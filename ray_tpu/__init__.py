@@ -1,0 +1,50 @@
+"""ray_tpu: a TPU-native distributed AI framework.
+
+A ground-up re-design of the reference system (iamjustinhsu/ray) for TPU
+hardware: the core task/actor/object runtime schedules work onto TPU hosts
+with a native shared-memory object store as the host staging tier for HBM,
+and the AI libraries (train/data/serve/tune) express parallelism as JAX mesh
+axes (dp/fsdp/tp/sp/ep) + pjit/shard_map with XLA collectives over ICI,
+rather than NCCL process groups.
+"""
+
+from ray_tpu._version import __version__
+from ray_tpu.api import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    get_runtime_context,
+    init,
+    is_initialized,
+    kill,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_tpu.core.actor import ActorClass, ActorHandle
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu import exceptions
+
+__all__ = [
+    "__version__",
+    "ActorClass",
+    "ActorHandle",
+    "ObjectRef",
+    "available_resources",
+    "cancel",
+    "cluster_resources",
+    "exceptions",
+    "get",
+    "get_actor",
+    "get_runtime_context",
+    "init",
+    "is_initialized",
+    "kill",
+    "put",
+    "remote",
+    "shutdown",
+    "wait",
+]

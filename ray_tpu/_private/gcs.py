@@ -1,0 +1,111 @@
+"""Global control state (GCS) tables.
+
+Counterpart of the reference's GCS server
+(/root/reference/src/ray/gcs/gcs_server/gcs_server.cc): actor registry with a
+lifecycle FSM, named-actor index, internal KV, and node table.  In this round
+it runs in-process in the head node behind a lock; the interface is kept
+narrow and message-shaped so it can move behind a socket/native service
+without touching callers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+# Actor lifecycle states (reference: src/ray/design_docs/actor_states.rst).
+DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+@dataclass
+class ActorInfo:
+    actor_id: bytes
+    name: Optional[str] = None
+    state: str = PENDING_CREATION
+    worker_id: Optional[bytes] = None
+    num_restarts: int = 0
+    max_restarts: int = 0
+    death_cause: Optional[str] = None
+    class_name: str = ""
+
+
+@dataclass
+class NodeInfo:
+    node_id: bytes
+    resources: dict = field(default_factory=dict)
+    alive: bool = True
+    ts: float = field(default_factory=time.time)
+
+
+class Gcs:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.actors: dict[bytes, ActorInfo] = {}
+        self.named_actors: dict[str, bytes] = {}
+        self.nodes: dict[bytes, NodeInfo] = {}
+        self.kv: dict[tuple[str, bytes], bytes] = {}
+        self.job_config: dict = {}
+
+    # -- actors ------------------------------------------------------------
+    def register_actor(self, info: ActorInfo):
+        with self._lock:
+            if info.name:
+                if info.name in self.named_actors:
+                    raise ValueError(f"actor name {info.name!r} already taken")
+                self.named_actors[info.name] = info.actor_id
+            self.actors[info.actor_id] = info
+
+    def update_actor(self, actor_id: bytes, **fields):
+        with self._lock:
+            info = self.actors.get(actor_id)
+            if info is None:
+                return
+            for k, v in fields.items():
+                setattr(info, k, v)
+            if info.state == DEAD and info.name:
+                self.named_actors.pop(info.name, None)
+
+    def get_actor(self, actor_id: bytes) -> Optional[ActorInfo]:
+        with self._lock:
+            return self.actors.get(actor_id)
+
+    def get_actor_by_name(self, name: str) -> Optional[ActorInfo]:
+        with self._lock:
+            actor_id = self.named_actors.get(name)
+            return self.actors.get(actor_id) if actor_id else None
+
+    def list_actors(self) -> list[ActorInfo]:
+        with self._lock:
+            return list(self.actors.values())
+
+    # -- nodes -------------------------------------------------------------
+    def register_node(self, info: NodeInfo):
+        with self._lock:
+            self.nodes[info.node_id] = info
+
+    def list_nodes(self) -> list[NodeInfo]:
+        with self._lock:
+            return list(self.nodes.values())
+
+    # -- internal KV (function/class registry, cluster metadata) -----------
+    def kv_put(self, namespace: str, key: bytes, value: bytes):
+        with self._lock:
+            self.kv[(namespace, key)] = value
+
+    def kv_get(self, namespace: str, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self.kv.get((namespace, key))
+
+    def kv_del(self, namespace: str, key: bytes):
+        with self._lock:
+            self.kv.pop((namespace, key), None)
+
+    def kv_keys(self, namespace: str) -> list[bytes]:
+        with self._lock:
+            return [k for (ns, k) in self.kv if ns == namespace]

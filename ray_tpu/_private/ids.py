@@ -1,0 +1,39 @@
+"""Binary IDs for tasks/actors/objects.
+
+Mirrors the reference's ID hierarchy (see
+/root/reference/src/ray/design_docs/id_specification.md: JobID ⊂ ActorID ⊂
+TaskID ⊂ ObjectID, where an ObjectID is a TaskID plus a return index) in a
+simplified 20-byte flat form: ObjectIDs produced by a task share the task's
+16-byte prefix with a 4-byte little-endian return index suffix.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+OBJECT_ID_LEN = 20
+TASK_ID_LEN = 16
+ACTOR_ID_LEN = 16
+NIL_ID = b"\x00" * OBJECT_ID_LEN
+
+
+def new_task_id() -> bytes:
+    return os.urandom(TASK_ID_LEN)
+
+
+def new_actor_id() -> bytes:
+    return os.urandom(ACTOR_ID_LEN)
+
+
+def object_id_for_return(task_id: bytes, index: int) -> bytes:
+    return task_id + struct.pack("<I", index)
+
+
+def random_object_id() -> bytes:
+    """For driver ``put``s, which have no producing task."""
+    return os.urandom(OBJECT_ID_LEN)
+
+
+def hex_short(id_bytes: bytes) -> str:
+    return id_bytes[:6].hex()

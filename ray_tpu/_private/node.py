@@ -1,0 +1,114 @@
+"""Node bootstrap: start/stop the per-node services.
+
+Counterpart of /root/reference/python/ray/_private/node.py: a head node owns
+the GCS, the scheduler ("raylet-lite"), and the native shared-memory object
+store daemon, all rooted in a session directory under /tmp/ray_tpu/.
+Resource detection treats TPU chips as first-class: ``RAY_TPU_NUM_CHIPS``
+overrides, else /dev/accel* (TPU VM) or an already-imported jax backend is
+consulted — we never import jax here, since grabbing the TPU belongs to the
+worker that wins the ``TPU`` resource.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+import time
+from typing import Optional
+
+from ray_tpu._private.gcs import Gcs, NodeInfo
+from ray_tpu._private.scheduler import Scheduler
+from ray_tpu.core.store_client import StoreClient, StoreServer
+
+DEFAULT_STORE_CAPACITY = 1 << 31  # 2 GiB host staging tier
+
+
+def detect_num_tpu_chips() -> int:
+    env = os.environ.get("RAY_TPU_NUM_CHIPS")
+    if env is not None:
+        return int(env)
+    accels = glob.glob("/dev/accel*") + [
+        p for p in glob.glob("/dev/vfio/*")
+        if os.path.basename(p).isdigit()  # skip the /dev/vfio/vfio control dev
+    ]
+    if accels:
+        return len(accels)
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None:
+        try:
+            return len([d for d in jax_mod.devices() if d.platform != "cpu"])
+        except Exception:
+            return 0
+    return 0
+
+
+def default_resources() -> dict:
+    res = {"CPU": float(os.cpu_count() or 1)}
+    n_tpu = detect_num_tpu_chips()
+    if n_tpu:
+        res["TPU"] = float(n_tpu)
+    return res
+
+
+class Node:
+    def __init__(
+        self,
+        resources: Optional[dict] = None,
+        object_store_memory: Optional[int] = None,
+        min_workers: int = 2,
+        max_workers: Optional[int] = None,
+        session_dir: Optional[str] = None,
+    ):
+        self.node_id = os.urandom(16)
+        ts = time.strftime("%Y-%m-%d_%H-%M-%S")
+        self.session_dir = session_dir or (
+            f"/tmp/ray_tpu/session_{ts}_{os.getpid()}"
+        )
+        os.makedirs(self.session_dir, exist_ok=True)
+
+        merged = default_resources()
+        if resources:
+            merged.update(resources)
+        self.resources = merged
+
+        capacity = object_store_memory or _default_store_capacity()
+        shm_name = f"rtpu_{os.getpid()}_{self.node_id[:4].hex()}"
+        self.store_server = StoreServer(
+            socket_path=os.path.join(self.session_dir, "store.sock"),
+            shm_name=shm_name,
+            capacity=capacity,
+        )
+        self.gcs = Gcs()
+        self.gcs.register_node(NodeInfo(self.node_id, resources=dict(merged)))
+        self.scheduler = Scheduler(
+            socket_path=os.path.join(self.session_dir, "sched.sock"),
+            store_socket=self.store_server.socket_path,
+            shm_name=shm_name,
+            store_capacity=capacity,
+            gcs=self.gcs,
+            node_resources=merged,
+            min_workers=min_workers,
+            max_workers=max_workers or max(4, int(merged.get("CPU", 4)) * 2),
+        )
+
+    def new_store_client(self) -> StoreClient:
+        return StoreClient(
+            self.store_server.socket_path,
+            self.store_server.shm_name,
+            self.store_server.capacity,
+        )
+
+    def shutdown(self):
+        self.scheduler.shutdown()
+        self.store_server.shutdown()
+
+
+def _default_store_capacity() -> int:
+    try:
+        import shutil
+
+        free = shutil.disk_usage("/dev/shm").free
+        return min(DEFAULT_STORE_CAPACITY, max(1 << 28, int(free * 0.5)))
+    except OSError:
+        return 1 << 28

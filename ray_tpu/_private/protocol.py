@@ -1,0 +1,72 @@
+"""Length-prefixed message framing over unix sockets.
+
+Control-plane counterpart of the reference's gRPC wrappers
+(/root/reference/src/ray/rpc/) scaled to the in-node runtime: messages are
+pickled dicts with a 4-byte length prefix.  The data plane never flows through
+here — objects move via the shared-memory store (store_client.py).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+
+_LEN = struct.Struct("<I")
+
+
+class Connection:
+    """A framed, thread-safe-for-send message connection."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self._send_lock = threading.Lock()
+
+    def send(self, msg: dict):
+        data = pickle.dumps(msg, protocol=5)
+        frame = _LEN.pack(len(data)) + data
+        with self._send_lock:
+            self.sock.sendall(frame)
+
+    def recv(self) -> dict | None:
+        """Receive one message; None on clean EOF."""
+        header = self._recv_exact(_LEN.size)
+        if header is None:
+            return None
+        (length,) = _LEN.unpack(header)
+        body = self._recv_exact(length)
+        if body is None:
+            return None
+        return pickle.loads(body)
+
+    def _recv_exact(self, n: int) -> bytes | None:
+        buf = b""
+        while len(buf) < n:
+            try:
+                chunk = self.sock.recv(n - len(buf))
+            except (ConnectionResetError, OSError):
+                return None
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def connect(path: str) -> Connection:
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.connect(path)
+    return Connection(s)
+
+
+def listener(path: str) -> socket.socket:
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.bind(path)
+    s.listen(512)
+    return s

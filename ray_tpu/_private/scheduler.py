@@ -1,0 +1,664 @@
+"""Node scheduler + worker pool ("raylet-lite").
+
+Single-node counterpart of the reference raylet
+(/root/reference/src/ray/raylet/node_manager.cc scheduling via
+scheduling/cluster_task_manager.cc + local_task_manager.cc, worker pool in
+worker_pool.h): owns the worker process pool, a pending-task queue, resource
+accounting (CPU/TPU/custom + placement-group bundles), actor→worker routing,
+and failure handling (crashed workers fail or retry their in-flight tasks).
+
+Runs as threads inside the head process in this round; the worker protocol is
+already socket-based so the scheduler can move out-of-process (and native)
+without changing workers.  TPU specifics: ``TPU`` is a first-class resource,
+and a worker granted TPU chips receives ``TPU_VISIBLE_CHIPS`` so concurrent
+JAX processes don't fight over the same device.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import traceback
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ray_tpu._private import gcs as gcs_mod
+from ray_tpu._private.protocol import Connection, listener
+from ray_tpu._private.serialization import store_error_best_effort
+from ray_tpu.core.store_client import StoreClient
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    TaskCancelledError,
+    WorkerCrashedError,
+)
+
+TASK = "task"
+ACTOR_CREATION = "actor_creation"
+ACTOR_METHOD = "actor_method"
+
+
+@dataclass
+class TaskSpec:
+    task_id: bytes
+    kind: str  # TASK | ACTOR_CREATION | ACTOR_METHOD
+    fn_id: bytes  # GCS KV key of the pickled function/class
+    args_blob: bytes  # cloudpickle of (args, kwargs) with ObjectRef markers
+    return_ids: list[bytes]
+    resources: dict = field(default_factory=dict)
+    actor_id: Optional[bytes] = None
+    method_name: Optional[str] = None
+    name: str = ""
+    max_retries: int = 0
+    retries_left: int = 0
+    max_restarts: int = 0
+    max_concurrency: int = 1
+    actor_name: Optional[str] = None
+    pg_id: Optional[bytes] = None
+    pg_bundle: Optional[int] = None
+    runtime_env: Optional[dict] = None
+
+
+@dataclass
+class WorkerState:
+    worker_id: bytes
+    proc: subprocess.Popen
+    conn: Optional[Connection] = None
+    idle: bool = False
+    actor_id: Optional[bytes] = None  # set once this worker hosts an actor
+    in_flight: dict = field(default_factory=dict)  # task_id -> TaskSpec
+    held_resources: dict = field(default_factory=dict)
+    held_pg: Optional[tuple[bytes, int]] = None
+    alive: bool = True
+    # Blocked-in-get bookkeeping: while a worker blocks on an unresolved
+    # object its granted resources are released back to the pool (reference:
+    # NotifyDirectCallTaskBlocked in src/ray/raylet/node_manager.cc) so
+    # dependency chains can't deadlock the node.
+    blocked_count: int = 0
+    blocked_resources: dict = field(default_factory=dict)
+    blocked_pg: Optional[tuple[bytes, int]] = None
+    held_chips: list = field(default_factory=list)  # physical TPU chip indices
+
+
+@dataclass
+class PlacementGroupState:
+    pg_id: bytes
+    bundles: list[dict]
+    strategy: str
+    available: list[dict] = field(default_factory=list)
+
+
+class Scheduler:
+    def __init__(
+        self,
+        socket_path: str,
+        store_socket: str,
+        shm_name: str,
+        store_capacity: int,
+        gcs: gcs_mod.Gcs,
+        node_resources: dict,
+        min_workers: int = 2,
+        max_workers: int = 64,
+        worker_env: Optional[dict] = None,
+    ):
+        self.socket_path = socket_path
+        self.store_socket = store_socket
+        self.shm_name = shm_name
+        self.store_capacity = store_capacity
+        self.gcs = gcs
+        self.total_resources = dict(node_resources)
+        self.available = dict(node_resources)
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        self.worker_env = worker_env or {}
+
+        self._lock = threading.RLock()
+        self._wake = threading.Condition(self._lock)
+        self._pending: deque[TaskSpec] = deque()
+        self._workers: dict[bytes, WorkerState] = {}
+        self._actor_workers: dict[bytes, bytes] = {}  # actor_id -> worker_id
+        self._pgs: dict[bytes, PlacementGroupState] = {}
+        self._task_index: dict[bytes, TaskSpec] = {}  # task_id -> spec (pending/running)
+        self._cancelled: set[bytes] = set()  # force-cancelled running tasks
+        # Physical TPU chip index allocator: grants concrete chip indices so
+        # concurrent TPU tasks never receive overlapping TPU_VISIBLE_CHIPS.
+        self._free_chips: list[int] = list(
+            range(int(node_resources.get("TPU", 0))))
+        self._shutdown = False
+
+        self._store = StoreClient(store_socket, shm_name, store_capacity)
+        self._listener = listener(socket_path)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="sched-accept", daemon=True
+        )
+        self._sched_thread = threading.Thread(
+            target=self._schedule_loop, name="sched-loop", daemon=True
+        )
+        self._accept_thread.start()
+        self._sched_thread.start()
+        for _ in range(min_workers):
+            self._spawn_worker()
+
+    # ------------------------------------------------------------------
+    # Public API (called from the driver thread and from worker readers)
+    # ------------------------------------------------------------------
+    def submit(self, spec: TaskSpec):
+        with self._lock:
+            if self._shutdown:
+                return
+            if spec.kind == ACTOR_CREATION:
+                try:
+                    self.gcs.register_actor(gcs_mod.ActorInfo(
+                        actor_id=spec.actor_id, name=spec.actor_name,
+                        max_restarts=spec.max_restarts, class_name=spec.name))
+                except ValueError as e:
+                    self._fail_task(spec, e)
+                    return
+                import pickle
+
+                self.gcs.kv_put("actor_creation", spec.actor_id,
+                                pickle.dumps(spec))
+            spec.retries_left = spec.max_retries
+            self._pending.append(spec)
+            self._task_index[spec.task_id] = spec
+            self._wake.notify_all()
+
+    def cancel(self, task_id: bytes, force: bool = False) -> bool:
+        """Cancel a pending task; with force, kill the running worker too."""
+        with self._lock:
+            spec = self._task_index.get(task_id)
+            if spec is None:
+                return False
+            if spec in self._pending:
+                self._pending.remove(spec)
+                self._task_index.pop(task_id, None)
+                self._fail_task(spec, TaskCancelledError(f"task {spec.name} cancelled"))
+                return True
+            if force:
+                for w in self._workers.values():
+                    if task_id in w.in_flight and w.actor_id is None:
+                        # Mark cancelled so worker-death handling fails the
+                        # task with TaskCancelledError instead of retrying.
+                        self._cancelled.add(task_id)
+                        self._terminate_worker(w)
+                        return True
+            return False
+
+    def kill_actor(self, actor_id: bytes, no_restart: bool = True):
+        with self._lock:
+            worker_id = self._actor_workers.get(actor_id)
+            if worker_id is None:
+                self.gcs.update_actor(actor_id, state=gcs_mod.DEAD,
+                                      death_cause="killed before placement")
+                # Drop queued creation/method tasks for it.
+                for spec in [s for s in self._pending if s.actor_id == actor_id]:
+                    self._pending.remove(spec)
+                    self._fail_task(spec, ActorDiedError("actor was killed"))
+                return
+            w = self._workers.get(worker_id)
+            if no_restart:
+                self.gcs.update_actor(actor_id, max_restarts=0)
+            if w is not None:
+                self._terminate_worker(w)
+
+    def create_placement_group(self, pg_id: bytes, bundles: list[dict],
+                               strategy: str) -> bool:
+        """Atomically reserve all bundles from node-available resources."""
+        with self._lock:
+            need: dict[str, float] = {}
+            for b in bundles:
+                for k, v in b.items():
+                    need[k] = need.get(k, 0) + v
+            for k, v in need.items():
+                if self.available.get(k, 0) < v:
+                    return False
+            for k, v in need.items():
+                self.available[k] -= v
+            self._pgs[pg_id] = PlacementGroupState(
+                pg_id, [dict(b) for b in bundles], strategy,
+                available=[dict(b) for b in bundles])
+            return True
+
+    def remove_placement_group(self, pg_id: bytes):
+        with self._lock:
+            pg = self._pgs.pop(pg_id, None)
+            if pg is None:
+                return
+            for b in pg.bundles:
+                for k, v in b.items():
+                    self.available[k] = self.available.get(k, 0) + v
+            self._wake.notify_all()
+
+    def placement_group_table(self) -> dict:
+        with self._lock:
+            return {
+                pg_id: {"bundles": pg.bundles, "strategy": pg.strategy,
+                        "available": pg.available}
+                for pg_id, pg in self._pgs.items()
+            }
+
+    def state_snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "num_workers": len([w for w in self._workers.values() if w.alive]),
+                "num_idle": len([w for w in self._workers.values()
+                                 if w.alive and w.idle]),
+                "pending_tasks": len(self._pending),
+                "available_resources": dict(self.available),
+                "total_resources": dict(self.total_resources),
+            }
+
+    def shutdown(self):
+        with self._lock:
+            self._shutdown = True
+            workers = list(self._workers.values())
+            self._wake.notify_all()
+        for w in workers:
+            try:
+                w.proc.terminate()
+            except OSError:
+                pass
+        for w in workers:
+            try:
+                w.proc.wait(timeout=2)
+            except subprocess.TimeoutExpired:
+                w.proc.kill()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+        self._store.close()
+
+    # ------------------------------------------------------------------
+    # Worker pool
+    # ------------------------------------------------------------------
+    def _spawn_worker(self) -> WorkerState:
+        worker_id = os.urandom(8)
+        env = dict(os.environ)
+        env.update(self.worker_env)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main",
+             "--scheduler-socket", self.socket_path,
+             "--store-socket", self.store_socket,
+             "--shm-name", self.shm_name,
+             "--store-capacity", str(self.store_capacity),
+             "--worker-id", worker_id.hex()],
+            env=env,
+        )
+        w = WorkerState(worker_id=worker_id, proc=proc)
+        self._workers[worker_id] = w
+        return w
+
+    def _accept_loop(self):
+        while not self._shutdown:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            conn = Connection(sock)
+            threading.Thread(target=self._reader_loop, args=(conn,),
+                             daemon=True).start()
+
+    def _reader_loop(self, conn: Connection):
+        worker: Optional[WorkerState] = None
+        while True:
+            msg = conn.recv()
+            if msg is None:
+                break
+            t = msg["t"]
+            if t == "register":
+                worker_id = bytes.fromhex(msg["worker_id"])
+                with self._lock:
+                    worker = self._workers.get(worker_id)
+                    if worker is None:  # late registration after shutdown
+                        conn.close()
+                        return
+                    worker.conn = conn
+                    worker.idle = True
+                    self._wake.notify_all()
+            elif t == "done":
+                self._on_task_done(worker, msg)
+            elif t == "submit":
+                self.submit(msg["spec"])
+            elif t == "actor_exit":
+                with self._lock:
+                    self.gcs.update_actor(msg["actor_id"], max_restarts=0)
+            elif t == "blocked":
+                if worker is not None:
+                    self._on_worker_blocked(worker)
+            elif t == "unblocked":
+                if worker is not None:
+                    self._on_worker_unblocked(worker)
+            elif t == "rpc":
+                try:
+                    result = self._handle_rpc(msg["method"], msg.get("params", {}))
+                    conn.send({"ok": True, "result": result})
+                except Exception as e:
+                    conn.send({"ok": False, "error": repr(e)})
+        if worker is not None:
+            self._on_worker_death(worker)
+
+    def _handle_rpc(self, method: str, params: dict):
+        """Request/response control-plane calls from workers (one-shot conns)."""
+        if method == "get_actor_by_name":
+            info = self.gcs.get_actor_by_name(params["name"])
+            if info is None or info.state == gcs_mod.DEAD:
+                return None
+            return {"actor_id": info.actor_id, "class_name": info.class_name}
+        if method == "actor_state":
+            info = self.gcs.get_actor(params["actor_id"])
+            return None if info is None else info.state
+        if method == "kill_actor":
+            self.kill_actor(params["actor_id"], params.get("no_restart", True))
+            return True
+        if method == "cancel":
+            return self.cancel(params["task_id"], params.get("force", False))
+        if method == "create_placement_group":
+            return self.create_placement_group(
+                params["pg_id"], params["bundles"], params["strategy"])
+        if method == "remove_placement_group":
+            self.remove_placement_group(params["pg_id"])
+            return True
+        if method == "cluster_state":
+            return self.state_snapshot()
+        if method == "pg_table":
+            return self.placement_group_table()
+        if method == "kv_get":
+            return self.gcs.kv_get(params["namespace"], params["key"])
+        if method == "kv_put":
+            self.gcs.kv_put(params["namespace"], params["key"], params["value"])
+            return True
+        raise ValueError(f"unknown rpc method {method!r}")
+
+    def _on_worker_blocked(self, worker: WorkerState):
+        with self._lock:
+            worker.blocked_count += 1
+            # Only CPU is released while blocked: TPU chips (and custom
+            # resources) stay held because device state survives the block —
+            # same rule as the reference (CPU released, GPU kept).
+            cpu = worker.held_resources.get("CPU", 0)
+            if worker.blocked_count == 1 and cpu:
+                worker.blocked_resources = {"CPU": cpu}
+                worker.blocked_pg = worker.held_pg
+                worker.held_resources = {
+                    k: v for k, v in worker.held_resources.items() if k != "CPU"
+                }
+                if worker.held_pg is not None:
+                    pg_id, bundle = worker.held_pg
+                    pg = self._pgs.get(pg_id)
+                    if pg is not None:
+                        pg.available[bundle]["CPU"] = (
+                            pg.available[bundle].get("CPU", 0) + cpu)
+                else:
+                    self.available["CPU"] = self.available.get("CPU", 0) + cpu
+                self._wake.notify_all()
+
+    def _on_worker_unblocked(self, worker: WorkerState):
+        with self._lock:
+            worker.blocked_count = max(0, worker.blocked_count - 1)
+            if worker.blocked_count == 0 and worker.blocked_resources:
+                # Re-acquire unconditionally; transient oversubscription is
+                # accepted (it self-corrects as tasks finish).
+                res, pg = worker.blocked_resources, worker.blocked_pg
+                worker.blocked_resources, worker.blocked_pg = {}, None
+                for k, v in res.items():
+                    worker.held_resources[k] = (
+                        worker.held_resources.get(k, 0) + v)
+                worker.held_pg = pg
+                if pg is not None:
+                    pg_state = self._pgs.get(pg[0])
+                    if pg_state is not None:
+                        for k, v in res.items():
+                            pg_state.available[pg[1]][k] = (
+                                pg_state.available[pg[1]].get(k, 0) - v)
+                else:
+                    for k, v in res.items():
+                        self.available[k] = self.available.get(k, 0) - v
+
+    def _on_task_done(self, worker: WorkerState, msg: dict):
+        task_id = msg["task_id"]
+        with self._lock:
+            spec = worker.in_flight.pop(task_id, None)
+            self._task_index.pop(task_id, None)
+            if spec is None:
+                return
+            if spec.kind == ACTOR_CREATION:
+                if msg["ok"]:
+                    self.gcs.update_actor(spec.actor_id, state=gcs_mod.ALIVE,
+                                          worker_id=worker.worker_id)
+                else:
+                    self.gcs.update_actor(spec.actor_id, state=gcs_mod.DEAD,
+                                          death_cause=msg.get("error"))
+                    self._release_worker_grants(worker)
+                    worker.actor_id = None
+                    self._actor_workers.pop(spec.actor_id, None)
+                    worker.idle = True
+            elif spec.kind == TASK:
+                self._release_worker_grants(worker)
+                worker.idle = True
+            # ACTOR_METHOD: worker stays bound to the actor; nothing to release.
+            self._wake.notify_all()
+
+    def _on_worker_death(self, worker: WorkerState):
+        with self._lock:
+            if not worker.alive:
+                return
+            worker.alive = False
+            worker.idle = False
+            self._release_worker_grants(worker)
+            in_flight = list(worker.in_flight.values())
+            worker.in_flight.clear()
+            self._workers.pop(worker.worker_id, None)
+
+            dead_actor = worker.actor_id
+            if dead_actor is not None:
+                self._actor_workers.pop(dead_actor, None)
+                info = self.gcs.get_actor(dead_actor)
+                restarts_ok = (
+                    info is not None
+                    and info.state != gcs_mod.DEAD
+                    and (info.max_restarts == -1
+                         or info.num_restarts < info.max_restarts)
+                )
+                if restarts_ok:
+                    self.gcs.update_actor(dead_actor,
+                                          state=gcs_mod.RESTARTING,
+                                          num_restarts=info.num_restarts + 1,
+                                          worker_id=None)
+                    creation = self._creation_spec_for(dead_actor)
+                    if creation is not None:
+                        self._pending.appendleft(creation)
+                        self._task_index[creation.task_id] = creation
+                else:
+                    self.gcs.update_actor(dead_actor, state=gcs_mod.DEAD,
+                                          death_cause="worker died")
+                    for spec in [s for s in self._pending
+                                 if s.actor_id == dead_actor]:
+                        self._pending.remove(spec)
+                        self._fail_task(spec, ActorDiedError(
+                            "The actor died unexpectedly before finishing "
+                            "this task."))
+
+            for spec in in_flight:
+                if spec.task_id in self._cancelled:
+                    self._cancelled.discard(spec.task_id)
+                    self._fail_task(spec, TaskCancelledError(
+                        f"task {spec.name} was force-cancelled"))
+                elif spec.kind != ACTOR_METHOD and spec.retries_left > 0:
+                    spec.retries_left -= 1
+                    self._pending.appendleft(spec)
+                    self._task_index[spec.task_id] = spec
+                else:
+                    err = (ActorDiedError("actor died while executing method")
+                           if spec.kind == ACTOR_METHOD
+                           else WorkerCrashedError(
+                               f"worker died executing {spec.name}"))
+                    self._fail_task(spec, err)
+            self._wake.notify_all()
+
+    def _creation_spec_for(self, actor_id: bytes) -> Optional[TaskSpec]:
+        """Rebuild the creation TaskSpec for restart from GCS KV."""
+        blob = self.gcs.kv_get("actor_creation", actor_id)
+        if blob is None:
+            return None
+        import pickle
+
+        spec: TaskSpec = pickle.loads(blob)
+        spec.task_id = os.urandom(16)
+        spec.return_ids = []  # restart produces no new creation return
+        return spec
+
+    def _terminate_worker(self, w: WorkerState):
+        try:
+            w.proc.terminate()
+        except OSError:
+            pass
+
+    def _release_worker_grants(self, worker: WorkerState):
+        if worker.held_pg is not None:
+            pg_id, bundle = worker.held_pg
+            pg = self._pgs.get(pg_id)
+            if pg is not None:
+                for k, v in worker.held_resources.items():
+                    pg.available[bundle][k] = pg.available[bundle].get(k, 0) + v
+        else:
+            for k, v in worker.held_resources.items():
+                self.available[k] = self.available.get(k, 0) + v
+        worker.held_resources = {}
+        worker.held_pg = None
+        if worker.held_chips:
+            self._free_chips.extend(worker.held_chips)
+            self._free_chips.sort()
+            worker.held_chips = []
+
+    def _fail_task(self, spec: TaskSpec, exc: Exception):
+        for oid in spec.return_ids:
+            if not store_error_best_effort(self._store, oid, exc, ""):
+                traceback.print_exc()
+                print(f"FATAL: could not record error for {oid.hex()[:12]}; "
+                      f"gets on it will hang", flush=True)
+
+    # ------------------------------------------------------------------
+    # Scheduling loop
+    # ------------------------------------------------------------------
+    def _schedule_loop(self):
+        while True:
+            with self._lock:
+                while not self._shutdown and not self._try_schedule_locked():
+                    self._wake.wait(timeout=1.0)
+                if self._shutdown:
+                    return
+
+    def _try_schedule_locked(self) -> bool:
+        """Dispatch as many pending tasks as possible; True if progress made."""
+        progress = False
+        remaining: deque[TaskSpec] = deque()
+        while self._pending:
+            spec = self._pending.popleft()
+            if spec.kind == ACTOR_METHOD:
+                worker_id = self._actor_workers.get(spec.actor_id)
+                info = self.gcs.get_actor(spec.actor_id)
+                if info is not None and info.state == gcs_mod.DEAD:
+                    self._task_index.pop(spec.task_id, None)
+                    self._fail_task(spec, ActorDiedError(
+                        f"actor {spec.actor_id.hex()[:8]} is dead: "
+                        f"{info.death_cause}"))
+                    progress = True
+                    continue
+                if worker_id is None or worker_id not in self._workers:
+                    remaining.append(spec)  # actor still being (re)created
+                    continue
+                w = self._workers[worker_id]
+                if w.conn is None:
+                    remaining.append(spec)
+                    continue
+                w.in_flight[spec.task_id] = spec
+                self._dispatch(w, spec)
+                progress = True
+                continue
+
+            granted = self._acquire_resources(spec)
+            if granted is None:
+                remaining.append(spec)
+                continue
+            w = self._find_idle_worker()
+            if w is None:
+                self._return_resources(spec, granted)
+                remaining.append(spec)
+                self._maybe_grow_pool()
+                continue
+            w.idle = False
+            w.held_resources = granted
+            w.held_pg = ((spec.pg_id, spec.pg_bundle)
+                         if spec.pg_id is not None else None)
+            w.in_flight[spec.task_id] = spec
+            if spec.kind == ACTOR_CREATION:
+                w.actor_id = spec.actor_id
+                self._actor_workers[spec.actor_id] = w.worker_id
+                self.gcs.update_actor(spec.actor_id, state=gcs_mod.PENDING_CREATION)
+            self._dispatch(w, spec)
+            progress = True
+        self._pending = remaining
+        return progress
+
+    def _acquire_resources(self, spec: TaskSpec) -> Optional[dict]:
+        res = spec.resources or {}
+        if spec.pg_id is not None:
+            pg = self._pgs.get(spec.pg_id)
+            if pg is None:
+                return None
+            bundle = spec.pg_bundle if spec.pg_bundle is not None else 0
+            avail = pg.available[bundle]
+            if any(avail.get(k, 0) < v for k, v in res.items()):
+                return None
+            for k, v in res.items():
+                avail[k] -= v
+            return dict(res)
+        if any(self.available.get(k, 0) < v for k, v in res.items()):
+            return None
+        for k, v in res.items():
+            self.available[k] -= v
+        return dict(res)
+
+    def _return_resources(self, spec: TaskSpec, granted: dict):
+        if spec.pg_id is not None:
+            pg = self._pgs.get(spec.pg_id)
+            if pg is not None:
+                bundle = spec.pg_bundle if spec.pg_bundle is not None else 0
+                for k, v in granted.items():
+                    pg.available[bundle][k] = pg.available[bundle].get(k, 0) + v
+        else:
+            for k, v in granted.items():
+                self.available[k] = self.available.get(k, 0) + v
+
+    def _find_idle_worker(self) -> Optional[WorkerState]:
+        for w in self._workers.values():
+            if w.alive and w.idle and w.conn is not None and w.actor_id is None:
+                return w
+        return None
+
+    def _maybe_grow_pool(self):
+        n_normal = len([w for w in self._workers.values()
+                        if w.alive and w.actor_id is None])
+        if n_normal < self.max_workers:
+            self._spawn_worker()
+
+    def _dispatch(self, w: WorkerState, spec: TaskSpec):
+        tpus = spec.resources.get("TPU", 0) if spec.resources else 0
+        env: dict[str, str] = {}
+        n_chips = int(tpus)
+        if n_chips >= 1 and len(self._free_chips) >= n_chips:
+            chips = [self._free_chips.pop(0) for _ in range(n_chips)]
+            w.held_chips.extend(chips)
+            env["TPU_VISIBLE_CHIPS"] = ",".join(str(i) for i in chips)
+        w.conn.send({"t": "task", "spec": spec, "env": env})

@@ -1,0 +1,159 @@
+"""Object serialization for the shared-memory store.
+
+Counterpart of /root/reference/python/ray/_private/serialization.py, designed
+around the TPU data path: numpy/JAX arrays are written as raw buffers after a
+small header so ``get`` can return a zero-copy view of shared memory that
+feeds ``jax.device_put`` (host-staging tier for HBM) without a host copy.
+Everything else goes through cloudpickle.
+
+Wire format: 1-byte tag, then payload.
+  tag 0: cloudpickle payload
+  tag 1: error payload — pickle of (exception, remote_traceback_str)
+  tag 2: array payload — u32 meta_len | pickle((dtype_str, shape)) | raw data
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import weakref
+
+import cloudpickle
+import numpy as np
+
+from ray_tpu.exceptions import RayTpuError, TaskError
+
+_task_error_types: dict[type, type] = {}
+
+
+def _as_raisable(exc: BaseException, tb: str) -> BaseException:
+    """Convert a stored remote exception into the exception to raise locally.
+
+    System errors (ActorDiedError, WorkerCrashedError, ...) raise as
+    themselves.  User exceptions raise as a dynamic subclass of both TaskError
+    and the original type, so ``except ValueError`` catches a remote
+    ValueError — same trick as the reference's RayTaskError
+    (/root/reference/python/ray/exceptions.py make_dual_exception_type).
+    """
+    if isinstance(exc, RayTpuError):
+        return exc
+    cause_t = type(exc)
+    dual = _task_error_types.get(cause_t)
+    if dual is None:
+        try:
+            dual = type(f"TaskError({cause_t.__name__})",
+                        (TaskError, cause_t), {})
+            _task_error_types[cause_t] = dual
+        except TypeError:  # e.g. cause type with incompatible layout
+            return TaskError(exc, tb)
+    try:
+        return dual(exc, tb)
+    except Exception:
+        return TaskError(exc, tb)
+
+TAG_PICKLE = 0
+TAG_ERROR = 1
+TAG_ARRAY = 2
+
+_U32 = struct.Struct("<I")
+
+
+def _as_host_array(value):
+    """Return a C-contiguous numpy view/copy for array-like values, else None."""
+    if isinstance(value, np.ndarray):
+        arr = value
+    elif type(value).__module__.startswith(("jaxlib", "jax")) and hasattr(
+        value, "__array__"
+    ):
+        arr = np.asarray(value)
+    else:
+        return None
+    if arr.dtype == object or arr.dtype.hasobject:
+        return None
+    return np.ascontiguousarray(arr)
+
+
+def serialized_size(value) -> tuple[int, object]:
+    """Compute the store allocation size and a prepared payload token."""
+    arr = _as_host_array(value)
+    if arr is not None:
+        meta = pickle.dumps((arr.dtype.str, arr.shape))
+        return 1 + _U32.size + len(meta) + arr.nbytes, ("array", meta, arr)
+    blob = cloudpickle.dumps(value)
+    return 1 + len(blob), ("pickle", blob)
+
+
+def write_payload(buf: memoryview, token) -> None:
+    kind = token[0]
+    if kind == "array":
+        _, meta, arr = token
+        buf[0] = TAG_ARRAY
+        off = 1
+        buf[off : off + _U32.size] = _U32.pack(len(meta))
+        off += _U32.size
+        buf[off : off + len(meta)] = meta
+        off += len(meta)
+        flat = arr.reshape(-1).view(np.uint8)
+        buf[off : off + arr.nbytes] = flat.data
+    else:
+        _, blob = token
+        buf[0] = TAG_PICKLE
+        buf[1 : 1 + len(blob)] = blob
+
+
+def serialize_error(exc: BaseException, tb: str) -> bytes:
+    try:
+        payload = pickle.dumps((exc, tb))
+    except Exception:
+        # Unpicklable exception: degrade to a RuntimeError with its repr.
+        payload = pickle.dumps((RuntimeError(repr(exc)), tb))
+    return bytes([TAG_ERROR]) + payload
+
+
+def store_error_best_effort(store, oid: bytes, exc: BaseException, tb: str) -> bool:
+    """Write an error payload to the store, degrading rather than leaving the
+    return object absent (an absent return hangs blocking ``get``s forever).
+    """
+    fallback = serialize_error(
+        RuntimeError(f"original error unrecordable: {type(exc).__name__}: "
+                     f"{str(exc)[:200]}"), "")
+    for payload in (serialize_error(exc, tb), fallback):
+        try:
+            store.put(oid, payload)
+            return True
+        except FileExistsError:
+            return True
+        except Exception:
+            continue
+    return False
+
+
+def deserialize(view: memoryview, release_cb=None):
+    """Deserialize a stored object from a pinned shm view.
+
+    ``release_cb`` is invoked when the object's pin can be dropped: immediately
+    for copying formats, or when the returned zero-copy array is GC'd.
+    Raises TaskError for stored errors.
+    """
+    tag = view[0]
+    if tag == TAG_PICKLE:
+        value = pickle.loads(view[1:])
+        if release_cb:
+            release_cb()
+        return value
+    if tag == TAG_ERROR:
+        exc, tb = pickle.loads(view[1:])
+        if release_cb:
+            release_cb()
+        raise _as_raisable(exc, tb)
+    if tag == TAG_ARRAY:
+        (meta_len,) = _U32.unpack(view[1 : 1 + _U32.size])
+        off = 1 + _U32.size
+        dtype_str, shape = pickle.loads(view[off : off + meta_len])
+        off += meta_len
+        arr = np.frombuffer(view[off:], dtype=np.dtype(dtype_str)).reshape(shape)
+        arr.flags.writeable = False
+        if release_cb:
+            weakref.finalize(arr, release_cb)
+        return arr
+    raise ValueError(f"unknown object tag {tag}")

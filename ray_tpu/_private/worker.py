@@ -1,0 +1,174 @@
+"""Process-local runtime context shared by driver and workers.
+
+Counterpart of the reference core worker
+(/root/reference/src/ray/core_worker/core_worker.h:166 and
+python/ray/_private/worker.py): every process participating in a cluster —
+the driver and each pooled worker — holds one ``WorkerContext`` wiring the
+shared-memory store client and the control-plane path (direct calls in the
+driver; socket messages in workers).  ``ray_tpu.get/put/remote`` route through
+the current global context, so user code behaves identically in both.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+import cloudpickle
+
+from ray_tpu._private import ids
+from ray_tpu._private.serialization import deserialize, serialized_size, write_payload
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.store_client import StoreClient
+from ray_tpu.exceptions import GetTimeoutError
+
+_GET_CHUNK_MS = 500  # blocking-get slice so Ctrl-C stays responsive
+
+
+class WorkerContext:
+    def __init__(
+        self,
+        mode: str,  # "driver" | "worker"
+        store: StoreClient,
+        submit_fn: Callable,  # (TaskSpec) -> None
+        rpc_fn: Callable,  # (method, params) -> result
+        worker_id: bytes = b"",
+        node=None,
+        block_notify_fn: Optional[Callable] = None,
+    ):
+        self.mode = mode
+        self.store = store
+        self.submit = submit_fn
+        self.rpc = rpc_fn
+        self.worker_id = worker_id
+        self.node = node
+        # Called with True/False around blocking waits so the scheduler can
+        # release/re-acquire this worker's resource grant — prevents
+        # dependency-chain deadlocks on small nodes.
+        self._block_notify = block_notify_fn
+        # Thread-local: concurrent actor methods (max_concurrency > 1) each
+        # run on their own pool thread and must see their own task id.
+        self._tls = threading.local()
+        # id(fn) -> (fn, object-id). The strong reference to fn is load-
+        # bearing: without it a GC'd function's address can be reused by a
+        # new function, which would then resolve to the stale blob.
+        self._fn_cache: dict[int, tuple[object, bytes]] = {}
+
+    @property
+    def current_task_id(self) -> Optional[bytes]:
+        return getattr(self._tls, "task_id", None)
+
+    @current_task_id.setter
+    def current_task_id(self, value: Optional[bytes]):
+        self._tls.task_id = value
+
+    @property
+    def current_actor_id(self) -> Optional[bytes]:
+        return getattr(self._tls, "actor_id", None)
+
+    @current_actor_id.setter
+    def current_actor_id(self, value: Optional[bytes]):
+        self._tls.actor_id = value
+
+    # -- objects -----------------------------------------------------------
+    def put_object(self, value, oid: Optional[bytes] = None) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError("passing an ObjectRef to put is not allowed")
+        oid = oid or ids.random_object_id()
+        size, token = serialized_size(value)
+        buf = self.store.create(oid, size)
+        try:
+            write_payload(buf, token)
+        finally:
+            buf.release()
+        self.store.seal(oid)
+        return ObjectRef(oid)
+
+    def get_object(self, ref: ObjectRef, timeout: Optional[float] = None):
+        oid = ref.binary()
+        # Fast path: already sealed, no block notification needed.
+        view = self.store.get(oid, 0)
+        if view is not None:
+            return deserialize(view, release_cb=lambda o=oid: self.store.release(o))
+        deadline = None if timeout is None else time.monotonic() + timeout
+        blocked = False
+        try:
+            while True:
+                if not blocked and self._block_notify is not None:
+                    self._block_notify(True)
+                    blocked = True
+                view = self.store.get(oid, _GET_CHUNK_MS)
+                if view is not None:
+                    return deserialize(
+                        view, release_cb=lambda o=oid: self.store.release(o)
+                    )
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise GetTimeoutError(
+                        f"get timed out after {timeout}s waiting for {ref}"
+                    )
+        finally:
+            if blocked:
+                self._block_notify(False)
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        pending = list(refs)
+        ready: list[ObjectRef] = []
+        deadline = None if timeout is None else time.monotonic() + timeout
+        blocked = False
+        try:
+            while True:
+                still = []
+                for ref in pending:
+                    if self.store.contains(ref.binary()):
+                        ready.append(ref)
+                    else:
+                        still.append(ref)
+                pending = still
+                if len(ready) >= num_returns or not pending:
+                    return ready, pending
+                if deadline is not None and time.monotonic() >= deadline:
+                    return ready, pending
+                if not blocked and self._block_notify is not None:
+                    self._block_notify(True)
+                    blocked = True
+                time.sleep(0.005)
+        finally:
+            if blocked:
+                self._block_notify(False)
+
+    # -- function registry (store doubles as the GCS function KV) ----------
+    def register_function(self, fn) -> bytes:
+        cached = self._fn_cache.get(id(fn))
+        if cached is not None and cached[0] is fn and self.store.contains(cached[1]):
+            return cached[1]
+        blob = cloudpickle.dumps(fn)
+        fn_id = ids.random_object_id()
+        buf = self.store.create(fn_id, len(blob))
+        try:
+            buf[:] = blob
+        finally:
+            buf.release()
+        self.store.seal(fn_id)
+        self._fn_cache[id(fn)] = (fn, fn_id)
+        return fn_id
+
+
+_global_worker: Optional[WorkerContext] = None
+
+
+def set_global_worker(w: Optional[WorkerContext]):
+    global _global_worker
+    _global_worker = w
+
+
+def global_worker() -> WorkerContext:
+    if _global_worker is None:
+        raise RuntimeError(
+            "ray_tpu has not been initialized; call ray_tpu.init() first"
+        )
+    return _global_worker
+
+
+def is_initialized() -> bool:
+    return _global_worker is not None

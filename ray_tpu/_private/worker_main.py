@@ -1,0 +1,188 @@
+"""Worker process entry point.
+
+Counterpart of the reference worker main loop
+(/root/reference/python/ray/_private/worker.py:953 ``main_loop`` + the task
+execution callback in python/ray/_raylet.pyx:2295): connects to the node's
+scheduler and object store, registers, then executes task messages —
+deserializing args (resolving top-level ObjectRefs from the store), running
+the user function or actor method, and writing returns back to shared memory.
+Actors with ``max_concurrency > 1`` run methods on a thread pool; everything
+else is sequential in arrival order, which preserves actor call ordering.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+
+import cloudpickle
+
+from ray_tpu._private import protocol
+from ray_tpu._private.scheduler import ACTOR_CREATION, ACTOR_METHOD, TaskSpec
+from ray_tpu._private.serialization import store_error_best_effort
+from ray_tpu._private.worker import WorkerContext, set_global_worker
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.store_client import StoreClient
+
+
+class WorkerRuntime:
+    def __init__(self, args):
+        self.worker_id = bytes.fromhex(args.worker_id)
+        self.store = StoreClient(args.store_socket, args.shm_name,
+                                 args.store_capacity)
+        self.conn = protocol.connect(args.scheduler_socket)
+        self.scheduler_socket = args.scheduler_socket
+        self.actors: dict[bytes, object] = {}
+        self.actor_pools: dict[bytes, ThreadPoolExecutor] = {}
+        self.fn_cache: dict[bytes, object] = {}
+
+        self.ctx = WorkerContext(
+            mode="worker",
+            store=self.store,
+            submit_fn=lambda spec: self.conn.send({"t": "submit", "spec": spec}),
+            rpc_fn=self._rpc,
+            worker_id=self.worker_id,
+            block_notify_fn=lambda blocked: self.conn.send(
+                {"t": "blocked" if blocked else "unblocked"}),
+        )
+        set_global_worker(self.ctx)
+
+    def _rpc(self, method: str, params: dict):
+        conn = protocol.connect(self.scheduler_socket)
+        try:
+            conn.send({"t": "rpc", "method": method, "params": params})
+            resp = conn.recv()
+        finally:
+            conn.close()
+        if resp is None or not resp.get("ok"):
+            raise RuntimeError(f"rpc {method} failed: "
+                               f"{resp.get('error') if resp else 'closed'}")
+        return resp["result"]
+
+    def run(self):
+        self.conn.send({"t": "register", "worker_id": self.worker_id.hex()})
+        while True:
+            msg = self.conn.recv()
+            if msg is None:
+                return
+            t = msg["t"]
+            if t == "task":
+                self.handle_task(msg["spec"], msg.get("env") or {})
+            elif t == "shutdown":
+                return
+
+    def handle_task(self, spec: TaskSpec, env: dict):
+        # Clear env granted to the previous task (e.g. TPU_VISIBLE_CHIPS)
+        # before applying this task's grant — a pooled worker must not leak
+        # chip visibility across tasks.
+        for k in getattr(self, "_last_task_env", ()):  # noqa: B009
+            if k not in env:
+                os.environ.pop(k, None)
+        self._last_task_env = list(env)
+        for k, v in env.items():
+            os.environ[k] = v
+        pool = self.actor_pools.get(spec.actor_id) if spec.actor_id else None
+        if spec.kind == ACTOR_METHOD and pool is not None:
+            pool.submit(self.execute, spec)
+        else:
+            self.execute(spec)
+
+    def _load_function(self, fn_id: bytes):
+        fn = self.fn_cache.get(fn_id)
+        if fn is None:
+            view = self.store.get(fn_id, 60_000)
+            if view is None:
+                raise RuntimeError(f"function blob {fn_id.hex()[:12]} not found")
+            try:
+                fn = cloudpickle.loads(bytes(view))
+            finally:
+                self.store.release(fn_id)
+            self.fn_cache[fn_id] = fn
+        return fn
+
+    def _resolve_args(self, blob: bytes):
+        args, kwargs = cloudpickle.loads(blob)
+        # Ray semantics: top-level ObjectRef args are resolved to their
+        # values; refs nested inside structures are passed through as refs.
+        args = [self.ctx.get_object(a) if isinstance(a, ObjectRef) else a
+                for a in args]
+        kwargs = {k: self.ctx.get_object(v) if isinstance(v, ObjectRef) else v
+                  for k, v in kwargs.items()}
+        return args, kwargs
+
+    def _store_returns(self, spec: TaskSpec, result):
+        n = len(spec.return_ids)
+        if n == 0:
+            return
+        values = (list(result) if n > 1 else [result])
+        if n > 1 and len(values) != n:
+            raise ValueError(
+                f"task {spec.name} declared num_returns={n} but returned "
+                f"{len(values)} values")
+        for oid, value in zip(spec.return_ids, values):
+            try:
+                self.ctx.put_object(value, oid=oid)
+            except FileExistsError:
+                pass  # retried task; first result wins
+
+    def execute(self, spec: TaskSpec):
+        self.ctx.current_task_id = spec.task_id
+        self.ctx.current_actor_id = spec.actor_id
+        ok, error = True, None
+        try:
+            if spec.kind == ACTOR_CREATION:
+                cls = self._load_function(spec.fn_id)
+                args, kwargs = self._resolve_args(spec.args_blob)
+                instance = cls(*args, **kwargs)
+                self.actors[spec.actor_id] = instance
+                if spec.max_concurrency > 1:
+                    self.actor_pools[spec.actor_id] = ThreadPoolExecutor(
+                        max_workers=spec.max_concurrency)
+                self._store_returns(spec, None)
+            elif spec.kind == ACTOR_METHOD:
+                instance = self.actors.get(spec.actor_id)
+                if instance is None:
+                    raise RuntimeError(
+                        f"actor {spec.actor_id.hex()[:8]} not on this worker")
+                method = getattr(instance, spec.method_name)
+                args, kwargs = self._resolve_args(spec.args_blob)
+                self._store_returns(spec, method(*args, **kwargs))
+            else:
+                fn = self._load_function(spec.fn_id)
+                args, kwargs = self._resolve_args(spec.args_blob)
+                self._store_returns(spec, fn(*args, **kwargs))
+        except BaseException as e:  # noqa: BLE001 - report everything upstream
+            ok, error = False, repr(e)
+            tb = traceback.format_exc()
+            for oid in spec.return_ids:
+                if not store_error_best_effort(self.store, oid, e, tb):
+                    print(f"FATAL: could not record error for "
+                          f"{oid.hex()[:12]}", file=sys.stderr, flush=True)
+        finally:
+            self.ctx.current_task_id = None
+            self.ctx.current_actor_id = None
+        self.conn.send({"t": "done", "task_id": spec.task_id, "ok": ok,
+                        "error": error})
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--scheduler-socket", required=True)
+    p.add_argument("--store-socket", required=True)
+    p.add_argument("--shm-name", required=True)
+    p.add_argument("--store-capacity", type=int, required=True)
+    p.add_argument("--worker-id", required=True)
+    args = p.parse_args()
+    runtime = WorkerRuntime(args)
+    try:
+        runtime.run()
+    except KeyboardInterrupt:
+        pass
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,195 @@
+"""Public API: init/shutdown/remote/get/put/wait/kill/cancel/...
+
+Counterpart of the reference's top-level API surface
+(/root/reference/python/ray/_private/worker.py: init :1330, get/put/wait, and
+python/ray/__init__.py re-exports).
+"""
+
+from __future__ import annotations
+
+import atexit
+import inspect
+import time
+from typing import Optional, Sequence, Union
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.node import Node
+from ray_tpu._private.worker import WorkerContext, global_worker
+from ray_tpu.core.actor import ActorClass, ActorHandle
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.remote_function import RemoteFunction
+
+_global_node: Optional[Node] = None
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    resources: Optional[dict] = None,
+    object_store_memory: Optional[int] = None,
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    min_workers: int = 2,
+    max_workers: Optional[int] = None,
+    ignore_reinit_error: bool = False,
+) -> "Node":
+    """Start (or connect to) a cluster. Only local mode in this round."""
+    global _global_node
+    if worker_mod.is_initialized():
+        if ignore_reinit_error:
+            return _global_node
+        raise RuntimeError("ray_tpu.init() called twice; pass "
+                           "ignore_reinit_error=True to ignore")
+    if address is not None:
+        raise NotImplementedError(
+            "remote cluster addresses are not supported yet; multi-node "
+            "bootstrap lands with the distributed GCS")
+    res = dict(resources or {})
+    if num_cpus is not None:
+        res["CPU"] = float(num_cpus)
+    if num_tpus is not None:
+        res["TPU"] = float(num_tpus)
+    node = Node(
+        resources=res or None,
+        object_store_memory=object_store_memory,
+        min_workers=min_workers,
+        max_workers=max_workers,
+    )
+    _global_node = node
+
+    scheduler = node.scheduler
+
+    def driver_rpc(method: str, params: dict):
+        return scheduler._handle_rpc(method, params)
+
+    ctx = WorkerContext(
+        mode="driver",
+        store=node.new_store_client(),
+        submit_fn=scheduler.submit,
+        rpc_fn=driver_rpc,
+        node=node,
+    )
+    worker_mod.set_global_worker(ctx)
+    atexit.register(shutdown)
+    return node
+
+
+def shutdown():
+    global _global_node
+    if _global_node is not None:
+        node, _global_node = _global_node, None
+        worker_mod.set_global_worker(None)
+        node.shutdown()
+
+
+def is_initialized() -> bool:
+    return worker_mod.is_initialized()
+
+
+def remote(*args, **options):
+    """Decorator turning a function into a RemoteFunction or a class into an
+    ActorClass.  Usable bare (``@remote``) or with options
+    (``@remote(num_tpus=1)``)."""
+
+    def make(obj):
+        if inspect.isclass(obj):
+            return ActorClass(obj, options)
+        return RemoteFunction(obj, options)
+
+    if len(args) == 1 and not options and (
+        callable(args[0]) or inspect.isclass(args[0])
+    ):
+        return make(args[0])
+    if args:
+        raise TypeError("remote() takes keyword options only")
+    return make
+
+
+def get(
+    refs: Union[ObjectRef, Sequence[ObjectRef]],
+    *,
+    timeout: Optional[float] = None,
+):
+    worker = global_worker()
+    if isinstance(refs, ObjectRef):
+        return worker.get_object(refs, timeout=timeout)
+    if isinstance(refs, (list, tuple)):
+        # The timeout bounds the whole call, not each ref.
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for r in refs:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            out.append(worker.get_object(r, timeout=remaining))
+        return out
+    raise TypeError(f"get expects ObjectRef or list, got {type(refs)}")
+
+
+def put(value) -> ObjectRef:
+    return global_worker().put_object(value)
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+    fetch_local: bool = True,
+):
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait expects a list of ObjectRefs")
+    if num_returns > len(refs):
+        raise ValueError("num_returns exceeds the number of refs")
+    return global_worker().wait(refs, num_returns, timeout, fetch_local)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    if not isinstance(actor, ActorHandle):
+        raise TypeError("kill expects an ActorHandle")
+    global_worker().rpc("kill_actor", {"actor_id": actor.actor_id,
+                                       "no_restart": no_restart})
+
+
+def cancel(ref: ObjectRef, *, force: bool = False):
+    # A return object id is task_id (16B) + return index (4B).
+    task_id = ref.binary()[:16]
+    global_worker().rpc("cancel", {"task_id": task_id, "force": force})
+
+
+def get_actor(name: str) -> ActorHandle:
+    info = global_worker().rpc("get_actor_by_name", {"name": name})
+    if info is None:
+        raise ValueError(f"no live actor named {name!r}")
+    return ActorHandle(info["actor_id"], info["class_name"])
+
+
+def cluster_resources() -> dict:
+    return global_worker().rpc("cluster_state", {})["total_resources"]
+
+
+def available_resources() -> dict:
+    return global_worker().rpc("cluster_state", {})["available_resources"]
+
+
+class RuntimeContext:
+    def __init__(self, worker: WorkerContext):
+        self._worker = worker
+
+    @property
+    def was_current_actor_reconstructed(self) -> bool:
+        return False
+
+    def get_actor_id(self) -> Optional[str]:
+        aid = self._worker.current_actor_id
+        return aid.hex() if aid else None
+
+    def get_task_id(self) -> Optional[str]:
+        tid = self._worker.current_task_id
+        return tid.hex() if tid else None
+
+    def get_worker_id(self) -> str:
+        return self._worker.worker_id.hex()
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(global_worker())

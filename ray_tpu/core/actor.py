@@ -1,0 +1,122 @@
+"""Actor classes and handles: ``@ray_tpu.remote`` on a class.
+
+Counterpart of /root/reference/python/ray/actor.py (ActorClass/ActorHandle):
+``ActorClass.remote()`` submits an actor-creation task that dedicates a pooled
+worker process to the instance; ``handle.method.remote()`` submits ordered
+method-call tasks routed to that worker.  Handles are plain data (actor id)
+and can be pickled into tasks; named actors are resolved via the GCS.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import cloudpickle
+
+from ray_tpu._private import ids
+from ray_tpu._private.scheduler import ACTOR_CREATION, ACTOR_METHOD, TaskSpec
+from ray_tpu._private.worker import global_worker
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.remote_function import resolve_resources, strategy_fields
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str):
+        self._handle = handle
+        self._method_name = method_name
+
+    def remote(self, *args, **kwargs):
+        return self._handle._submit_method(
+            self._method_name, args, kwargs, num_returns=1)
+
+    def options(self, num_returns: int = 1, **_ignored):
+        handle, name = self._handle, self._method_name
+
+        class _Bound:
+            def remote(self, *args, **kwargs):
+                return handle._submit_method(name, args, kwargs,
+                                             num_returns=num_returns)
+
+        return _Bound()
+
+
+class ActorHandle:
+    def __init__(self, actor_id: bytes, class_name: str = ""):
+        self._actor_id = actor_id
+        self._class_name = class_name
+
+    @property
+    def actor_id(self) -> bytes:
+        return self._actor_id
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return ActorMethod(self, item)
+
+    def _submit_method(self, method_name, args, kwargs, num_returns=1):
+        worker = global_worker()
+        task_id = ids.new_task_id()
+        return_ids = [ids.object_id_for_return(task_id, i)
+                      for i in range(num_returns)]
+        spec = TaskSpec(
+            task_id=task_id,
+            kind=ACTOR_METHOD,
+            fn_id=b"",
+            args_blob=cloudpickle.dumps((list(args), dict(kwargs))),
+            return_ids=return_ids,
+            actor_id=self._actor_id,
+            method_name=method_name,
+            name=f"{self._class_name}.{method_name}",
+        )
+        worker.submit(spec)
+        refs = [ObjectRef(oid) for oid in return_ids]
+        return refs[0] if num_returns == 1 else refs
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._class_name))
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:8]})"
+
+
+class ActorClass:
+    def __init__(self, cls, options: Optional[dict] = None):
+        self._cls = cls
+        self._options = options or {}
+        self.__name__ = getattr(cls, "__name__", "Actor")
+
+    def options(self, **actor_options) -> "ActorClass":
+        merged = dict(self._options)
+        merged.update(actor_options)
+        return ActorClass(self._cls, merged)
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        worker = global_worker()
+        opts = self._options
+        fn_id = worker.register_function(self._cls)
+        actor_id = ids.new_actor_id()
+        task_id = ids.new_task_id()
+        spec = TaskSpec(
+            task_id=task_id,
+            kind=ACTOR_CREATION,
+            fn_id=fn_id,
+            args_blob=cloudpickle.dumps((list(args), dict(kwargs))),
+            return_ids=[ids.object_id_for_return(task_id, 0)],
+            resources=resolve_resources(opts, default_num_cpus=0),
+            actor_id=actor_id,
+            name=self.__name__,
+            max_restarts=opts.get("max_restarts", 0),
+            max_concurrency=opts.get("max_concurrency", 1),
+            actor_name=opts.get("name"),
+            runtime_env=opts.get("runtime_env"),
+            **strategy_fields(opts),
+        )
+        worker.submit(spec)
+        return ActorHandle(actor_id, self.__name__)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self.__name__!r} cannot be instantiated directly; "
+            f"use {self.__name__}.remote()."
+        )

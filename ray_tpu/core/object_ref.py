@@ -1,0 +1,35 @@
+"""ObjectRef: a handle to an object in the distributed store.
+
+Counterpart of the reference's ObjectRef
+(/root/reference/python/ray/includes/object_ref.pxi): a 20-byte ID whose
+payload lives in the shared-memory store (or will, once its producing task
+finishes).  Pickling an ObjectRef transfers the ID only; the receiving process
+resolves it against its own store client.
+"""
+
+from __future__ import annotations
+
+
+class ObjectRef:
+    __slots__ = ("_id",)
+
+    def __init__(self, id_bytes: bytes):
+        self._id = id_bytes
+
+    def binary(self) -> bytes:
+        return self._id
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def __reduce__(self):
+        return (ObjectRef, (self._id,))
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()[:16]})"

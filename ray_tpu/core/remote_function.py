@@ -1,0 +1,92 @@
+"""Remote functions: ``@ray_tpu.remote`` on a function.
+
+Counterpart of /root/reference/python/ray/remote_function.py: holds task
+options (resources, num_returns, retries, scheduling strategy), registers the
+pickled function in the store-backed function registry once per session, and
+builds TaskSpecs for submission.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import cloudpickle
+
+from ray_tpu._private import ids
+from ray_tpu._private.scheduler import TASK, TaskSpec
+from ray_tpu._private.worker import global_worker
+from ray_tpu.core.object_ref import ObjectRef
+
+def resolve_resources(options: dict, default_num_cpus: float = 1) -> dict:
+    res = dict(options.get("resources") or {})
+    num_cpus = options.get("num_cpus")
+    num_tpus = options.get("num_tpus")
+    if num_cpus is None:
+        # Tasks default to 1 CPU; actors to 0 (they hold resources for their
+        # whole lifetime, so a nonzero default would starve the pool —
+        # matching the reference's actor defaults).
+        num_cpus = default_num_cpus
+    if num_cpus:
+        res["CPU"] = float(num_cpus)
+    if num_tpus:
+        res["TPU"] = float(num_tpus)
+    if options.get("memory"):
+        res["memory"] = float(options["memory"])
+    return res
+
+
+def strategy_fields(options: dict) -> dict:
+    """Extract pg routing from a scheduling_strategy option."""
+    strategy = options.get("scheduling_strategy")
+    pg = options.get("placement_group")
+    bundle = options.get("placement_group_bundle_index")
+    if strategy is not None and hasattr(strategy, "placement_group"):
+        pg = strategy.placement_group
+        bundle = strategy.placement_group_bundle_index
+    if pg is None:
+        return {}
+    return {"pg_id": pg.id, "pg_bundle": 0 if bundle in (None, -1) else bundle}
+
+
+class RemoteFunction:
+    def __init__(self, function, options: Optional[dict] = None):
+        self._function = function
+        self._options = options or {}
+        self.__name__ = getattr(function, "__name__", "remote_fn")
+
+    def options(self, **task_options) -> "RemoteFunction":
+        merged = dict(self._options)
+        merged.update(task_options)
+        return RemoteFunction(self._function, merged)
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, self._options)
+
+    def _remote(self, args, kwargs, options: dict):
+        worker = global_worker()
+        fn_id = worker.register_function(self._function)
+        task_id = ids.new_task_id()
+        num_returns = options.get("num_returns", 1)
+        return_ids = [ids.object_id_for_return(task_id, i)
+                      for i in range(num_returns)]
+        spec = TaskSpec(
+            task_id=task_id,
+            kind=TASK,
+            fn_id=fn_id,
+            args_blob=cloudpickle.dumps((list(args), dict(kwargs))),
+            return_ids=return_ids,
+            resources=resolve_resources(options),
+            name=options.get("name") or self.__name__,
+            max_retries=options.get("max_retries", 3),
+            runtime_env=options.get("runtime_env"),
+            **strategy_fields(options),
+        )
+        worker.submit(spec)
+        refs = [ObjectRef(oid) for oid in return_ids]
+        return refs[0] if num_returns == 1 else refs
+
+    def __call__(self, *args: Any, **kwargs: Any):
+        raise TypeError(
+            f"Remote function {self.__name__!r} cannot be called directly; "
+            f"use {self.__name__}.remote()."
+        )

@@ -1,0 +1,198 @@
+"""Client for the native shared-memory object store.
+
+Counterpart of the reference's plasma client
+(/root/reference/src/ray/object_manager/plasma/client.cc) re-designed for the
+TPU build: the client mmaps the store's named POSIX shm segment directly, so
+sealed objects are readable zero-copy as memoryviews / numpy arrays that can
+feed ``jax.device_put`` without an intermediate host copy.  Control traffic is
+a fixed 37-byte request / 17-byte response frame over a unix socket (see
+shm_store.cc for the protocol).
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import socket
+import struct
+import subprocess
+import threading
+import time
+
+from ray_tpu.native.build import binary_path
+
+ID_LEN = 20
+_REQ = struct.Struct("<B20sQQ")
+_RESP = struct.Struct("<BQQ")
+
+ST_OK = 0
+ST_NOT_FOUND = 1
+ST_EXISTS = 2
+ST_OOM = 3
+ST_TIMEOUT = 4
+ST_NOT_SEALED = 5
+ST_ERR = 6
+
+_OP_CREATE, _OP_SEAL, _OP_GET, _OP_RELEASE = 1, 2, 3, 4
+_OP_DELETE, _OP_CONTAINS, _OP_STATS, _OP_ABORT = 5, 6, 7, 8
+
+
+class StoreFullError(Exception):
+    pass
+
+
+class ObjectNotFoundError(Exception):
+    pass
+
+
+class StoreServer:
+    """Owns the store daemon process for a node."""
+
+    def __init__(self, socket_path: str, shm_name: str, capacity: int):
+        self.socket_path = socket_path
+        self.shm_name = shm_name
+        self.capacity = capacity
+        self._proc = subprocess.Popen(
+            [binary_path("shm_store"), socket_path, shm_name, str(capacity)],
+            stdout=subprocess.PIPE,
+        )
+        line = self._proc.stdout.readline()
+        if b"READY" not in line:
+            raise RuntimeError(f"shm_store failed to start: {line!r}")
+
+    def shutdown(self):
+        if self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+        shm_file = f"/dev/shm/{self.shm_name.lstrip('/')}"
+        try:
+            os.unlink(shm_file)
+        except OSError:
+            pass
+
+
+class StoreClient:
+    """Thread-safe client: a pool of sockets + one shm mapping.
+
+    A pool (rather than one mutex-guarded socket) is required because GET can
+    block server-side until an object is sealed; a concurrent PUT from
+    another thread of the same client must not queue behind it — that would
+    deadlock producer/consumer threads sharing a client.
+    """
+
+    def __init__(self, socket_path: str, shm_name: str, capacity: int):
+        self._socket_path = socket_path
+        self._client_id = os.urandom(ID_LEN)  # server-side ref bookkeeping key
+        self._pool_lock = threading.Lock()
+        self._pool: list[socket.socket] = [self._dial(timeout=10)]
+        shm_file = f"/dev/shm/{shm_name.lstrip('/')}"
+        fd = os.open(shm_file, os.O_RDWR)
+        try:
+            self._mm = mmap.mmap(fd, capacity)
+        finally:
+            os.close(fd)
+
+    def _dial(self, timeout: float = 2.0) -> socket.socket:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                sock.connect(self._socket_path)
+                sock.sendall(self._client_id)  # handshake
+                return sock
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
+
+    def _call(self, op: int, oid: bytes, arg0: int = 0, arg1: int = 0):
+        req = _REQ.pack(op, oid, arg0, arg1)
+        with self._pool_lock:
+            sock = self._pool.pop() if self._pool else None
+        if sock is None:
+            sock = self._dial()
+        try:
+            sock.sendall(req)
+            buf = b""
+            while len(buf) < _RESP.size:
+                chunk = sock.recv(_RESP.size - len(buf))
+                if not chunk:
+                    raise ConnectionError("object store connection closed")
+                buf += chunk
+        except BaseException:
+            sock.close()
+            raise
+        with self._pool_lock:
+            if len(self._pool) < 8:
+                self._pool.append(sock)
+            else:
+                sock.close()
+        return _RESP.unpack(buf)
+
+    def create(self, oid: bytes, size: int) -> memoryview:
+        """Allocate space; returns a writable view. Must seal() after writing."""
+        status, offset, _ = self._call(_OP_CREATE, oid, size)
+        if status == ST_OOM:
+            raise StoreFullError(f"object store full allocating {size} bytes")
+        if status == ST_EXISTS:
+            raise FileExistsError(f"object {oid.hex()} already exists")
+        if status != ST_OK:
+            raise RuntimeError(f"create failed: status={status}")
+        return memoryview(self._mm)[offset : offset + size]
+
+    def seal(self, oid: bytes):
+        status, _, _ = self._call(_OP_SEAL, oid)
+        if status != ST_OK:
+            raise RuntimeError(f"seal failed: status={status}")
+
+    def put(self, oid: bytes, data) -> None:
+        buf = self.create(oid, len(data))
+        buf[:] = data
+        self.seal(oid)
+
+    def get(self, oid: bytes, timeout_ms: int = 0):
+        """Return a zero-copy memoryview of a sealed object, or None.
+
+        With timeout_ms == 0 this is a non-blocking probe; otherwise blocks in
+        the store until the object is sealed or the timeout elapses.  The view
+        pins the object (refcount) until ``release``.
+        """
+        status, offset, size = self._call(_OP_GET, oid, timeout_ms)
+        if status in (ST_NOT_FOUND, ST_NOT_SEALED, ST_TIMEOUT):
+            return None
+        if status != ST_OK:
+            raise RuntimeError(f"get failed: status={status}")
+        return memoryview(self._mm)[offset : offset + size]
+
+    def release(self, oid: bytes):
+        self._call(_OP_RELEASE, oid)
+
+    def delete(self, oid: bytes):
+        self._call(_OP_DELETE, oid)
+
+    def abort(self, oid: bytes):
+        self._call(_OP_ABORT, oid)
+
+    def contains(self, oid: bytes) -> bool:
+        status, sealed, _ = self._call(_OP_CONTAINS, oid)
+        return status == ST_OK and sealed == 1
+
+    def stats(self) -> dict:
+        _, used, num_objects = self._call(_OP_STATS, b"\x00" * ID_LEN)
+        return {"used_bytes": used, "num_objects": num_objects}
+
+    def close(self):
+        with self._pool_lock:
+            socks, self._pool = self._pool, []
+        for sock in socks:
+            try:
+                sock.close()
+            except OSError:
+                pass

@@ -1,0 +1,51 @@
+"""User-facing exceptions (counterpart of /root/reference/python/ray/exceptions.py)."""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A remote task raised; re-raised at ``get`` with the remote traceback."""
+
+    def __init__(self, cause: BaseException, remote_traceback: str = ""):
+        self.cause = cause
+        self.remote_traceback = remote_traceback
+        super().__init__(
+            f"{type(cause).__name__}: {cause}\n"
+            f"--- remote traceback ---\n{remote_traceback}"
+        )
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker executing the task died unexpectedly."""
+
+
+class ActorDiedError(RayTpuError):
+    """The actor owning this method call has died."""
+
+
+class ActorUnavailableError(RayTpuError):
+    """The actor exists but cannot currently serve calls."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """``get`` exceeded its timeout."""
+
+
+class ObjectLostError(RayTpuError):
+    """Object is no longer available (evicted and not reconstructable)."""
+
+
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled before/while running."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Runtime environment could not be set up for the task/actor."""
+
+
+class PlacementGroupUnavailableError(RayTpuError):
+    """Placement group resources could not be reserved."""

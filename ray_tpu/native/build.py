@@ -1,0 +1,49 @@
+"""Build helpers for ray_tpu native (C++) components.
+
+Compiles the shared-memory object store daemon (``shm_store.cc``) and other
+native binaries on first use, caching the result under
+``ray_tpu/native/_build/``.  The cache key is a hash of the source file so
+edits trigger a rebuild.  g++ is part of the baked toolchain; there is no
+runtime dependency beyond libc/pthread/rt.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+
+_NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_NATIVE_DIR, "_build")
+
+_BINARIES = {
+    "shm_store": {
+        "sources": ["shm_store.cc"],
+        "flags": ["-O2", "-std=c++17", "-pthread"],
+        "libs": ["-lrt"],
+    },
+}
+
+
+def _source_hash(sources: list[str]) -> str:
+    h = hashlib.sha256()
+    for src in sources:
+        with open(os.path.join(_NATIVE_DIR, src), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def binary_path(name: str) -> str:
+    """Return the path to a built native binary, compiling it if needed."""
+    spec = _BINARIES[name]
+    tag = _source_hash(spec["sources"])
+    out = os.path.join(_BUILD_DIR, f"{name}-{tag}")
+    if os.path.exists(out):
+        return out
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    srcs = [os.path.join(_NATIVE_DIR, s) for s in spec["sources"]]
+    tmp = out + f".tmp.{os.getpid()}"
+    cmd = ["g++", *spec["flags"], *srcs, "-o", tmp, *spec["libs"]]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, out)  # atomic: concurrent builders race benignly
+    return out

@@ -1,0 +1,439 @@
+// ray_tpu native shared-memory object store ("plasma equivalent").
+//
+// TPU-native re-design of the reference's plasma store
+// (/root/reference/src/ray/object_manager/plasma/store.cc): a per-node daemon
+// that owns one large POSIX shared-memory segment, hands out offsets to
+// clients (which mmap the same segment for zero-copy reads/writes), tracks
+// object lifecycle (CREATED -> SEALED -> released/evicted) and performs LRU
+// eviction of unreferenced sealed objects under memory pressure.  Unlike the
+// reference we do not use fd-passing + flatbuffers; clients address the
+// segment by name (`/dev/shm/<name>`) and the wire protocol is fixed-size
+// binary frames over a unix domain socket, which keeps the client mappable
+// from Python via mmap + struct with no codegen.
+//
+// The host segment doubles as the staging tier for TPU HBM transfers: numpy
+// views of sealed objects feed jax.device_put without an intermediate copy.
+//
+// Usage: shm_store <socket_path> <shm_name> <capacity_bytes>
+//
+// Wire protocol (all little-endian):
+//   request:  u8 op | u8[20] object_id | u64 arg0 | u64 arg1
+//   response: u8 status | u64 offset | u64 size
+// Ops: 1=CREATE(size,timeout) 2=SEAL 3=GET(timeout_ms) 4=RELEASE 5=DELETE
+//      6=CONTAINS 7=STATS 8=ABORT
+// Status: 0=OK 1=NOT_FOUND 2=EXISTS 3=OOM 4=TIMEOUT 5=NOT_SEALED 6=ERR
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cstdlib>
+#include <string>
+#include <map>
+#include <unordered_map>
+#include <list>
+#include <mutex>
+#include <condition_variable>
+#include <thread>
+#include <vector>
+#include <array>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <unistd.h>
+#include <signal.h>
+
+namespace {
+
+constexpr uint8_t OP_CREATE = 1, OP_SEAL = 2, OP_GET = 3, OP_RELEASE = 4,
+                  OP_DELETE = 5, OP_CONTAINS = 6, OP_STATS = 7, OP_ABORT = 8;
+constexpr uint8_t ST_OK = 0, ST_NOT_FOUND = 1, ST_EXISTS = 2, ST_OOM = 3,
+                  ST_TIMEOUT = 4, ST_NOT_SEALED = 5, ST_ERR = 6;
+
+constexpr size_t kIdLen = 20;
+constexpr size_t kReqLen = 1 + kIdLen + 8 + 8;
+constexpr size_t kRespLen = 1 + 8 + 8;
+constexpr uint64_t kAlign = 64;  // cache-line align allocations
+
+using ObjectId = std::array<uint8_t, kIdLen>;
+
+struct IdHash {
+  size_t operator()(const ObjectId& id) const {
+    size_t h;
+    memcpy(&h, id.data(), sizeof(h));
+    return h;
+  }
+};
+
+struct ObjectEntry {
+  uint64_t offset = 0;
+  uint64_t size = 0;
+  bool sealed = false;
+  int refcount = 0;  // pinned while > 0 (creator or active getters)
+  std::list<ObjectId>::iterator lru_it;
+  bool in_lru = false;
+};
+
+// First-fit free-list allocator over [0, capacity). Offsets are segment-
+// relative; the table lives host-side (not in the segment), so a crashed
+// client cannot corrupt allocator metadata.
+class FreeListAllocator {
+ public:
+  explicit FreeListAllocator(uint64_t capacity) : capacity_(capacity) {
+    free_[0] = capacity;
+  }
+  bool Alloc(uint64_t size, uint64_t* out) {
+    size = (size + kAlign - 1) / kAlign * kAlign;
+    if (size == 0) size = kAlign;
+    for (auto it = free_.begin(); it != free_.end(); ++it) {
+      if (it->second >= size) {
+        *out = it->first;
+        uint64_t rem = it->second - size;
+        uint64_t new_off = it->first + size;
+        free_.erase(it);
+        if (rem > 0) free_[new_off] = rem;
+        used_ += size;
+        sizes_[*out] = size;
+        return true;
+      }
+    }
+    return false;
+  }
+  void Free(uint64_t off) {
+    auto sit = sizes_.find(off);
+    if (sit == sizes_.end()) return;
+    uint64_t size = sit->second;
+    sizes_.erase(sit);
+    used_ -= size;
+    auto it = free_.emplace(off, size).first;
+    // merge with next
+    auto next = std::next(it);
+    if (next != free_.end() && it->first + it->second == next->first) {
+      it->second += next->second;
+      free_.erase(next);
+    }
+    // merge with prev
+    if (it != free_.begin()) {
+      auto prev = std::prev(it);
+      if (prev->first + prev->second == it->first) {
+        prev->second += it->second;
+        free_.erase(it);
+      }
+    }
+  }
+  uint64_t used() const { return used_; }
+  uint64_t capacity() const { return capacity_; }
+
+ private:
+  uint64_t capacity_;
+  uint64_t used_ = 0;
+  std::map<uint64_t, uint64_t> free_;           // offset -> size
+  std::unordered_map<uint64_t, uint64_t> sizes_;  // offset -> alloc size
+};
+
+class Store {
+ public:
+  Store(uint64_t capacity) : alloc_(capacity) {}
+
+  uint8_t Create(const ObjectId& id, uint64_t size, uint64_t* offset) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (objects_.count(id)) return ST_EXISTS;
+    uint64_t off;
+    while (!alloc_.Alloc(size, &off)) {
+      if (!EvictOneLocked()) return ST_OOM;
+    }
+    ObjectEntry e;
+    e.offset = off;
+    e.size = size;
+    e.refcount = 1;  // creator holds a ref until seal
+    objects_[id] = e;
+    *offset = off;
+    return ST_OK;
+  }
+
+  uint8_t Seal(const ObjectId& id) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return ST_NOT_FOUND;
+    it->second.sealed = true;
+    DecrefLocked(it->second, id);
+    cv_.notify_all();
+    return ST_OK;
+  }
+
+  uint8_t Get(const ObjectId& id, uint64_t timeout_ms, uint64_t* offset,
+              uint64_t* size) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    for (;;) {
+      auto it = objects_.find(id);
+      if (it != objects_.end() && it->second.sealed) {
+        it->second.refcount++;
+        if (it->second.in_lru) {
+          lru_.erase(it->second.lru_it);
+          it->second.in_lru = false;
+        }
+        *offset = it->second.offset;
+        *size = it->second.size;
+        return ST_OK;
+      }
+      if (timeout_ms == 0) return it == objects_.end() ? ST_NOT_FOUND
+                                                       : ST_NOT_SEALED;
+      if (cv_.wait_until(lk, deadline) == std::cv_status::timeout)
+        return ST_TIMEOUT;
+    }
+  }
+
+  uint8_t Release(const ObjectId& id) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return ST_NOT_FOUND;
+    DecrefLocked(it->second, id);
+    return ST_OK;
+  }
+
+  uint8_t Delete(const ObjectId& id) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return ST_NOT_FOUND;
+    if (it->second.in_lru) lru_.erase(it->second.lru_it);
+    alloc_.Free(it->second.offset);
+    objects_.erase(it);
+    cv_.notify_all();
+    return ST_OK;
+  }
+
+  // Abort an unsealed create (client died or errored mid-write).
+  uint8_t Abort(const ObjectId& id) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return ST_NOT_FOUND;
+    if (it->second.sealed) return ST_ERR;
+    alloc_.Free(it->second.offset);
+    objects_.erase(it);
+    return ST_OK;
+  }
+
+  uint8_t Contains(const ObjectId& id, uint64_t* sealed, uint64_t* size) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return ST_NOT_FOUND;
+    *sealed = it->second.sealed ? 1 : 0;
+    *size = it->second.size;
+    return ST_OK;
+  }
+
+  void Stats(uint64_t* used, uint64_t* num_objects) {
+    std::unique_lock<std::mutex> lk(mu_);
+    *used = alloc_.used();
+    *num_objects = objects_.size();
+  }
+
+ private:
+  void DecrefLocked(ObjectEntry& e, const ObjectId& id) {
+    if (e.refcount > 0) e.refcount--;
+    if (e.refcount == 0 && e.sealed && !e.in_lru) {
+      lru_.push_back(id);
+      e.lru_it = std::prev(lru_.end());
+      e.in_lru = true;
+    }
+  }
+
+  bool EvictOneLocked() {
+    if (lru_.empty()) return false;
+    ObjectId victim = lru_.front();
+    lru_.pop_front();
+    auto it = objects_.find(victim);
+    if (it != objects_.end()) {
+      alloc_.Free(it->second.offset);
+      objects_.erase(it);
+    }
+    return true;
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  FreeListAllocator alloc_;
+  std::unordered_map<ObjectId, ObjectEntry, IdHash> objects_;
+  std::list<ObjectId> lru_;  // sealed, refcount==0, eviction candidates
+};
+
+bool ReadFull(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool WriteFull(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// Per-client (not per-connection) ref bookkeeping: a client process may pool
+// several sockets, so a GET on one connection can be RELEASEd on another.
+// Pins are reclaimed when the client's last connection closes.
+struct ClientState {
+  int conns = 0;
+  std::unordered_map<ObjectId, int, IdHash> held;
+  std::unordered_map<ObjectId, bool, IdHash> creating;  // unsealed creates
+};
+
+std::mutex g_clients_mu;
+std::unordered_map<ObjectId, ClientState, IdHash> g_clients;
+
+void ServeClient(Store* store, int fd) {
+  uint8_t req[kReqLen];
+  // Handshake: first 20 bytes are the client id.
+  ObjectId client_id;
+  if (!ReadFull(fd, client_id.data(), kIdLen)) {
+    close(fd);
+    return;
+  }
+  {
+    std::lock_guard<std::mutex> lk(g_clients_mu);
+    g_clients[client_id].conns++;
+  }
+  while (ReadFull(fd, req, kReqLen)) {
+    uint8_t op = req[0];
+    ObjectId id;
+    memcpy(id.data(), req + 1, kIdLen);
+    uint64_t arg0, arg1;
+    memcpy(&arg0, req + 1 + kIdLen, 8);
+    memcpy(&arg1, req + 1 + kIdLen + 8, 8);
+
+    uint8_t status = ST_ERR;
+    uint64_t r0 = 0, r1 = 0;
+    switch (op) {
+      case OP_CREATE:
+        status = store->Create(id, arg0, &r0);
+        if (status == ST_OK) {
+          std::lock_guard<std::mutex> lk(g_clients_mu);
+          g_clients[client_id].creating[id] = true;
+        }
+        r1 = arg0;
+        break;
+      case OP_SEAL:
+        status = store->Seal(id);
+        if (status == ST_OK) {
+          std::lock_guard<std::mutex> lk(g_clients_mu);
+          g_clients[client_id].creating.erase(id);
+        }
+        break;
+      case OP_GET:
+        status = store->Get(id, arg0, &r0, &r1);
+        if (status == ST_OK) {
+          std::lock_guard<std::mutex> lk(g_clients_mu);
+          g_clients[client_id].held[id]++;
+        }
+        break;
+      case OP_RELEASE:
+        status = store->Release(id);
+        if (status == ST_OK) {
+          std::lock_guard<std::mutex> lk(g_clients_mu);
+          auto& held = g_clients[client_id].held;
+          auto it = held.find(id);
+          if (it != held.end() && --it->second <= 0) held.erase(it);
+        }
+        break;
+      case OP_DELETE:
+        status = store->Delete(id);
+        break;
+      case OP_CONTAINS:
+        status = store->Contains(id, &r0, &r1);
+        break;
+      case OP_STATS:
+        store->Stats(&r0, &r1);
+        status = ST_OK;
+        break;
+      case OP_ABORT:
+        status = store->Abort(id);
+        break;
+      default:
+        status = ST_ERR;
+    }
+    uint8_t resp[kRespLen];
+    resp[0] = status;
+    memcpy(resp + 1, &r0, 8);
+    memcpy(resp + 1 + 8, &r1, 8);
+    if (!WriteFull(fd, resp, kRespLen)) break;
+  }
+  // Connection closed: if this was the client's last connection, release its
+  // leaked pins and abort half-written creates.
+  {
+    std::unique_lock<std::mutex> lk(g_clients_mu);
+    auto it = g_clients.find(client_id);
+    if (it != g_clients.end() && --it->second.conns == 0) {
+      ClientState state = std::move(it->second);
+      g_clients.erase(it);
+      lk.unlock();
+      for (auto& kv : state.held)
+        for (int i = 0; i < kv.second; i++) store->Release(kv.first);
+      for (auto& kv : state.creating) store->Abort(kv.first);
+    }
+  }
+  close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 4) {
+    fprintf(stderr, "usage: %s <socket_path> <shm_name> <capacity_bytes>\n",
+            argv[0]);
+    return 2;
+  }
+  signal(SIGPIPE, SIG_IGN);
+  const char* sock_path = argv[1];
+  const char* shm_name = argv[2];
+  uint64_t capacity = strtoull(argv[3], nullptr, 10);
+
+  // Create + size the shared memory segment.
+  shm_unlink(shm_name);
+  int shm_fd = shm_open(shm_name, O_CREAT | O_RDWR | O_EXCL, 0600);
+  if (shm_fd < 0) {
+    perror("shm_open");
+    return 1;
+  }
+  if (ftruncate(shm_fd, static_cast<off_t>(capacity)) != 0) {
+    perror("ftruncate");
+    return 1;
+  }
+  close(shm_fd);  // clients map by name; server needs no mapping
+
+  Store store(capacity);
+
+  unlink(sock_path);
+  int srv = socket(AF_UNIX, SOCK_STREAM, 0);
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  strncpy(addr.sun_path, sock_path, sizeof(addr.sun_path) - 1);
+  if (bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  listen(srv, 128);
+  // Signal readiness on stdout for the parent bootstrap.
+  printf("READY\n");
+  fflush(stdout);
+
+  for (;;) {
+    int fd = accept(srv, nullptr, nullptr);
+    if (fd < 0) continue;
+    std::thread(ServeClient, &store, fd).detach();
+  }
+  return 0;
+}

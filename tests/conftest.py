@@ -1,0 +1,41 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU platform (the reference's analogue is
+its fake multi-node Cluster fixture, SURVEY.md §4) so mesh/sharding paths are
+exercised without TPU hardware.  Must run before any jax backend
+initialization — the axon sitecustomize imports jax at interpreter start, but
+backends initialize lazily, so setting env here is still effective.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def ray_cluster():
+    import ray_tpu
+
+    node = ray_tpu.init(
+        min_workers=2,
+        max_workers=8,
+        object_store_memory=1 << 28,
+        resources={"CPU": 4.0},  # virtualized: the CI host has 1 real core
+    )
+    yield node
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def shutdown_only():
+    import ray_tpu
+
+    yield
+    ray_tpu.shutdown()

@@ -1,0 +1,213 @@
+"""Core API tests: tasks, objects, actors, errors.
+
+Models the reference's python/ray/tests/ core suite (test_basic*.py,
+test_actor*.py) at single-node scope.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import TaskError
+
+
+@ray_tpu.remote
+def echo(x):
+    return x
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.value = start
+
+    def increment(self, by=1):
+        self.value += by
+        return self.value
+
+    def get(self):
+        return self.value
+
+
+def test_simple_task(ray_cluster):
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_kwargs(ray_cluster):
+    assert ray_tpu.get(add.remote(a=10, b=20)) == 30
+
+
+def test_put_get(ray_cluster):
+    ref = ray_tpu.put({"k": [1, 2, 3]})
+    assert ray_tpu.get(ref) == {"k": [1, 2, 3]}
+
+
+def test_numpy_zero_copy_roundtrip(ray_cluster):
+    x = np.arange(1000, dtype=np.float32).reshape(10, 100)
+    ref = ray_tpu.put(x)
+    y = ray_tpu.get(ref)
+    np.testing.assert_array_equal(x, y)
+    assert not y.flags.writeable  # zero-copy view of shared memory
+
+
+def test_object_ref_as_arg_is_resolved(ray_cluster):
+    ref = ray_tpu.put(21)
+    assert ray_tpu.get(echo.remote(ref)) == 21
+
+
+def test_nested_ref_passes_through(ray_cluster):
+    ref = ray_tpu.put(5)
+    out = ray_tpu.get(echo.remote([ref]))
+    assert isinstance(out[0], ray_tpu.ObjectRef)
+    assert ray_tpu.get(out[0]) == 5
+
+
+def test_nested_tasks(ray_cluster):
+    @ray_tpu.remote
+    def fanout(n):
+        return sum(ray_tpu.get([add.remote(i, i) for i in range(n)]))
+
+    assert ray_tpu.get(fanout.remote(4)) == 12
+
+
+def test_task_chain_dependencies(ray_cluster):
+    ref = echo.remote(1)
+    for _ in range(5):
+        ref = add.remote(ref, 1)
+    assert ray_tpu.get(ref) == 6
+
+
+def test_num_returns(ray_cluster):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return "a", "b", "c"
+
+    r1, r2, r3 = three.remote()
+    assert ray_tpu.get([r1, r2, r3]) == ["a", "b", "c"]
+
+
+def test_error_propagation(ray_cluster):
+    @ray_tpu.remote
+    def fail():
+        raise ValueError("intended")
+
+    with pytest.raises(TaskError):
+        ray_tpu.get(fail.remote())
+    # dual-type: catchable as the original exception type too
+    with pytest.raises(ValueError):
+        ray_tpu.get(fail.remote())
+
+
+def test_error_through_dependency(ray_cluster):
+    @ray_tpu.remote
+    def fail():
+        raise RuntimeError("first")
+
+    with pytest.raises(TaskError):
+        ray_tpu.get(echo.remote(fail.remote()))
+
+
+def test_wait(ray_cluster):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return "slow"
+
+    fast_ref = echo.remote("fast")
+    slow_ref = slow.remote()
+    ready, not_ready = ray_tpu.wait(
+        [slow_ref, fast_ref], num_returns=1, timeout=3
+    )
+    assert fast_ref in ready
+    assert slow_ref in not_ready
+    ray_tpu.cancel(slow_ref)
+
+
+def test_get_timeout(ray_cluster):
+    @ray_tpu.remote
+    def hang():
+        time.sleep(30)
+
+    ref = hang.remote()
+    with pytest.raises(ray_tpu.exceptions.GetTimeoutError):
+        ray_tpu.get(ref, timeout=0.3)
+    ray_tpu.cancel(ref, force=True)
+
+
+def test_actor_basic(ray_cluster):
+    c = Counter.remote(100)
+    assert ray_tpu.get(c.increment.remote()) == 101
+    assert ray_tpu.get(c.increment.remote(by=9)) == 110
+    assert ray_tpu.get(c.get.remote()) == 110
+
+
+def test_actor_method_ordering(ray_cluster):
+    c = Counter.remote()
+    refs = [c.increment.remote() for _ in range(20)]
+    assert ray_tpu.get(refs) == list(range(1, 21))
+
+
+def test_actor_state_isolated(ray_cluster):
+    a, b = Counter.remote(), Counter.remote()
+    ray_tpu.get(a.increment.remote())
+    assert ray_tpu.get(b.get.remote()) == 0
+
+
+def test_actor_handle_passed_to_task(ray_cluster):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def bump(counter):
+        return ray_tpu.get(counter.increment.remote())
+
+    assert ray_tpu.get(bump.remote(c)) == 1
+
+
+def test_named_actor(ray_cluster):
+    Counter.options(name="test_named_counter").remote(7)
+    h = ray_tpu.get_actor("test_named_counter")
+    assert ray_tpu.get(h.get.remote()) == 7
+
+
+def test_get_actor_missing(ray_cluster):
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("no_such_actor")
+
+
+def test_actor_error_propagation(ray_cluster):
+    @ray_tpu.remote
+    class Bad:
+        def boom(self):
+            raise KeyError("nope")
+
+    b = Bad.remote()
+    with pytest.raises(TaskError):
+        ray_tpu.get(b.boom.remote())
+
+
+def test_large_object(ray_cluster):
+    x = np.zeros((4 << 20,), dtype=np.uint8)  # 4 MiB
+    ref = echo.remote(ray_tpu.put(x))
+    assert ray_tpu.get(ref).nbytes == x.nbytes
+
+
+def test_cluster_resources(ray_cluster):
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU", 0) >= 1
+
+
+def test_runtime_context_in_task(ray_cluster):
+    @ray_tpu.remote
+    def whoami():
+        ctx = ray_tpu.get_runtime_context()
+        return ctx.get_task_id(), ctx.get_worker_id()
+
+    task_id, worker_id = ray_tpu.get(whoami.remote())
+    assert task_id and worker_id

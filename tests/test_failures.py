@@ -1,0 +1,108 @@
+"""Fault-tolerance tests: worker crashes, task retries, actor restarts.
+
+Models the reference's python/ray/tests/test_actor_failures.py and
+test_failure*.py at single-node scope.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import ActorDiedError, WorkerCrashedError
+
+
+def _wait_for(predicate, timeout=10):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def test_task_retry_on_worker_crash(ray_cluster):
+    marker = f"/tmp/ray_tpu_retry_{os.getpid()}"
+    if os.path.exists(marker):
+        os.unlink(marker)
+
+    @ray_tpu.remote(max_retries=2)
+    def die_once(path):
+        if not os.path.exists(path):
+            open(path, "w").close()
+            os._exit(1)
+        return "survived"
+
+    assert ray_tpu.get(die_once.remote(marker), timeout=60) == "survived"
+    os.unlink(marker)
+
+
+def test_task_no_retry_fails(ray_cluster):
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        os._exit(1)
+
+    with pytest.raises(WorkerCrashedError):
+        ray_tpu.get(die.remote(), timeout=60)
+
+
+def test_actor_restart(ray_cluster):
+    @ray_tpu.remote(max_restarts=1)
+    class Phoenix:
+        def __init__(self):
+            self.calls = 0
+
+        def call(self):
+            self.calls += 1
+            return self.calls
+
+        def die(self):
+            os._exit(1)
+
+    p = Phoenix.remote()
+    assert ray_tpu.get(p.call.remote(), timeout=60) == 1
+    p.die.remote()
+    # After restart, state is rebuilt from __init__.
+    deadline = time.monotonic() + 60
+    while True:
+        try:
+            assert ray_tpu.get(p.call.remote(), timeout=60) == 1
+            break
+        except ActorDiedError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.2)
+
+
+def test_actor_dead_after_max_restarts(ray_cluster):
+    @ray_tpu.remote(max_restarts=0)
+    class Mortal:
+        def die(self):
+            os._exit(1)
+
+        def ping(self):
+            return "pong"
+
+    m = Mortal.remote()
+    assert ray_tpu.get(m.ping.remote(), timeout=60) == "pong"
+    m.die.remote()
+    with pytest.raises(ActorDiedError):
+        for _ in range(50):
+            ray_tpu.get(m.ping.remote(), timeout=60)
+            time.sleep(0.1)
+
+
+def test_kill_actor(ray_cluster):
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    v = Victim.remote()
+    assert ray_tpu.get(v.ping.remote(), timeout=60) == "pong"
+    ray_tpu.kill(v)
+    with pytest.raises(ActorDiedError):
+        for _ in range(50):
+            ray_tpu.get(v.ping.remote(), timeout=60)
+            time.sleep(0.1)

@@ -1,0 +1,64 @@
+"""Placement group tests (reference: python/ray/tests/test_placement_group*.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import PlacementGroupUnavailableError
+from ray_tpu.util.placement_group import (
+    placement_group,
+    remove_placement_group,
+)
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+def test_reserve_and_use(ray_cluster):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}])
+
+    @ray_tpu.remote
+    def where():
+        return "ok"
+
+    ref = where.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            pg, placement_group_bundle_index=0
+        )
+    ).remote()
+    assert ray_tpu.get(ref, timeout=60) == "ok"
+    remove_placement_group(pg)
+
+
+def test_reservation_reduces_availability(ray_cluster):
+    before = ray_tpu.available_resources().get("CPU", 0)
+    pg = placement_group([{"CPU": 2}])
+    after = ray_tpu.available_resources().get("CPU", 0)
+    assert after == before - 2
+    remove_placement_group(pg)
+    assert ray_tpu.available_resources().get("CPU", 0) == before
+
+
+def test_infeasible_rejected(ray_cluster):
+    with pytest.raises(PlacementGroupUnavailableError):
+        placement_group([{"CPU": 10_000}])
+
+
+def test_invalid_args(ray_cluster):
+    with pytest.raises(ValueError):
+        placement_group([])
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 1}], strategy="DIAGONAL")
+
+
+def test_actor_in_placement_group(ray_cluster):
+    pg = placement_group([{"CPU": 1}])
+
+    @ray_tpu.remote(num_cpus=1)
+    class Pinned:
+        def ping(self):
+            return "pong"
+
+    a = Pinned.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(pg)
+    ).remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    ray_tpu.kill(a)
+    remove_placement_group(pg)

@@ -1,0 +1,129 @@
+"""Unit tests for the native shared-memory object store.
+
+Models the reference's plasma tests
+(/root/reference/src/ray/object_manager/plasma/test/).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.core.store_client import (
+    StoreClient,
+    StoreFullError,
+    StoreServer,
+)
+
+
+@pytest.fixture(scope="module")
+def store(tmp_path_factory):
+    d = tmp_path_factory.mktemp("store")
+    srv = StoreServer(
+        str(d / "store.sock"), f"rtpu_test_{os.getpid()}", 1 << 24
+    )
+    client = StoreClient(srv.socket_path, srv.shm_name, srv.capacity)
+    yield client
+    srv.shutdown()
+
+
+def _oid():
+    return os.urandom(20)
+
+
+def test_put_get_roundtrip(store):
+    oid = _oid()
+    store.put(oid, b"payload")
+    view = store.get(oid, 1000)
+    assert bytes(view) == b"payload"
+    store.release(oid)
+
+
+def test_get_missing_nonblocking(store):
+    assert store.get(_oid(), 0) is None
+
+
+def test_get_timeout(store):
+    t0 = time.monotonic()
+    assert store.get(_oid(), 200) is None
+    assert time.monotonic() - t0 >= 0.15
+
+
+def test_blocking_get_wakes_on_seal(store):
+    oid = _oid()
+
+    def writer():
+        time.sleep(0.15)
+        store.put(oid, b"late")
+
+    threading.Thread(target=writer).start()
+    view = store.get(oid, 5000)
+    assert bytes(view) == b"late"
+    store.release(oid)
+
+
+def test_create_seal_zero_copy(store):
+    oid = _oid()
+    data = np.arange(1024, dtype=np.int32)
+    buf = store.create(oid, data.nbytes)
+    buf[:] = data.tobytes()
+    buf.release()
+    store.seal(oid)
+    view = store.get(oid, 1000)
+    out = np.frombuffer(view, dtype=np.int32)
+    np.testing.assert_array_equal(out, data)
+    del out, view
+    store.release(oid)
+
+
+def test_contains_and_delete(store):
+    oid = _oid()
+    assert not store.contains(oid)
+    store.put(oid, b"x")
+    assert store.contains(oid)
+    store.delete(oid)
+    assert not store.contains(oid)
+
+
+def test_duplicate_create_rejected(store):
+    oid = _oid()
+    store.put(oid, b"one")
+    with pytest.raises(FileExistsError):
+        store.create(oid, 8)
+    store.delete(oid)
+
+
+def test_lru_eviction_under_pressure(store):
+    # Fill the 16 MiB store with 1 MiB unreferenced objects; earlier ones
+    # must be evicted rather than failing with OOM.
+    oids = []
+    for _ in range(32):
+        oid = _oid()
+        store.put(oid, b"z" * (1 << 20))
+        oids.append(oid)
+    assert not store.contains(oids[0])
+    assert store.contains(oids[-1])
+
+
+def test_pinned_objects_not_evicted(store):
+    oid = _oid()
+    store.put(oid, b"pinned" * 100)
+    view = store.get(oid, 1000)  # pin
+    for _ in range(32):
+        store.put(_oid(), b"z" * (1 << 20))
+    assert store.contains(oid)
+    del view
+    store.release(oid)
+
+
+def test_oom_when_everything_pinned(store):
+    oid = _oid()
+    with pytest.raises(StoreFullError):
+        store.create(oid, 1 << 30)
+
+
+def test_stats(store):
+    s = store.stats()
+    assert "used_bytes" in s and "num_objects" in s
