@@ -1,0 +1,96 @@
+"""Headline benchmark: GPT-2 124M training throughput on one TPU chip.
+
+BASELINE config 1 ("GPT-2 124M single-worker trainer, 1 TPU chip").  Runs the
+full sharded train step (fwd + bwd + adamw, bf16 compute, Pallas flash
+attention) and prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
+
+vs_baseline compares against the number recorded in BASELINE.json under
+published["gpt2_124m_tokens_per_sec_chip"]; until one is recorded the ratio
+is 1.0 (the reference publishes no training tokens/sec — see BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import gpt2
+from ray_tpu.parallel.mesh import create_mesh, MeshConfig
+from ray_tpu.train.step import (
+    create_train_state,
+    data_sharding,
+    default_optimizer,
+    make_train_step,
+)
+
+BATCH = 8  # best measured single-chip throughput (batch 16+remat ties)
+SEQ = 1024
+WARMUP_STEPS = 3
+MEASURE_STEPS = 20
+
+
+def main():
+    cfg = gpt2.GPT2Config(remat=False)  # batch 8 activations fit in HBM
+    mesh = create_mesh(MeshConfig())  # all axes fill trivially on one chip
+    opt = default_optimizer()
+    key = jax.random.PRNGKey(0)
+
+    with mesh:
+        state = create_train_state(gpt2, cfg, mesh, opt, key)
+        step = make_train_step(gpt2, cfg, mesh, opt)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (BATCH, SEQ + 1), 0, cfg.vocab_size,
+            dtype=jnp.int32)
+        tokens = jax.device_put(tokens, data_sharding(mesh))
+
+        for _ in range(WARMUP_STEPS):
+            state, metrics = step(state, tokens)
+        float(metrics["loss"])  # full sync: value fetch, not block_until_ready
+        # (the axon remote runtime can report buffers ready before the chain
+        # has executed; fetching a literal is the reliable barrier)
+
+        t0 = time.perf_counter()
+        for _ in range(MEASURE_STEPS):
+            state, metrics = step(state, tokens)
+        final_loss = float(metrics["loss"])
+        dt = time.perf_counter() - t0
+
+    tokens_per_sec = BATCH * SEQ * MEASURE_STEPS / dt
+    n_devices = mesh.size
+
+    # ~6*P flops/token (fwd+bwd) for a dense LM, ignoring attention extras.
+    n_params = sum(x.size for x in jax.tree.leaves(state["params"]))
+    flops_per_token = 6 * n_params
+    mfu = (tokens_per_sec * flops_per_token) / (n_devices * 197e12)
+
+    try:
+        with open("BASELINE.json") as f:
+            published = json.load(f).get("published", {})
+    except (OSError, json.JSONDecodeError):
+        published = {}
+    baseline = published.get("gpt2_124m_tokens_per_sec_chip")
+    vs_baseline = (tokens_per_sec / n_devices / baseline) if baseline else 1.0
+
+    print(json.dumps({
+        "metric": "gpt2_124m_train_tokens_per_sec_chip",
+        "value": round(tokens_per_sec / n_devices, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(vs_baseline, 3),
+        "extra": {
+            "loss": round(final_loss, 4),
+            "step_time_ms": round(dt / MEASURE_STEPS * 1e3, 2),
+            "batch": BATCH,
+            "seq": SEQ,
+            "n_params": int(n_params),
+            "mfu_vs_v5e_peak": round(mfu, 4),
+            "backend": jax.default_backend(),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -20,6 +20,7 @@ import os
 import subprocess
 import sys
 import threading
+import time
 import traceback
 from collections import deque
 from dataclasses import dataclass, field
@@ -149,13 +150,13 @@ class Scheduler:
             if self._shutdown:
                 return
             if spec.kind == ACTOR_CREATION:
-                try:
-                    self.gcs.register_actor(gcs_mod.ActorInfo(
-                        actor_id=spec.actor_id, name=spec.actor_name,
-                        max_restarts=spec.max_restarts, class_name=spec.name))
-                except ValueError as e:
-                    self._fail_task(spec, e)
-                    return
+                # Raises ValueError on name conflict: the driver's direct
+                # submit() call surfaces it at ActorClass.remote() (matching
+                # the reference); the worker socket path catches it in
+                # _reader_loop and records it on the creation return object.
+                self.gcs.register_actor(gcs_mod.ActorInfo(
+                    actor_id=spec.actor_id, name=spec.actor_name,
+                    max_restarts=spec.max_restarts, class_name=spec.name))
                 import pickle
 
                 self.gcs.kv_put("actor_creation", spec.actor_id,
@@ -329,7 +330,10 @@ class Scheduler:
             elif t == "done":
                 self._on_task_done(worker, msg)
             elif t == "submit":
-                self.submit(msg["spec"])
+                try:
+                    self.submit(msg["spec"])
+                except ValueError as e:
+                    self._fail_task(msg["spec"], e)
             elif t == "actor_exit":
                 with self._lock:
                     self.gcs.update_actor(msg["actor_id"], max_restarts=0)
@@ -553,11 +557,19 @@ class Scheduler:
     # ------------------------------------------------------------------
     def _schedule_loop(self):
         while True:
-            with self._lock:
-                while not self._shutdown and not self._try_schedule_locked():
-                    self._wake.wait(timeout=1.0)
-                if self._shutdown:
-                    return
+            try:
+                with self._lock:
+                    while (not self._shutdown
+                           and not self._try_schedule_locked()):
+                        self._wake.wait(timeout=1.0)
+                    if self._shutdown:
+                        return
+            except Exception:
+                # The loop must survive any per-task error (bad PG index,
+                # races with dying workers, ...) — a dead scheduling loop
+                # hangs the whole node silently.
+                traceback.print_exc()
+                time.sleep(0.05)
 
     def _try_schedule_locked(self) -> bool:
         """Dispatch as many pending tasks as possible; True if progress made."""
@@ -568,7 +580,16 @@ class Scheduler:
             if spec.kind == ACTOR_METHOD:
                 worker_id = self._actor_workers.get(spec.actor_id)
                 info = self.gcs.get_actor(spec.actor_id)
-                if info is not None and info.state == gcs_mod.DEAD:
+                if info is None:
+                    # Never registered (e.g. creation rejected): fail fast
+                    # rather than queueing forever.
+                    self._task_index.pop(spec.task_id, None)
+                    self._fail_task(spec, ActorDiedError(
+                        f"actor {spec.actor_id.hex()[:8]} does not exist "
+                        f"(creation failed or was rejected)"))
+                    progress = True
+                    continue
+                if info.state == gcs_mod.DEAD:
                     self._task_index.pop(spec.task_id, None)
                     self._fail_task(spec, ActorDiedError(
                         f"actor {spec.actor_id.hex()[:8]} is dead: "
@@ -661,4 +682,9 @@ class Scheduler:
             chips = [self._free_chips.pop(0) for _ in range(n_chips)]
             w.held_chips.extend(chips)
             env["TPU_VISIBLE_CHIPS"] = ",".join(str(i) for i in chips)
-        w.conn.send({"t": "task", "spec": spec, "env": env})
+        try:
+            w.conn.send({"t": "task", "spec": spec, "env": env})
+        except OSError:
+            # Worker died between selection and send; its reader thread will
+            # run _on_worker_death, which retries/fails this in-flight spec.
+            pass

@@ -102,11 +102,13 @@ def write_payload(buf: memoryview, token) -> None:
 
 
 def serialize_error(exc: BaseException, tb: str) -> bytes:
+    # cloudpickle, not pickle: driver-defined exception classes (__main__)
+    # must survive by-value so `except MyError` keeps matching at the caller.
     try:
-        payload = pickle.dumps((exc, tb))
+        payload = cloudpickle.dumps((exc, tb))
     except Exception:
-        # Unpicklable exception: degrade to a RuntimeError with its repr.
-        payload = pickle.dumps((RuntimeError(repr(exc)), tb))
+        # Truly unpicklable exception: degrade to a RuntimeError with repr.
+        payload = cloudpickle.dumps((RuntimeError(repr(exc)), tb))
     return bytes([TAG_ERROR]) + payload
 
 
@@ -122,7 +124,15 @@ def store_error_best_effort(store, oid: bytes, exc: BaseException, tb: str) -> b
             store.put(oid, payload)
             return True
         except FileExistsError:
-            return True
+            if store.contains(oid):  # sealed: a real result/error exists
+                return True
+            # Unsealed husk from a failed earlier write: clear and retry.
+            try:
+                store.abort(oid)
+                store.put(oid, payload)
+                return True
+            except Exception:
+                continue
         except Exception:
             continue
     return False

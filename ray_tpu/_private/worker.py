@@ -20,8 +20,8 @@ import cloudpickle
 from ray_tpu._private import ids
 from ray_tpu._private.serialization import deserialize, serialized_size, write_payload
 from ray_tpu.core.object_ref import ObjectRef
-from ray_tpu.core.store_client import StoreClient
-from ray_tpu.exceptions import GetTimeoutError
+from ray_tpu.core.store_client import ObjectEvictedError, StoreClient
+from ray_tpu.exceptions import GetTimeoutError, ObjectLostError
 
 _GET_CHUNK_MS = 500  # blocking-get slice so Ctrl-C stays responsive
 
@@ -79,14 +79,29 @@ class WorkerContext:
         size, token = serialized_size(value)
         buf = self.store.create(oid, size)
         try:
-            write_payload(buf, token)
-        finally:
-            buf.release()
-        self.store.seal(oid)
+            try:
+                write_payload(buf, token)
+            finally:
+                buf.release()
+            self.store.seal(oid)
+        except BaseException:
+            # Never leave an unsealed husk behind — it would wedge every
+            # consumer blocking on this id.
+            self.store.abort(oid)
+            raise
         return ObjectRef(oid)
 
     def get_object(self, ref: ObjectRef, timeout: Optional[float] = None):
         oid = ref.binary()
+        try:
+            return self._get_object_inner(ref, oid, timeout)
+        except ObjectEvictedError:
+            raise ObjectLostError(
+                f"object {ref} was evicted from the object store before it "
+                f"could be fetched (store under memory pressure); increase "
+                f"object_store_memory or fetch results sooner") from None
+
+    def _get_object_inner(self, ref, oid, timeout: Optional[float]):
         # Fast path: already sealed, no block notification needed.
         view = self.store.get(oid, 0)
         if view is not None:

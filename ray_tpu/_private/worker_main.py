@@ -77,13 +77,16 @@ class WorkerRuntime:
     def handle_task(self, spec: TaskSpec, env: dict):
         # Clear env granted to the previous task (e.g. TPU_VISIBLE_CHIPS)
         # before applying this task's grant — a pooled worker must not leak
-        # chip visibility across tasks.
-        for k in getattr(self, "_last_task_env", ()):  # noqa: B009
-            if k not in env:
-                os.environ.pop(k, None)
-        self._last_task_env = list(env)
-        for k, v in env.items():
-            os.environ[k] = v
+        # chip visibility across tasks.  Actor methods are exempt: the grant
+        # made at actor creation lives for the actor's lifetime (its JAX
+        # backend may initialize lazily inside any later method call).
+        if spec.kind != ACTOR_METHOD:
+            for k in getattr(self, "_last_task_env", ()):  # noqa: B009
+                if k not in env:
+                    os.environ.pop(k, None)
+            self._last_task_env = list(env)
+            for k, v in env.items():
+                os.environ[k] = v
         pool = self.actor_pools.get(spec.actor_id) if spec.actor_id else None
         if spec.kind == ACTOR_METHOD and pool is not None:
             pool.submit(self.execute, spec)

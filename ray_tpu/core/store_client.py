@@ -32,6 +32,7 @@ ST_OOM = 3
 ST_TIMEOUT = 4
 ST_NOT_SEALED = 5
 ST_ERR = 6
+ST_EVICTED = 7
 
 _OP_CREATE, _OP_SEAL, _OP_GET, _OP_RELEASE = 1, 2, 3, 4
 _OP_DELETE, _OP_CONTAINS, _OP_STATS, _OP_ABORT = 5, 6, 7, 8
@@ -42,6 +43,10 @@ class StoreFullError(Exception):
 
 
 class ObjectNotFoundError(Exception):
+    pass
+
+
+class ObjectEvictedError(Exception):
     pass
 
 
@@ -167,6 +172,9 @@ class StoreClient:
         status, offset, size = self._call(_OP_GET, oid, timeout_ms)
         if status in (ST_NOT_FOUND, ST_NOT_SEALED, ST_TIMEOUT):
             return None
+        if status == ST_EVICTED:
+            raise ObjectEvictedError(
+                f"object {oid.hex()[:12]} was evicted from the store")
         if status != ST_OK:
             raise RuntimeError(f"get failed: status={status}")
         return memoryview(self._mm)[offset : offset + size]

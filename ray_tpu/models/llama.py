@@ -1,0 +1,189 @@
+"""Llama-family decoder LM, TPU-first.
+
+Plain functional JAX: params are nested-dict pytrees, layers are stacked on a
+leading axis and iterated with ``lax.scan`` (O(1) compile time in depth), and
+every parameter carries a *logical* sharding spec (parallel/sharding.py) so
+the same definition runs single-chip, FSDP, TP, or any mesh combination.
+The reference delegates this entire layer to torch/vLLM engines; here it is
+native (SURVEY.md §2.4, §7 step 7).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import flash_attention
+from ray_tpu.parallel.sharding import logical_spec as L
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+    remat: bool = True  # checkpoint each layer: recompute activations in bwd
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def llama3_70b() -> "LlamaConfig":
+        return LlamaConfig(d_model=8192, n_layers=80, n_heads=64,
+                           n_kv_heads=8, d_ff=28672)
+
+    @staticmethod
+    def tiny(vocab_size: int = 512) -> "LlamaConfig":
+        """For tests and multichip dry runs."""
+        return LlamaConfig(vocab_size=vocab_size, d_model=128, n_layers=2,
+                           n_heads=4, n_kv_heads=2, d_ff=256,
+                           max_seq_len=256, remat=False)
+
+
+def param_logical_specs(cfg: LlamaConfig):
+    """Logical sharding spec tree, mirroring init()'s param tree."""
+    layer = {
+        "attn": {
+            "wq": L("layers", "embed", "heads"),
+            "wk": L("layers", "embed", "kv_heads"),
+            "wv": L("layers", "embed", "kv_heads"),
+            "wo": L("layers", "heads", "embed"),
+        },
+        "mlp": {
+            "w_gate": L("layers", "embed", "mlp"),
+            "w_up": L("layers", "embed", "mlp"),
+            "w_down": L("layers", "mlp", "embed"),
+        },
+        "attn_norm": L("layers", "norm"),
+        "mlp_norm": L("layers", "norm"),
+    }
+    return {
+        "embed": L("vocab", "embed"),
+        "layers": layer,
+        "final_norm": L("norm",),
+        "lm_head": L("embed", "vocab"),
+    }
+
+
+def init(cfg: LlamaConfig, key: jax.Array):
+    """Initialize parameters (fp32 master weights)."""
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    d, nl = cfg.d_model, cfg.n_layers
+    hq = cfg.n_heads * cfg.head_dim
+    hkv = cfg.n_kv_heads * cfg.head_dim
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5))
+
+    ks = jax.random.split(k_layers, 7)
+    layers = {
+        "attn": {
+            "wq": dense(ks[0], (nl, d, hq), d),
+            "wk": dense(ks[1], (nl, d, hkv), d),
+            "wv": dense(ks[2], (nl, d, hkv), d),
+            "wo": dense(ks[3], (nl, hq, d), hq),
+        },
+        "mlp": {
+            "w_gate": dense(ks[4], (nl, d, cfg.d_ff), d),
+            "w_up": dense(ks[5], (nl, d, cfg.d_ff), d),
+            "w_down": dense(ks[6], (nl, cfg.d_ff, d), cfg.d_ff),
+        },
+        "attn_norm": jnp.ones((nl, d), jnp.float32),
+        "mlp_norm": jnp.ones((nl, d), jnp.float32),
+    }
+    return {
+        "embed": dense(k_embed, (cfg.vocab_size, d), d) * (d ** 0.5) * 0.02,
+        "layers": layers,
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "lm_head": dense(k_head, (d, cfg.vocab_size), d),
+    }
+
+
+def rms_norm(x, weight, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight.astype(
+        x.dtype)
+
+
+def rope(x, positions, theta):
+    """Rotary embedding; x: (..., seq, heads, head_dim)."""
+    head_dim = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, head_dim // 2, dtype=jnp.float32)
+                      / (head_dim // 2))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (.., s, d/2)
+    cos = jnp.cos(angles)[..., None, :]  # broadcast over heads
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _layer(cfg: LlamaConfig, x, layer_params, positions, attn_impl):
+    p = layer_params
+    b, s, d = x.shape
+    h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+    q = (h @ p["attn"]["wq"].astype(h.dtype)).reshape(
+        b, s, cfg.n_heads, cfg.head_dim)
+    k = (h @ p["attn"]["wk"].astype(h.dtype)).reshape(
+        b, s, cfg.n_kv_heads, cfg.head_dim)
+    v = (h @ p["attn"]["wv"].astype(h.dtype)).reshape(
+        b, s, cfg.n_kv_heads, cfg.head_dim)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    attn = flash_attention(q, k, v, causal=True, impl=attn_impl)
+    attn = attn.reshape(b, s, cfg.n_heads * cfg.head_dim)
+    x = x + attn @ p["attn"]["wo"].astype(h.dtype)
+
+    h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(h @ p["mlp"]["w_gate"].astype(h.dtype))
+    up = h @ p["mlp"]["w_up"].astype(h.dtype)
+    x = x + (gate * up) @ p["mlp"]["w_down"].astype(h.dtype)
+    return x
+
+
+def apply(params, tokens, cfg: LlamaConfig, attn_impl: str = "auto"):
+    """Forward pass: tokens (batch, seq) int32 -> logits (batch, seq, vocab).
+
+    Layers run under lax.scan over the stacked layer params; each step is
+    optionally rematerialized (jax.checkpoint) to trade FLOPs for HBM.
+    """
+    dtype = jnp.dtype(cfg.dtype)
+    x = params["embed"][tokens].astype(dtype)
+    positions = jnp.arange(tokens.shape[1])[None, :]
+
+    step = partial(_layer, cfg, positions=positions, attn_impl=attn_impl)
+    if cfg.remat:
+        step = jax.checkpoint(step)
+
+    def scan_body(x, layer_params):
+        return step(x, layer_params), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    # Final projection in fp32 for a stable softmax/CE.
+    return x.astype(jnp.float32) @ params["lm_head"]
+
+
+def loss_fn(params, tokens, cfg: LlamaConfig, attn_impl: str = "auto"):
+    """Next-token cross-entropy; tokens (batch, seq)."""
+    logits = apply(params, tokens[:, :-1], cfg, attn_impl)
+    targets = tokens[:, 1:]
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)

@@ -28,8 +28,10 @@
 #include <cstring>
 #include <cstdlib>
 #include <string>
+#include <deque>
 #include <map>
 #include <unordered_map>
+#include <unordered_set>
 #include <list>
 #include <mutex>
 #include <condition_variable>
@@ -50,7 +52,8 @@ namespace {
 constexpr uint8_t OP_CREATE = 1, OP_SEAL = 2, OP_GET = 3, OP_RELEASE = 4,
                   OP_DELETE = 5, OP_CONTAINS = 6, OP_STATS = 7, OP_ABORT = 8;
 constexpr uint8_t ST_OK = 0, ST_NOT_FOUND = 1, ST_EXISTS = 2, ST_OOM = 3,
-                  ST_TIMEOUT = 4, ST_NOT_SEALED = 5, ST_ERR = 6;
+                  ST_TIMEOUT = 4, ST_NOT_SEALED = 5, ST_ERR = 6,
+                  ST_EVICTED = 7;
 
 constexpr size_t kIdLen = 20;
 constexpr size_t kReqLen = 1 + kIdLen + 8 + 8;
@@ -140,6 +143,7 @@ class Store {
   uint8_t Create(const ObjectId& id, uint64_t size, uint64_t* offset) {
     std::unique_lock<std::mutex> lk(mu_);
     if (objects_.count(id)) return ST_EXISTS;
+    evicted_.erase(id);  // recreation (e.g. task retry) clears the tombstone
     uint64_t off;
     while (!alloc_.Alloc(size, &off)) {
       if (!EvictOneLocked()) return ST_OOM;
@@ -169,6 +173,7 @@ class Store {
     auto deadline = std::chrono::steady_clock::now() +
                     std::chrono::milliseconds(timeout_ms);
     for (;;) {
+      if (evicted_.count(id)) return ST_EVICTED;
       auto it = objects_.find(id);
       if (it != objects_.end() && it->second.sealed) {
         it->second.refcount++;
@@ -202,7 +207,7 @@ class Store {
     if (it->second.in_lru) lru_.erase(it->second.lru_it);
     alloc_.Free(it->second.offset);
     objects_.erase(it);
-    cv_.notify_all();
+    RecordEvictedLocked(id);  // waiters fail fast instead of hanging
     return ST_OK;
   }
 
@@ -250,15 +255,31 @@ class Store {
     if (it != objects_.end()) {
       alloc_.Free(it->second.offset);
       objects_.erase(it);
+      RecordEvictedLocked(victim);
     }
     return true;
   }
 
+  // Bounded tombstone set so a GET on an evicted object fails fast with
+  // ST_EVICTED instead of blocking forever as if the object were pending.
+  void RecordEvictedLocked(const ObjectId& id) {
+    evicted_.insert(id);
+    evicted_order_.push_back(id);
+    while (evicted_order_.size() > kMaxTombstones) {
+      evicted_.erase(evicted_order_.front());
+      evicted_order_.pop_front();
+    }
+    cv_.notify_all();
+  }
+
+  static constexpr size_t kMaxTombstones = 1 << 20;
   std::mutex mu_;
   std::condition_variable cv_;
   FreeListAllocator alloc_;
   std::unordered_map<ObjectId, ObjectEntry, IdHash> objects_;
   std::list<ObjectId> lru_;  // sealed, refcount==0, eviction candidates
+  std::unordered_set<ObjectId, IdHash> evicted_;
+  std::deque<ObjectId> evicted_order_;
 };
 
 bool ReadFull(int fd, void* buf, size_t n) {

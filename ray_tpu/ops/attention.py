@@ -1,0 +1,211 @@
+"""Fused attention for TPU: Pallas flash-attention forward + custom VJP.
+
+Net-new relative to the reference, which delegates attention math to
+torch/vLLM (SURVEY.md §2.4): here it is a first-class op.  The forward pass
+is a Pallas kernel — online-softmax over KV blocks, O(seq) memory, bf16
+inputs with f32 accumulation on the MXU; the backward pass rematerializes
+attention with standard XLA ops (saves only out + logsumexp from forward).
+
+Layout: (batch*heads, seq, head_dim) inside the kernel; the public API takes
+(batch, seq, heads, head_dim) and handles GQA by repeating KV heads.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific memory spaces; absent when running CPU interpret mode
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+                  causal: bool, sm_scale: float):
+    """One (bh, q_block) program: stream KV blocks with online softmax."""
+    block_q = q_ref.shape[1]
+    head_dim = q_ref.shape[2]
+    seq_k = k_ref.shape[1]
+    qi = pl.program_id(1)
+
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, d)
+
+    q_offset = qi * block_q
+    if causal:
+        # Only KV blocks at or before this Q block's last row participate.
+        num_kv = jnp.minimum(
+            pl.cdiv(q_offset + block_q, block_k), pl.cdiv(seq_k, block_k))
+    else:
+        num_kv = pl.cdiv(seq_k, block_k)
+
+    def body(j, carry):
+        acc, m_prev, l_prev = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (block_q, block_k)
+        if causal:
+            row = q_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(row >= col, s, NEG_INF)
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, num_kv, body, (acc0, m0, l0))
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "sm_scale", "block_q", "block_k",
+                              "interpret"))
+def _flash_forward(q, k, v, *, causal: bool, sm_scale: float,
+                   block_q: int, block_k: int, interpret: bool):
+    """q,k,v: (bh, seq, head_dim). Returns (out, lse)."""
+    bh, seq_q, head_dim = q.shape
+    seq_k = k.shape[1]
+    block_q = min(block_q, seq_q)
+    block_k = min(block_k, seq_k)
+    num_q_blocks = pl.cdiv(seq_q, block_q)
+
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale)
+    mem = {} if _VMEM is None else {"memory_space": _VMEM}
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, num_q_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim),
+                         lambda b, i: (b, i, 0), **mem),
+            pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0), **mem),
+            pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0), **mem),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, head_dim),
+                               lambda b, i: (b, i, 0), **mem),
+        out_shape=jax.ShapeDtypeStruct((bh, seq_q, head_dim), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+    return out
+
+
+def _reference_attention(q, k, v, causal: bool, sm_scale: float):
+    """Plain XLA attention (used for backward rematerialization + fallback)."""
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        seq_q, seq_k = s.shape[-2], s.shape[-1]
+        row = jnp.arange(seq_q)[:, None]
+        col = jnp.arange(seq_k)[None, :]
+        s = jnp.where(row >= col, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal=causal, sm_scale=sm_scale,
+                          block_q=block_q, block_k=block_k,
+                          interpret=interpret)
+
+
+def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal=causal, sm_scale=sm_scale,
+                         block_q=block_q, block_k=block_k,
+                         interpret=interpret)
+    return out, (q, k, v, out)
+
+
+def _bwd(causal, sm_scale, block_q, block_k, interpret, res, d_out):
+    q, k, v, out = res
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    do = d_out.astype(jnp.float32)
+    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * sm_scale
+    if causal:
+        row = jnp.arange(s.shape[-2])[:, None]
+        col = jnp.arange(s.shape[-1])[None, :]
+        s = jnp.where(row >= col, s, NEG_INF)
+    lse = jax.scipy.special.logsumexp(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - lse)  # rematerialized softmax
+    dv = jnp.einsum("bqk,bqd->bkd", p, do)
+    dp = jnp.einsum("bqd,bkd->bqk", do, vf)
+    delta = jnp.sum(do * out.astype(jnp.float32), axis=-1, keepdims=True)
+    ds = p * (dp - delta) * sm_scale
+    dq = jnp.einsum("bqk,bkd->bqd", ds, kf)
+    dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_attention.defvjp(_fwd, _bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    impl: str = "auto",  # auto | pallas | xla
+) -> jax.Array:
+    """Multi-head attention with GQA support.
+
+    Shapes: q (batch, seq, heads, head_dim); k/v (batch, seq, kv_heads,
+    head_dim) with heads % kv_heads == 0.  Returns (batch, seq, heads,
+    head_dim) in q's dtype.
+    """
+    batch, seq_q, num_heads, head_dim = q.shape
+    kv_heads = k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(head_dim)
+    if num_heads != kv_heads:
+        reps = num_heads // kv_heads
+        k = jnp.repeat(k, reps, axis=2)
+        v = jnp.repeat(v, reps, axis=2)
+
+    # (b, s, h, d) -> (b*h, s, d)
+    def pack(x):
+        return x.transpose(0, 2, 1, 3).reshape(
+            batch * num_heads, x.shape[1], head_dim)
+
+    qp, kp, vp = pack(q), pack(k), pack(v)
+
+    if impl == "auto":
+        # Backend query, not array query: works under tracing.
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "xla":
+        out = _reference_attention(qp, kp, vp, causal, sm_scale)
+    else:
+        interpret = jax.default_backend() != "tpu"
+        out = _flash_attention(qp, kp, vp, causal, sm_scale, block_q,
+                               block_k, interpret)
+    return out.reshape(batch, num_heads, seq_q, head_dim).transpose(0, 2, 1, 3)

@@ -1,0 +1,103 @@
+"""Device-mesh management: the TPU-native replacement for process groups.
+
+Where the reference wires NCCL/Gloo process groups per parallelism strategy
+(/root/reference/python/ray/train/torch/config.py:115,
+python/ray/util/collective/collective.py:145), the TPU build has ONE
+abstraction: a `jax.sharding.Mesh` whose named axes carry every strategy —
+data parallel (``dp``), ZeRO/FSDP sharded-data parallel (``fsdp``), tensor
+parallel (``tp``), sequence/context parallel (``sp``), expert parallel
+(``ep``), pipeline stages (``pp``).  Collectives are emitted by XLA from
+shardings over ICI; there are no communicator handles to manage.
+
+Axis order is chosen so the innermost (fastest-varying over the physical
+ring) axes carry the heaviest traffic: tp innermost, then sp, then fsdp/dp.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order, outermost-first.
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Sizes of each parallelism axis; -1 on at most one axis means "fill
+    with the remaining devices"."""
+
+    dp: int = 1
+    fsdp: int = -1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    pp: int = 1
+
+    def resolved(self, num_devices: int) -> dict[str, int]:
+        sizes = {"pp": self.pp, "dp": self.dp, "fsdp": self.fsdp,
+                 "ep": self.ep, "sp": self.sp, "tp": self.tp}
+        fills = [k for k, v in sizes.items() if v == -1]
+        if len(fills) > 1:
+            raise ValueError(f"only one axis may be -1, got {fills}")
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if fills:
+            if num_devices % fixed != 0:
+                raise ValueError(
+                    f"{num_devices} devices not divisible by fixed axes "
+                    f"product {fixed}")
+            sizes[fills[0]] = num_devices // fixed
+        elif fixed != num_devices:
+            raise ValueError(
+                f"mesh axes product {fixed} != device count {num_devices}")
+        return sizes
+
+
+def create_mesh(
+    config: Optional[MeshConfig] = None,
+    devices: Optional[Sequence] = None,
+    axis_names: Sequence[str] = AXIS_ORDER,
+) -> Mesh:
+    """Build a Mesh over the given (default: all) devices.
+
+    Devices are laid out in their default enumeration order, which on TPU
+    follows the physical ICI torus — keeping tp as the innermost axis puts
+    tensor-parallel collectives on nearest-neighbour links.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    config = config or MeshConfig()
+    sizes = config.resolved(len(devices))
+    shape = tuple(sizes[a] for a in axis_names)
+    dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, axis_names=tuple(axis_names))
+
+
+def single_device_mesh(device=None) -> Mesh:
+    device = device or jax.devices()[0]
+    shape = (1,) * len(AXIS_ORDER)
+    return Mesh(np.array([device]).reshape(shape), axis_names=AXIS_ORDER)
+
+
+def mesh_axis_size(mesh: Mesh, *axes: str) -> int:
+    return math.prod(mesh.shape.get(a, 1) for a in axes)
+
+
+@dataclass
+class MeshContext:
+    """Holds the active mesh + logical sharding rules for a worker.
+
+    The Train worker group materializes one of these per host once its
+    placement group lands on a slice (SURVEY.md §7 step 4 "mesh manager").
+    """
+
+    mesh: Mesh
+    rules: dict = field(default_factory=dict)
+
+    @property
+    def num_devices(self) -> int:
+        return self.mesh.size

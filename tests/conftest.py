@@ -9,12 +9,20 @@ backends initialize lazily, so setting env here is still effective.
 
 import os
 
+# NOTE: the axon sitecustomize imports jax before this file runs, so the
+# JAX_PLATFORMS env var is already snapshotted — jax.config.update is the
+# effective path.  XLA_FLAGS is read by the XLA client at backend init, which
+# is still lazy, so the env var works for the device count.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

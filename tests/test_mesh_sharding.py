@@ -1,0 +1,61 @@
+"""Mesh + logical sharding tests on the virtual 8-device CPU platform."""
+
+import jax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.parallel.mesh import (
+    AXIS_ORDER,
+    MeshConfig,
+    create_mesh,
+    mesh_axis_size,
+)
+from ray_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    logical_spec,
+    to_partition_spec,
+)
+
+
+def test_mesh_axes_all_present():
+    mesh = create_mesh(MeshConfig(fsdp=-1))
+    assert mesh.axis_names == AXIS_ORDER
+    assert mesh.size == len(jax.devices())
+
+
+def test_mesh_fill_axis():
+    mesh = create_mesh(MeshConfig(dp=2, fsdp=-1, tp=2))
+    assert mesh.shape["dp"] == 2
+    assert mesh.shape["tp"] == 2
+    assert mesh.shape["fsdp"] == len(jax.devices()) // 4
+
+
+def test_mesh_invalid_product():
+    with pytest.raises(ValueError):
+        create_mesh(MeshConfig(dp=3, fsdp=1))  # 3 doesn't divide 8
+
+
+def test_mesh_two_fill_axes_rejected():
+    with pytest.raises(ValueError):
+        MeshConfig(dp=-1, fsdp=-1).resolved(8)
+
+
+def test_logical_to_partition_spec():
+    spec = to_partition_spec(logical_spec("batch", "seq", "embed"))
+    assert spec == P(("dp", "fsdp"), "sp", "fsdp")
+    assert to_partition_spec(logical_spec(None, "heads")) == P(None, "tp")
+
+
+def test_unknown_logical_name_replicates():
+    assert to_partition_spec(logical_spec("nonexistent")) == P(None)
+
+
+def test_custom_rules_override():
+    rules = dict(DEFAULT_RULES, embed=None)
+    assert to_partition_spec(logical_spec("embed"), rules) == P(None)
+
+
+def test_mesh_axis_size():
+    mesh = create_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    assert mesh_axis_size(mesh, "dp", "fsdp") == 4
+    assert mesh_axis_size(mesh, "tp") == 2

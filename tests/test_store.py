@@ -127,3 +127,17 @@ def test_oom_when_everything_pinned(store):
 def test_stats(store):
     s = store.stats()
     assert "used_bytes" in s and "num_objects" in s
+
+
+def test_get_evicted_raises(store):
+    from ray_tpu.core.store_client import ObjectEvictedError
+
+    oid = _oid()
+    store.put(oid, b"victim")
+    store.delete(oid)
+    with pytest.raises(ObjectEvictedError):
+        store.get(oid, 100)
+    # Recreation (task retry) clears the tombstone.
+    store.put(oid, b"retry")
+    assert bytes(store.get(oid, 100)) == b"retry"
+    store.release(oid)
