@@ -1,0 +1,47 @@
+"""ray_tpu.train: distributed SPMD training on TPU meshes.
+
+The reference's Ray Train (v2) re-designed TPU-first: a controller drives a
+gang of per-host worker actors; each worker enters the same jitted SPMD
+program over a jax.sharding.Mesh; parallelism strategies (dp/fsdp/tp/sp/ep)
+are mesh axes + partition specs (ray_tpu.train.step), not NCCL process
+groups.  Reports/checkpoints flow through shared storage with orbax array
+payloads.
+"""
+
+from ray_tpu.train.checkpoint import (
+    Checkpoint,
+    CheckpointManager,
+    load_pytree,
+    save_pytree,
+)
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.context import (
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from ray_tpu.train.controller import Result, TrainController, TrainingFailedError
+from ray_tpu.train.step import (
+    create_train_state,
+    data_sharding,
+    default_optimizer,
+    make_train_step,
+)
+from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
+from ray_tpu.train.worker_group import TrainWorker, WorkerGroup
+
+__all__ = [
+    "Checkpoint", "CheckpointConfig", "CheckpointManager", "DataParallelTrainer",
+    "FailureConfig", "JaxTrainer", "Result", "RunConfig", "ScalingConfig",
+    "TrainContext", "TrainController", "TrainWorker", "TrainingFailedError",
+    "WorkerGroup", "create_train_state", "data_sharding", "default_optimizer",
+    "get_checkpoint", "get_context", "get_dataset_shard", "load_pytree",
+    "make_train_step", "report", "save_pytree",
+]

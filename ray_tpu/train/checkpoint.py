@@ -1,0 +1,171 @@
+"""Checkpoints: directory handles + top-K retention + array (de)serialization.
+
+Counterpart of the reference's Checkpoint
+(/root/reference/python/ray/train/_checkpoint.py:56, to/from_directory) and
+CheckpointManager (v2/_internal/execution/checkpoint/checkpoint_manager.py:72).
+Array payloads use orbax (the TPU-native answer to torch.save): sharded
+jax.Arrays restore onto whatever mesh the restoring process provides.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import shutil
+import tempfile
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+_METADATA_FILE = ".ray_tpu_ckpt_meta.json"
+_MANIFEST = "checkpoint_manifest.json"
+
+
+class Checkpoint:
+    """A handle to a checkpoint directory on a filesystem."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def to_directory(self, dest: Optional[str] = None) -> str:
+        dest = dest or tempfile.mkdtemp(prefix="ckpt_")
+        os.makedirs(dest, exist_ok=True)
+        for name in os.listdir(self.path):
+            src = os.path.join(self.path, name)
+            dst = os.path.join(dest, name)
+            if os.path.isdir(src):
+                shutil.copytree(src, dst, dirs_exist_ok=True)
+            else:
+                shutil.copy2(src, dst)
+        return dest
+
+    @contextlib.contextmanager
+    def as_directory(self):
+        """Yield a local directory view of the checkpoint (zero-copy here)."""
+        yield self.path
+
+    def get_metadata(self) -> dict:
+        meta = os.path.join(self.path, _METADATA_FILE)
+        if os.path.exists(meta):
+            with open(meta) as f:
+                return json.load(f)
+        return {}
+
+    def set_metadata(self, metadata: dict) -> None:
+        with open(os.path.join(self.path, _METADATA_FILE), "w") as f:
+            json.dump(metadata, f)
+
+    def __repr__(self):
+        return f"Checkpoint(path={self.path!r})"
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))
+
+
+def save_pytree(ckpt_dir: str, tree: Any, *, name: str = "state") -> None:
+    """Persist a pytree of (possibly sharded) jax.Arrays with orbax."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.join(os.path.abspath(ckpt_dir), name)
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path, tree)
+
+
+def load_pytree(ckpt_dir: str, target: Any = None, *, name: str = "state") -> Any:
+    """Restore a pytree saved by save_pytree.
+
+    With ``target`` (a pytree of arrays or jax.ShapeDtypeStruct with
+    shardings), arrays restore directly onto the target's shardings/mesh —
+    the resharded-restore path used for elastic restarts.
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.join(os.path.abspath(ckpt_dir), name)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        if target is None:
+            return ckptr.restore(path)
+        return ckptr.restore(path, item=target)
+
+
+@dataclass
+class _CheckpointRecord:
+    index: int
+    path: str
+    metrics: dict = field(default_factory=dict)
+
+
+class CheckpointManager:
+    """Tracks committed checkpoints, keeps top-K, persists a manifest."""
+
+    def __init__(self, experiment_dir: str, config=None):
+        from ray_tpu.train.config import CheckpointConfig
+
+        self._dir = experiment_dir
+        self._config = config or CheckpointConfig()
+        self._records: list[_CheckpointRecord] = []
+        self._load_manifest()
+
+    @property
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        if not self._records:
+            return None
+        return Checkpoint(self._records[-1].path)
+
+    def best_checkpoints(self) -> list[tuple[Checkpoint, dict]]:
+        return [(Checkpoint(r.path), dict(r.metrics)) for r in self._records]
+
+    def register_checkpoint(self, path: str, metrics: dict, index: int) -> None:
+        self._records.append(_CheckpointRecord(index, path, dict(metrics)))
+        self._evict()
+        self._save_manifest()
+
+    def _score(self, rec: _CheckpointRecord):
+        attr = self._config.checkpoint_score_attribute
+        if attr is None:
+            return rec.index
+        val = rec.metrics.get(attr)
+        if val is None:
+            return float("-inf") if self._config.checkpoint_score_order == "max" \
+                else float("inf")
+        return val if self._config.checkpoint_score_order == "max" else -val
+
+    def _evict(self):
+        k = self._config.num_to_keep
+        if k is None or len(self._records) <= k:
+            return
+        # Never evict the latest (needed for resume); evict lowest-scored rest.
+        latest = self._records[-1]
+        rest = sorted(self._records[:-1], key=self._score, reverse=True)
+        keep = rest[: max(k - 1, 0)] + [latest]
+        for rec in rest[max(k - 1, 0):]:
+            shutil.rmtree(rec.path, ignore_errors=True)
+        self._records = sorted(keep, key=lambda r: r.index)
+
+    def _manifest_path(self) -> str:
+        return os.path.join(self._dir, _MANIFEST)
+
+    def _save_manifest(self):
+        os.makedirs(self._dir, exist_ok=True)
+        data = [{"index": r.index, "path": r.path, "metrics": r.metrics}
+                for r in self._records]
+        tmp = self._manifest_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, self._manifest_path())
+
+    def _load_manifest(self):
+        try:
+            with open(self._manifest_path()) as f:
+                data = json.load(f)
+            self._records = [
+                _CheckpointRecord(d["index"], d["path"], d.get("metrics", {}))
+                for d in data if os.path.exists(d["path"])
+            ]
+        except (OSError, ValueError):
+            self._records = []

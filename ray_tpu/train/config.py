@@ -1,0 +1,77 @@
+"""User-facing Train configuration dataclasses.
+
+Counterparts of the reference's ScalingConfig/RunConfig/FailureConfig/
+CheckpointConfig (/root/reference/python/ray/train/v2/api/config.py and
+/root/reference/python/ray/air/config.py).  TPU-native additions: a
+``ScalingConfig.topology`` hint (e.g. "v5e-16") and mesh axis sizes so the
+worker group can gang-reserve a slice and hand each host its mesh.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class ScalingConfig:
+    """Shape of the worker group.
+
+    num_workers: one worker per host (the JAX multi-controller model: each
+    host process enters the same SPMD program; ICI collectives connect them).
+    resources_per_worker: resource bundle per worker, default 1 CPU.
+    use_gpu kept for API familiarity; on this framework TPU chips are the
+    accelerator resource ("TPU").
+    """
+
+    num_workers: int = 1
+    resources_per_worker: Optional[dict] = None
+    use_tpu: bool = False
+    topology: Optional[str] = None  # e.g. "v5e-16": reserve a full slice
+    placement_strategy: str = "STRICT_PACK"
+    # Initialize jax.distributed across workers (real multi-host pods). Off
+    # in single-host/virtual-device tests where process-local meshes are used.
+    use_jax_distributed: bool = False
+
+    def bundle(self) -> dict:
+        res = dict(self.resources_per_worker or {"CPU": 1})
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = 1
+        return res
+
+
+@dataclass
+class FailureConfig:
+    """How the controller reacts to worker failures.
+
+    max_failures: group restarts allowed (-1 = unlimited).  On restart the
+    group is rebuilt and the train fn re-invoked with the latest committed
+    checkpoint visible via ``ray_tpu.train.get_checkpoint()`` — the elastic
+    path the reference implements in v2/_internal/execution/failure_handling.
+    """
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    """Top-K checkpoint retention (reference: air/config.py CheckpointConfig)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"  # or "min"
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+
+
+@dataclass
+class RunConfig:
+    """Where results/checkpoints go and how failures are handled."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 0

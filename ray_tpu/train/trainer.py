@@ -1,0 +1,67 @@
+"""JaxTrainer: the user-facing data-parallel trainer.
+
+Counterpart of the reference's TorchTrainer/DataParallelTrainer
+(/root/reference/python/ray/train/v2/api/data_parallel_trainer.py) with JAX
+as the native backend: the train fn runs once per host-worker, builds (or
+receives) a device mesh, and expresses dp/fsdp/tp/sp/ep via shardings
+(ray_tpu.train.step helpers) — XLA inserts the collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.controller import Result, TrainController
+
+
+class JaxTrainer:
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[dict] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[dict] = None,
+        callbacks: Optional[list] = None,
+    ):
+        self._train_fn = train_loop_per_worker
+        self._train_loop_config = train_loop_config
+        self._scaling_config = scaling_config or ScalingConfig()
+        self._run_config = run_config or RunConfig()
+        self._datasets = datasets or {}
+        self._callbacks = callbacks
+
+    def _dataset_factory(self, num_shards: int) -> list:
+        """Split each dataset into per-rank shards.
+
+        Datasets exposing ``streaming_split`` (ray_tpu.data.Dataset) split
+        natively; plain lists/iterables are sharded round-robin.
+        """
+        per_rank: list[dict] = [{} for _ in range(num_shards)]
+        for name, ds in self._datasets.items():
+            if hasattr(ds, "streaming_split"):
+                splits = ds.streaming_split(num_shards)
+            else:
+                items = list(ds)
+                splits = [items[r::num_shards] for r in range(num_shards)]
+            for r in range(num_shards):
+                per_rank[r][name] = splits[r]
+        return per_rank
+
+    def fit(self) -> Result:
+        factory = self._dataset_factory if self._datasets else None
+        controller = TrainController(
+            self._train_fn,
+            self._train_loop_config,
+            self._scaling_config,
+            self._run_config,
+            dataset_factory=factory,
+            callbacks=self._callbacks,
+        )
+        return controller.run()
+
+
+# API-familiarity alias: the reference's generic name for SPMD trainers.
+DataParallelTrainer = JaxTrainer
