@@ -134,7 +134,20 @@ def rope(x, positions, theta):
     return out.astype(x.dtype)
 
 
-def _layer(cfg: LlamaConfig, x, layer_params, positions, attn_impl):
+def _attention(q, k, v, attn_impl, mesh, rules=None):
+    """Dispatch dense flash vs sequence-parallel (ring/ulysses) attention."""
+    if attn_impl in ("ring", "ulysses"):
+        from ray_tpu.ops.ring_attention import sequence_parallel_attention
+
+        if mesh is None:
+            raise ValueError(f"attn_impl={attn_impl!r} requires a mesh")
+        return sequence_parallel_attention(q, k, v, mesh, impl=attn_impl,
+                                           causal=True, rules=rules)
+    return flash_attention(q, k, v, causal=True, impl=attn_impl)
+
+
+def _layer(cfg: LlamaConfig, x, layer_params, positions, attn_impl, mesh,
+           rules):
     p = layer_params
     b, s, d = x.shape
     h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
@@ -146,7 +159,7 @@ def _layer(cfg: LlamaConfig, x, layer_params, positions, attn_impl):
         b, s, cfg.n_kv_heads, cfg.head_dim)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
-    attn = flash_attention(q, k, v, causal=True, impl=attn_impl)
+    attn = _attention(q, k, v, attn_impl, mesh, rules)
     attn = attn.reshape(b, s, cfg.n_heads * cfg.head_dim)
     x = x + attn @ p["attn"]["wo"].astype(h.dtype)
 
@@ -157,17 +170,21 @@ def _layer(cfg: LlamaConfig, x, layer_params, positions, attn_impl):
     return x
 
 
-def apply(params, tokens, cfg: LlamaConfig, attn_impl: str = "auto"):
+def apply(params, tokens, cfg: LlamaConfig, attn_impl: str = "auto",
+          mesh=None, rules=None):
     """Forward pass: tokens (batch, seq) int32 -> logits (batch, seq, vocab).
 
     Layers run under lax.scan over the stacked layer params; each step is
     optionally rematerialized (jax.checkpoint) to trade FLOPs for HBM.
+    attn_impl "ring"/"ulysses" (with a mesh) enables sequence-parallel
+    attention over the sp axis for long-context training.
     """
     dtype = jnp.dtype(cfg.dtype)
     x = params["embed"][tokens].astype(dtype)
     positions = jnp.arange(tokens.shape[1])[None, :]
 
-    step = partial(_layer, cfg, positions=positions, attn_impl=attn_impl)
+    step = partial(_layer, cfg, positions=positions, attn_impl=attn_impl,
+                   mesh=mesh, rules=rules)
     if cfg.remat:
         step = jax.checkpoint(step)
 
@@ -180,9 +197,11 @@ def apply(params, tokens, cfg: LlamaConfig, attn_impl: str = "auto"):
     return x.astype(jnp.float32) @ params["lm_head"]
 
 
-def loss_fn(params, tokens, cfg: LlamaConfig, attn_impl: str = "auto"):
+def loss_fn(params, tokens, cfg: LlamaConfig, attn_impl: str = "auto",
+            mesh=None, rules=None):
     """Next-token cross-entropy; tokens (batch, seq)."""
-    logits = apply(params, tokens[:, :-1], cfg, attn_impl)
+    logits = apply(params, tokens[:, :-1], cfg, attn_impl, mesh=mesh,
+                   rules=rules)
     targets = tokens[:, 1:]
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]

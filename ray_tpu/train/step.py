@@ -56,9 +56,24 @@ def make_train_step(
     rules: Optional[dict] = None,
     loss_fn: Optional[Callable] = None,
     donate: bool = True,
+    attn_impl: Optional[str] = None,
 ) -> Callable:
-    """Build the jitted SPMD train step: (state, batch) -> (state, metrics)."""
-    loss = loss_fn or (lambda p, b: model.loss_fn(p, b, cfg))
+    """Build the jitted SPMD train step: (state, batch) -> (state, metrics).
+
+    attn_impl "ring"/"ulysses" enables sequence-parallel attention over the
+    mesh's sp axis (model must accept attn_impl/mesh kwargs in loss_fn).
+    """
+    if loss_fn is None:
+        if attn_impl in ("ring", "ulysses"):
+            loss = lambda p, b: model.loss_fn(  # noqa: E731
+                p, b, cfg, attn_impl=attn_impl, mesh=mesh, rules=rules)
+        elif attn_impl is not None:
+            loss = lambda p, b: model.loss_fn(  # noqa: E731
+                p, b, cfg, attn_impl=attn_impl)
+        else:
+            loss = lambda p, b: model.loss_fn(p, b, cfg)  # noqa: E731
+    else:
+        loss = loss_fn
     batch_sharding = data_sharding(mesh, rules)
 
     def step_fn(state, batch):
