@@ -1,0 +1,234 @@
+"""Mixtral-family sparse MoE decoder LM with expert parallelism, TPU-first.
+
+The reference has no native MoE/expert-parallel implementation — it passes
+``enable_expert_parallel`` through to vLLM engine kwargs (SURVEY.md §2.4).
+Here EP is a mesh axis: expert weights are sharded over ``ep`` and token
+dispatch/combine are einsums against a static-capacity one-hot dispatch
+tensor (GShard-style), so XLA emits the token all-to-all from the shardings
+alone.  Everything is static-shape: top-k routing, capacity dropping, and
+combine are MXU-friendly dense ops — no ragged gathers.
+
+Attention/norm/rope are shared with the Llama block (models/llama.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.llama import _attention, rms_norm, rope
+from ray_tpu.parallel.sharding import logical_spec as L
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    n_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    max_seq_len: int = 32768
+    rope_theta: float = 1_000_000.0
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def mixtral_8x7b() -> "MoEConfig":
+        return MoEConfig()
+
+    @staticmethod
+    def tiny(vocab_size: int = 512) -> "MoEConfig":
+        return MoEConfig(vocab_size=vocab_size, d_model=128, n_layers=2,
+                         n_heads=4, n_kv_heads=2, d_ff=256, n_experts=4,
+                         experts_per_token=2, max_seq_len=256, remat=False)
+
+
+def param_logical_specs(cfg: MoEConfig):
+    layer = {
+        "attn": {
+            "wq": L("layers", "embed", "heads"),
+            "wk": L("layers", "embed", "kv_heads"),
+            "wv": L("layers", "embed", "kv_heads"),
+            "wo": L("layers", "heads", "embed"),
+        },
+        "router": L("layers", "embed", None),
+        "experts": {
+            "w_gate": L("layers", "experts", "embed", "expert_mlp"),
+            "w_up": L("layers", "experts", "embed", "expert_mlp"),
+            "w_down": L("layers", "experts", "expert_mlp", "embed"),
+        },
+        "attn_norm": L("layers", "norm"),
+        "mlp_norm": L("layers", "norm"),
+    }
+    return {
+        "embed": L("vocab", "embed"),
+        "layers": layer,
+        "final_norm": L("norm",),
+        "lm_head": L("embed", "vocab"),
+    }
+
+
+def init(cfg: MoEConfig, key: jax.Array):
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    d, nl, ne = cfg.d_model, cfg.n_layers, cfg.n_experts
+    hq = cfg.n_heads * cfg.head_dim
+    hkv = cfg.n_kv_heads * cfg.head_dim
+
+    def dense(key, shape, fan_in):
+        return jax.random.normal(key, shape, jnp.float32) * (fan_in ** -0.5)
+
+    ks = jax.random.split(k_layers, 8)
+    layers = {
+        "attn": {
+            "wq": dense(ks[0], (nl, d, hq), d),
+            "wk": dense(ks[1], (nl, d, hkv), d),
+            "wv": dense(ks[2], (nl, d, hkv), d),
+            "wo": dense(ks[3], (nl, hq, d), hq),
+        },
+        "router": dense(ks[4], (nl, d, ne), d),
+        "experts": {
+            "w_gate": dense(ks[5], (nl, ne, d, cfg.d_ff), d),
+            "w_up": dense(ks[6], (nl, ne, d, cfg.d_ff), d),
+            "w_down": dense(ks[7], (nl, ne, cfg.d_ff, d), cfg.d_ff),
+        },
+        "attn_norm": jnp.ones((nl, d), jnp.float32),
+        "mlp_norm": jnp.ones((nl, d), jnp.float32),
+    }
+    return {
+        "embed": dense(k_embed, (cfg.vocab_size, d), d) * (d ** 0.5) * 0.02,
+        "layers": layers,
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "lm_head": dense(k_head, (d, cfg.vocab_size), d),
+    }
+
+
+def expert_capacity(cfg: MoEConfig, n_tokens: int) -> int:
+    """Static per-expert token capacity, rounded up to a multiple of 8."""
+    c = int(n_tokens * cfg.experts_per_token * cfg.capacity_factor
+            / cfg.n_experts)
+    return max(8, -(-c // 8) * 8)
+
+
+def moe_mlp(cfg: MoEConfig, x, router_w, experts):
+    """Top-k routed expert MLP.  x: (B, S, D) -> (out (B, S, D), aux_loss).
+
+    Dispatch/combine are dense einsums against a (tokens, experts, capacity)
+    one-hot; with experts sharded over ``ep`` XLA turns these contractions
+    into the EP all-to-all.  Tokens over an expert's capacity are dropped
+    (their residual stream passes through unchanged), as in GShard/Switch.
+    """
+    b, s, d = x.shape
+    n = b * s
+    e, k = cfg.n_experts, cfg.experts_per_token
+    cap = expert_capacity(cfg, n)
+    xf = x.reshape(n, d)
+
+    logits = (xf.astype(jnp.float32) @ router_w.astype(jnp.float32))  # (N, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_idx = jax.lax.top_k(probs, k)  # (N, k)
+    top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)  # Mixtral renorm
+
+    # Position of each (token, choice) in its expert's buffer.  Priority is
+    # choice-major (all first choices before any second choice) so a token's
+    # primary expert wins capacity contention.
+    choice_onehot = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)  # (N, k, E)
+    flat = choice_onehot.transpose(1, 0, 2).reshape(k * n, e)
+    pos_flat = jnp.cumsum(flat, axis=0) - flat  # (k*N, E) position per slot
+    pos = pos_flat.reshape(k, n, e).transpose(1, 0, 2)  # (N, k, E)
+    pos_in_expert = jnp.sum(pos * choice_onehot, axis=-1)  # (N, k)
+    keep = pos_in_expert < cap  # capacity drop mask
+
+    # (N, k, E, C) collapsed over k -> dispatch (N, E, C)
+    cap_onehot = jax.nn.one_hot(pos_in_expert.astype(jnp.int32), cap,
+                                dtype=jnp.float32)
+    dispatch = jnp.einsum("nke,nkc,nk->nec", choice_onehot, cap_onehot,
+                          keep.astype(jnp.float32))
+    combine = jnp.einsum("nec,nke,nk->nec", dispatch, choice_onehot, top_p)
+
+    compute_dtype = x.dtype
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(compute_dtype), xf)
+    gate = jax.nn.silu(jnp.einsum(
+        "ecd,edf->ecf", expert_in, experts["w_gate"].astype(compute_dtype)))
+    up = jnp.einsum("ecd,edf->ecf", expert_in,
+                    experts["w_up"].astype(compute_dtype))
+    expert_out = jnp.einsum("ecf,efd->ecd", gate * up,
+                            experts["w_down"].astype(compute_dtype))
+    out = jnp.einsum("nec,ecd->nd", combine.astype(compute_dtype), expert_out)
+
+    # Switch-style load-balancing auxiliary loss: E * sum_e f_e * p_e where
+    # f_e = fraction of tokens whose TOP choice is e, p_e = mean router prob.
+    top1 = jax.nn.one_hot(top_idx[:, 0], e, dtype=jnp.float32)
+    f = jnp.mean(top1, axis=0)
+    p = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(f * p)
+    return out.reshape(b, s, d), aux
+
+
+def _layer(cfg: MoEConfig, carry, layer_params, positions, attn_impl, mesh,
+           rules):
+    x, aux_sum = carry
+    p = layer_params
+    b, s, d = x.shape
+    h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+    q = (h @ p["attn"]["wq"].astype(h.dtype)).reshape(
+        b, s, cfg.n_heads, cfg.head_dim)
+    k = (h @ p["attn"]["wk"].astype(h.dtype)).reshape(
+        b, s, cfg.n_kv_heads, cfg.head_dim)
+    v = (h @ p["attn"]["wv"].astype(h.dtype)).reshape(
+        b, s, cfg.n_kv_heads, cfg.head_dim)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    attn = _attention(q, k, v, attn_impl, mesh, rules)
+    attn = attn.reshape(b, s, cfg.n_heads * cfg.head_dim)
+    x = x + attn @ p["attn"]["wo"].astype(h.dtype)
+
+    h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+    moe_out, aux = moe_mlp(cfg, h, p["router"], p["experts"])
+    return (x + moe_out, aux_sum + aux)
+
+
+def apply(params, tokens, cfg: MoEConfig, attn_impl: str = "auto",
+          mesh=None, rules=None, return_aux: bool = False):
+    """Forward: tokens (B, S) -> logits (B, S, vocab) [, aux_loss]."""
+    dtype = jnp.dtype(cfg.dtype)
+    x = params["embed"][tokens].astype(dtype)
+    positions = jnp.arange(tokens.shape[1])[None, :]
+
+    step = partial(_layer, cfg, positions=positions, attn_impl=attn_impl,
+                   mesh=mesh, rules=rules)
+    if cfg.remat:
+        step = jax.checkpoint(step)
+
+    def scan_body(carry, layer_params):
+        return step(carry, layer_params), None
+
+    (x, aux), _ = jax.lax.scan(
+        scan_body, (x, jnp.zeros((), jnp.float32)), params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x.astype(jnp.float32) @ params["lm_head"]
+    aux = aux / cfg.n_layers
+    return (logits, aux) if return_aux else logits
+
+
+def loss_fn(params, tokens, cfg: MoEConfig, attn_impl: str = "auto",
+            mesh=None, rules=None):
+    """Next-token CE + load-balancing aux loss."""
+    logits, aux = apply(params, tokens[:, :-1], cfg, attn_impl, mesh=mesh,
+                        rules=rules, return_aux=True)
+    targets = tokens[:, 1:]
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold) + cfg.aux_loss_weight * aux

@@ -32,6 +32,16 @@ DEFAULT_BLOCK_K = 256
 NEG_INF = -1e30
 
 
+def repeat_kv_heads(k, v, num_heads):
+    """Expand GQA K/V (..., kv_heads, d) to num_heads along axis 2."""
+    kv_heads = k.shape[2]
+    if kv_heads != num_heads:
+        reps = num_heads // kv_heads
+        k = jnp.repeat(k, reps, axis=2)
+        v = jnp.repeat(v, reps, axis=2)
+    return k, v
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
                   causal: bool, sm_scale: float):
     """One (bh, q_block) program: stream KV blocks with online softmax."""
@@ -184,13 +194,9 @@ def flash_attention(
     head_dim) in q's dtype.
     """
     batch, seq_q, num_heads, head_dim = q.shape
-    kv_heads = k.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(head_dim)
-    if num_heads != kv_heads:
-        reps = num_heads // kv_heads
-        k = jnp.repeat(k, reps, axis=2)
-        v = jnp.repeat(v, reps, axis=2)
+    k, v = repeat_kv_heads(k, v, num_heads)
 
     # (b, s, h, d) -> (b*h, s, d)
     def pack(x):

@@ -32,19 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ray_tpu.ops.attention import flash_attention
+from ray_tpu.ops.attention import NEG_INF, flash_attention, repeat_kv_heads
 from ray_tpu.parallel.sharding import to_partition_spec
-
-NEG_INF = -1e30
-
-
-def _gqa_repeat(k, v, num_heads):
-    kv_heads = k.shape[2]
-    if kv_heads != num_heads:
-        reps = num_heads // kv_heads
-        k = jnp.repeat(k, reps, axis=2)
-        v = jnp.repeat(v, reps, axis=2)
-    return k, v
 
 
 def ring_attention(
@@ -85,7 +74,7 @@ def ring_attention(
     def block(k_cur, v_cur, src, acc, m_prev, l_prev):
         """Fold one KV shard (originally at ring position src) into the
         online-softmax accumulator."""
-        k_rep, v_rep = _gqa_repeat(k_cur, v_cur, h)
+        k_rep, v_rep = repeat_kv_heads(k_cur, v_cur, h)
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_rep.astype(jnp.float32))
         if causal:
             cols = src * s_loc + jnp.arange(s_loc)
@@ -145,7 +134,6 @@ def ulysses_attention(
     h = q.shape[2]
     if h % sp != 0:
         raise ValueError(f"ulysses needs heads ({h}) % sp ({sp}) == 0")
-    k, v = _gqa_repeat(k, v, h)
 
     def fwd(x):  # (b, s/sp, h, d) -> (b, s, h/sp, d)
         return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
@@ -155,7 +143,16 @@ def ulysses_attention(
         return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                                   tiled=True)
 
-    out = flash_attention(fwd(q), fwd(k), fwd(v), causal=causal,
+    # When the kv_heads axis itself splits over sp, swap the raw GQA K/V
+    # (fewer bytes over ICI) and expand to full heads locally afterwards.
+    if k.shape[2] % sp == 0:
+        kg, vg = fwd(k), fwd(v)
+        kg, vg = repeat_kv_heads(kg, vg, h // sp)
+    else:
+        k, v = repeat_kv_heads(k, v, h)
+        kg, vg = fwd(k), fwd(v)
+
+    out = flash_attention(fwd(q), kg, vg, causal=causal,
                           sm_scale=sm_scale, impl=attn_impl)
     return rev(out)
 

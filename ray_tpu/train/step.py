@@ -64,14 +64,12 @@ def make_train_step(
     mesh's sp axis (model must accept attn_impl/mesh kwargs in loss_fn).
     """
     if loss_fn is None:
+        loss_kwargs = {}
+        if attn_impl is not None:
+            loss_kwargs["attn_impl"] = attn_impl
         if attn_impl in ("ring", "ulysses"):
-            loss = lambda p, b: model.loss_fn(  # noqa: E731
-                p, b, cfg, attn_impl=attn_impl, mesh=mesh, rules=rules)
-        elif attn_impl is not None:
-            loss = lambda p, b: model.loss_fn(  # noqa: E731
-                p, b, cfg, attn_impl=attn_impl)
-        else:
-            loss = lambda p, b: model.loss_fn(p, b, cfg)  # noqa: E731
+            loss_kwargs.update(mesh=mesh, rules=rules)
+        loss = lambda p, b: model.loss_fn(p, b, cfg, **loss_kwargs)  # noqa: E731
     else:
         loss = loss_fn
     batch_sharding = data_sharding(mesh, rules)
