@@ -1,0 +1,117 @@
+"""ray_tpu.data: lazy, streaming, distributed datasets over Arrow blocks.
+
+Counterpart of Ray Data (/root/reference/python/ray/data/): read_* build a
+lazy logical plan; transforms append ops; consumption lowers to physical
+operators run by a pull-based streaming executor on the core task/actor
+runtime.  See dataset.py / executor.py for the design.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ray_tpu.data import block as _block
+from ray_tpu.data import datasource as _ds
+from ray_tpu.data import logical as _L
+from ray_tpu.data.block import Block, BlockMetadata
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import Dataset, GroupedData, MaterializedDataset
+from ray_tpu.data.iterator import DataIterator
+
+
+def _read(name: str, tasks) -> Dataset:
+    return Dataset(_L.LogicalPlan([_L.Read(name=name, read_tasks=tasks)]))
+
+
+def _par(override: Optional[int]) -> int:
+    return override or DataContext.get_current().default_parallelism
+
+
+def range(n: int, *, override_num_blocks: Optional[int] = None) -> Dataset:  # noqa: A001
+    return _read("Range", _ds.range_tasks(n, _par(override_num_blocks)))
+
+
+def from_items(items: List[Any], *,
+               override_num_blocks: Optional[int] = None) -> Dataset:
+    return _read("FromItems", _ds.items_tasks(items, _par(override_num_blocks)))
+
+
+def from_numpy(arr, column: str = "item") -> Dataset:
+    import numpy as np
+
+    block = _block.from_batch({column: np.asarray(arr)})
+    import ray_tpu
+
+    bundles = [(ray_tpu.put(block), BlockMetadata.of(block))]
+    return Dataset(_L.LogicalPlan([_L.InputData(name="FromNumpy",
+                                                bundles=bundles)]))
+
+
+def from_pandas(df) -> Dataset:
+    import pyarrow as pa
+
+    import ray_tpu
+
+    block = pa.Table.from_pandas(df, preserve_index=False)
+    bundles = [(ray_tpu.put(block), BlockMetadata.of(block))]
+    return Dataset(_L.LogicalPlan([_L.InputData(name="FromPandas",
+                                                bundles=bundles)]))
+
+
+def from_arrow(table) -> Dataset:
+    import ray_tpu
+
+    bundles = [(ray_tpu.put(table), BlockMetadata.of(table))]
+    return Dataset(_L.LogicalPlan([_L.InputData(name="FromArrow",
+                                                bundles=bundles)]))
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None,
+                 override_num_blocks: Optional[int] = None) -> Dataset:
+    return _read("ReadParquet",
+                 _ds.parquet_tasks(paths, _par(override_num_blocks), columns))
+
+
+def read_csv(paths, *, override_num_blocks: Optional[int] = None) -> Dataset:
+    return _read("ReadCSV", _ds.csv_tasks(paths, _par(override_num_blocks)))
+
+
+def read_json(paths, *, override_num_blocks: Optional[int] = None) -> Dataset:
+    return _read("ReadJSON", _ds.json_tasks(paths, _par(override_num_blocks)))
+
+
+def read_text(paths, *, override_num_blocks: Optional[int] = None) -> Dataset:
+    return _read("ReadText", _ds.text_tasks(paths, _par(override_num_blocks)))
+
+
+def read_binary_files(paths, *, include_paths: bool = False,
+                      override_num_blocks: Optional[int] = None) -> Dataset:
+    return _read("ReadBinary",
+                 _ds.binary_tasks(paths, _par(override_num_blocks),
+                                  include_paths))
+
+
+def read_numpy(paths, *, override_num_blocks: Optional[int] = None) -> Dataset:
+    return _read("ReadNumpy", _ds.numpy_tasks(paths, _par(override_num_blocks)))
+
+
+__all__ = [
+    "Block",
+    "BlockMetadata",
+    "DataContext",
+    "DataIterator",
+    "Dataset",
+    "GroupedData",
+    "MaterializedDataset",
+    "from_arrow",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "read_binary_files",
+    "read_csv",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+    "read_text",
+]

@@ -1,0 +1,167 @@
+"""Blocks: the unit of data movement — a pyarrow.Table.
+
+Counterpart of the reference's block layer
+(/root/reference/python/ray/data/block.py, _internal/arrow_block.py,
+_internal/pandas_block.py): every Dataset is a stream of blocks; here a block
+is always a pyarrow Table (columnar, zero-copy slicing, cheap concat), and
+batch formats ("numpy" | "pandas" | "pyarrow") are views converted at the
+edges.  TPU relevance: numpy batches feed ``jax.device_put`` without copies
+for fixed-width types.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, Iterator, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+
+# Column name used when data has no schema (e.g. range of ints, list of
+# scalars) — reference uses "item" for the same purpose
+# (python/ray/data/_internal/arrow_block.py TENSOR_COLUMN/item semantics).
+VALUE_COL = "item"
+
+
+@dataclass
+class BlockMetadata:
+    """Sidecar facts about a block, computed where the block was produced so
+    the driver never has to fetch the block to plan (reference:
+    block.py BlockMetadata)."""
+
+    num_rows: int
+    size_bytes: int
+    schema_str: str = ""
+
+    @staticmethod
+    def of(block: Block) -> "BlockMetadata":
+        return BlockMetadata(
+            num_rows=block.num_rows,
+            size_bytes=block.nbytes,
+            schema_str=str(block.schema),
+        )
+
+
+def _normalize_value(v: Any) -> Any:
+    return v
+
+
+def from_rows(rows: Iterable[Dict[str, Any]]) -> Block:
+    rows = list(rows)
+    if not rows:
+        return pa.table({})
+    if not isinstance(rows[0], dict):
+        rows = [{VALUE_COL: r} for r in rows]
+    cols: Dict[str, List[Any]] = {k: [] for k in rows[0]}
+    for r in rows:
+        for k in cols:
+            cols[k].append(r.get(k))
+    return from_batch(cols)
+
+
+def from_batch(batch: Any) -> Block:
+    """Build a block from any supported batch format."""
+    if isinstance(batch, pa.Table):
+        return batch
+    if isinstance(batch, dict):
+        arrays = {}
+        fields = []
+        for k, v in batch.items():
+            if isinstance(v, np.ndarray) and v.ndim > 1:
+                # Multi-dim arrays (images, tokens) → fixed-size-list column
+                # with the trailing shape recorded in field metadata so
+                # to_batch can reconstruct the exact ndarray.
+                import json as json_mod
+
+                n = v.shape[0]
+                inner = int(np.prod(v.shape[1:]))
+                flat = pa.array(np.ascontiguousarray(v).reshape(-1))
+                arr = pa.FixedSizeListArray.from_arrays(flat, inner)
+                arrays[k] = arr
+                fields.append(pa.field(
+                    k, arr.type,
+                    metadata={b"np_shape": json_mod.dumps(
+                        list(v.shape[1:])).encode()}))
+            else:
+                arr = pa.array(v)
+                arrays[k] = arr
+                fields.append(pa.field(k, arr.type))
+        return pa.Table.from_arrays(list(arrays.values()),
+                                    schema=pa.schema(fields))
+    try:
+        import pandas as pd
+
+        if isinstance(batch, pd.DataFrame):
+            return pa.Table.from_pandas(batch, preserve_index=False)
+    except ImportError:
+        pass
+    if isinstance(batch, (list, np.ndarray)):
+        return from_rows(list(batch))
+    raise TypeError(f"unsupported batch type: {type(batch)}")
+
+
+def to_batch(block: Block, batch_format: str = "numpy") -> Any:
+    if batch_format in ("pyarrow", "arrow"):
+        return block
+    if batch_format == "pandas":
+        return block.to_pandas()
+    if batch_format in ("numpy", "default", None):
+        import json as json_mod
+
+        out: Dict[str, np.ndarray] = {}
+        for i, name in enumerate(block.column_names):
+            col = block.column(name)
+            field = block.schema.field(i)
+            meta = field.metadata or {}
+            if b"np_shape" in meta and pa.types.is_fixed_size_list(
+                    field.type):
+                shape = json_mod.loads(meta[b"np_shape"].decode())
+                flat = col.combine_chunks().flatten().to_numpy(
+                    zero_copy_only=False)
+                out[name] = flat.reshape([block.num_rows] + shape)
+                continue
+            try:
+                out[name] = col.to_numpy(zero_copy_only=False)
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                out[name] = np.asarray(col.to_pylist(), dtype=object)
+        return out
+    raise ValueError(f"unknown batch_format: {batch_format!r}")
+
+
+def rows_of(block: Block) -> Iterator[Dict[str, Any]]:
+    for r in block.to_pylist():
+        yield r
+
+
+def concat(blocks: List[Block]) -> Block:
+    blocks = [b for b in blocks if b.num_rows > 0] or blocks[:1]
+    if not blocks:
+        return pa.table({})
+    if len(blocks) == 1:
+        return blocks[0]
+    return pa.concat_tables(blocks, promote_options="default")
+
+
+def slice_block(block: Block, start: int, stop: int) -> Block:
+    return block.slice(start, stop - start)
+
+
+def split_by_bytes(block: Block, target_bytes: int) -> List[Block]:
+    """Slice an oversized output block to ~target_bytes chunks (reference:
+    map tasks yield blocks bounded by target_max_block_size)."""
+    if block.num_rows == 0 or block.nbytes <= target_bytes:
+        return [block]
+    per_row = max(1, block.nbytes // max(1, block.num_rows))
+    rows_per = max(1, target_bytes // per_row)
+    return [
+        block.slice(i, min(rows_per, block.num_rows - i))
+        for i in range(0, block.num_rows, rows_per)
+    ]
+
+
+def empty_like(block: Optional[Block]) -> Block:
+    if block is None:
+        return pa.table({})
+    return block.schema.empty_table()

@@ -1,0 +1,201 @@
+"""Datasources: read-task generation and file writes.
+
+Counterpart of the reference's read API + datasources
+(/root/reference/python/ray/data/read_api.py: read_parquet :786, read_json
+:1260, read_datasource :344; _internal/datasource/*): a read is a list of
+zero-arg callables, each yielding pyarrow Tables, scheduled as ordinary tasks
+by the streaming executor.  File reads split the file list across tasks.
+"""
+
+from __future__ import annotations
+
+import glob as glob_mod
+import os
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data import block as block_mod
+from ray_tpu.data.block import VALUE_COL, Block
+
+
+def _chunk(items: List[Any], n: int) -> List[List[Any]]:
+    n = max(1, min(n, len(items)))
+    size, rem = divmod(len(items), n)
+    out, i = [], 0
+    for k in range(n):
+        take = size + (1 if k < rem else 0)
+        if take:
+            out.append(items[i:i + take])
+        i += take
+    return out
+
+
+def range_tasks(n: int, parallelism: int) -> List[Callable]:
+    """ray_tpu.data.range — integer column "id" like the reference's
+    read_api.range."""
+    tasks = []
+    bounds = np.linspace(0, n, max(1, min(parallelism, n or 1)) + 1,
+                         dtype=np.int64)
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        lo_i, hi_i = int(lo), int(hi)
+
+        def read(lo=lo_i, hi=hi_i) -> Iterator[Block]:
+            yield pa.table({"id": np.arange(lo, hi, dtype=np.int64)})
+
+        tasks.append(read)
+    return tasks
+
+
+def expand_paths(paths, suffixes: Optional[List[str]] = None) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _, names in os.walk(p):
+                files.extend(os.path.join(root, f) for f in sorted(names))
+        elif any(c in p for c in "*?["):
+            files.extend(sorted(glob_mod.glob(p)))
+        else:
+            files.append(p)
+    if suffixes:
+        files = [f for f in files
+                 if any(f.endswith(s) for s in suffixes)]
+    if not files:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return files
+
+
+def _file_tasks(files: List[str], parallelism: int,
+                read_file: Callable[[str], Iterator[Block]]
+                ) -> List[Callable]:
+    tasks = []
+    for group in _chunk(files, parallelism):
+        def read(group=group) -> Iterator[Block]:
+            for f in group:
+                yield from read_file(f)
+
+        tasks.append(read)
+    return tasks
+
+
+def parquet_tasks(paths, parallelism: int,
+                  columns: Optional[List[str]] = None) -> List[Callable]:
+    files = expand_paths(paths, [".parquet"])
+
+    def read_file(f: str) -> Iterator[Block]:
+        import pyarrow.parquet as pq
+
+        yield pq.read_table(f, columns=columns)
+
+    return _file_tasks(files, parallelism, read_file)
+
+
+def csv_tasks(paths, parallelism: int) -> List[Callable]:
+    files = expand_paths(paths)
+
+    def read_file(f: str) -> Iterator[Block]:
+        import pyarrow.csv as pacsv
+
+        yield pacsv.read_csv(f)
+
+    return _file_tasks(files, parallelism, read_file)
+
+
+def json_tasks(paths, parallelism: int) -> List[Callable]:
+    """JSONL files (reference read_json handles jsonl via pyarrow.json)."""
+    files = expand_paths(paths)
+
+    def read_file(f: str) -> Iterator[Block]:
+        import pyarrow.json as pajson
+
+        yield pajson.read_json(f)
+
+    return _file_tasks(files, parallelism, read_file)
+
+
+def text_tasks(paths, parallelism: int) -> List[Callable]:
+    files = expand_paths(paths)
+
+    def read_file(f: str) -> Iterator[Block]:
+        with open(f, "r") as fh:
+            lines = [ln.rstrip("\n") for ln in fh]
+        yield pa.table({"text": lines})
+
+    return _file_tasks(files, parallelism, read_file)
+
+
+def binary_tasks(paths, parallelism: int,
+                 include_paths: bool = False) -> List[Callable]:
+    files = expand_paths(paths)
+
+    def read_file(f: str) -> Iterator[Block]:
+        with open(f, "rb") as fh:
+            data = fh.read()
+        cols: Dict[str, Any] = {"bytes": pa.array([data], pa.binary())}
+        if include_paths:
+            cols["path"] = pa.array([f])
+        yield pa.table(cols)
+
+    return _file_tasks(files, parallelism, read_file)
+
+
+def numpy_tasks(paths, parallelism: int) -> List[Callable]:
+    files = expand_paths(paths, [".npy"])
+
+    def read_file(f: str) -> Iterator[Block]:
+        arr = np.load(f)
+        yield block_mod.from_batch({VALUE_COL: arr})
+
+    return _file_tasks(files, parallelism, read_file)
+
+
+def items_tasks(items: List[Any], parallelism: int) -> List[Callable]:
+    tasks = []
+    for group in _chunk(list(items), parallelism):
+        def read(group=group) -> Iterator[Block]:
+            yield block_mod.from_rows(group)
+
+        tasks.append(read)
+    return tasks
+
+
+# ----------------------------- writes ---------------------------------------
+
+
+def make_write_fn(path: str, fmt: str, write_kwargs: Optional[dict] = None):
+    """Per-block write transform: writes one file per block under ``path``,
+    emits a single-row block of written paths (reference: the Write logical
+    op plans to map tasks, _internal/planner/plan_write_op.py)."""
+    os.makedirs(path, exist_ok=True)
+    write_kwargs = write_kwargs or {}
+
+    def write_blocks(blocks: Iterator[Block]) -> Iterator[Block]:
+        import uuid
+
+        for b in blocks:
+            name = f"{uuid.uuid4().hex[:12]}"
+            if fmt == "parquet":
+                import pyarrow.parquet as pq
+
+                out = os.path.join(path, name + ".parquet")
+                pq.write_table(b, out, **write_kwargs)
+            elif fmt == "csv":
+                import pyarrow.csv as pacsv
+
+                out = os.path.join(path, name + ".csv")
+                pacsv.write_csv(b, out)
+            elif fmt == "json":
+                out = os.path.join(path, name + ".jsonl")
+                with open(out, "w") as fh:
+                    import json as json_mod
+
+                    for row in b.to_pylist():
+                        fh.write(json_mod.dumps(row, default=str) + "\n")
+            else:
+                raise ValueError(f"unknown write format {fmt!r}")
+            yield pa.table({"path": [out], "num_rows": [b.num_rows]})
+
+    return write_blocks

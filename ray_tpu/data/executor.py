@@ -1,0 +1,451 @@
+"""Streaming executor: lowers a logical plan to physical ops and runs it.
+
+Counterpart of the reference's streaming execution stack
+(/root/reference/python/ray/data/_internal/execution/streaming_executor.py:52,
+streaming_executor_state.py:631 select_operator_to_run,
+operators/map_operator.py, task_pool_map_operator.py,
+actor_pool_map_operator.py): here each physical operator is a *generator
+transformer* over streams of (block_ref, metadata) bundles.  Pull-based
+generators give backpressure for free — an operator launches at most
+``window`` concurrent tasks and only launches more when a downstream consumer
+pulls — which is the same steady-state behavior as the reference's push-based
+scheduling loop + concurrency-cap backpressure, with far less machinery.
+
+Map fusion (reference _internal/logical/rules/operator_fusion.py) happens in
+``plan_physical``: adjacent task-compute OneToOne ops compose into a single
+task; a task-compute chain feeding an actor-compute op is folded into the
+actor's transform.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.data import block as block_mod
+from ray_tpu.data.block import Block, BlockMetadata
+from ray_tpu.data.context import DataContext
+from ray_tpu.data import logical as L
+
+RefBundle = Tuple[ObjectRef, BlockMetadata]
+
+
+@dataclass
+class OpStats:
+    name: str
+    tasks: int = 0
+    rows: int = 0
+    wall_s: float = 0.0
+
+
+@dataclass
+class ExecStats:
+    ops: List[OpStats] = field(default_factory=list)
+
+    def summary(self) -> str:
+        lines = []
+        for s in self.ops:
+            lines.append(
+                f"{s.name}: {s.tasks} tasks, {s.rows} rows, "
+                f"{s.wall_s:.2f}s")
+        return "\n".join(lines)
+
+
+def _put_blocks(blocks: List[Block], target_bytes: int) -> List[RefBundle]:
+    out = []
+    for b in blocks:
+        for piece in block_mod.split_by_bytes(b, target_bytes):
+            out.append((ray_tpu.put(piece), BlockMetadata.of(piece)))
+    return out
+
+
+def make_map_task(chain_blob: bytes, target_bytes: int):
+    """Build the remote task body for a fused task-compute map stage.  The
+    chain is shipped as a cloudpickle blob so one generic task body serves
+    every stage (reference: map_operator.py _map_task)."""
+
+    def _map_task(*blocks):
+        chain = cloudpickle.loads(chain_blob)
+        out = list(chain(iter(blocks)))
+        return _put_blocks(out, target_bytes)
+
+    return _map_task
+
+
+class _MapWorker:
+    """Actor-pool UDF host: constructs the user's class once, reuses it for
+    every block (reference: actor_pool_map_operator.py _MapWorker)."""
+
+    def __init__(self, udf_blob: bytes, make_fn_blob: bytes,
+                 target_bytes: int):
+        udf_cls, args, kwargs = cloudpickle.loads(udf_blob)
+        self._udf = udf_cls(*args, **kwargs)
+        self._chain = cloudpickle.loads(make_fn_blob)(self._udf)
+        self._target_bytes = target_bytes
+
+    def ready(self) -> str:
+        return "ok"
+
+    def map(self, *blocks):
+        out = list(self._chain(iter(blocks)))
+        return _put_blocks(out, self._target_bytes)
+
+
+class PhysicalOp:
+    name = "op"
+
+    def execute(self, inp: Iterator[List[RefBundle]],
+                stats: OpStats) -> Iterator[List[RefBundle]]:
+        raise NotImplementedError
+
+
+class InputOp(PhysicalOp):
+    def __init__(self, bundles: List[RefBundle]):
+        self.name = "Input"
+        self._bundles = bundles
+
+    def execute(self, inp, stats):
+        for b in self._bundles:
+            stats.rows += b[1].num_rows
+            yield [b]
+
+
+def _window_run(submit: Callable[[], Optional[ObjectRef]],
+                window: int, stats: OpStats) -> Iterator[List[RefBundle]]:
+    """Core streaming loop for task-launching ops: keep up to ``window``
+    tasks in flight; yield results of whichever finishes first."""
+    pending: deque = deque()
+    exhausted = False
+    while True:
+        while not exhausted and len(pending) < window:
+            ref = submit()
+            if ref is None:
+                exhausted = True
+                break
+            pending.append(ref)
+            stats.tasks += 1
+        if not pending:
+            return
+        # Yield in submission (FIFO) order so dataset order is deterministic
+        # (reference: streaming executor preserves block order).  Later tasks
+        # in the window keep running while we wait on the head.
+        head = pending.popleft()
+        result = ray_tpu.get(head)
+        for _, meta in result:
+            stats.rows += meta.num_rows
+        yield result
+
+
+class TaskMapOp(PhysicalOp):
+    def __init__(self, name: str, chain: Callable, resources: dict,
+                 ctx: DataContext, concurrency: Optional[int] = None):
+        self.name = name
+        self._chain_blob = cloudpickle.dumps(chain)
+        self._resources = resources
+        self._ctx = ctx
+        self._window = concurrency or ctx.max_tasks_in_flight_per_op
+
+    def execute(self, inp, stats):
+        task = ray_tpu.remote(
+            make_map_task(self._chain_blob, self._ctx.target_max_block_size)
+        ).options(name=self.name, max_retries=self._ctx.task_max_retries,
+                  **self._resources)
+        it = iter(inp)
+
+        def submit():
+            bundle = next(it, None)
+            if bundle is None:
+                return None
+            return task.remote(*[ref for ref, _ in bundle])
+
+        t0 = time.perf_counter()
+        yield from _window_run(submit, self._window, stats)
+        stats.wall_s += time.perf_counter() - t0
+
+
+class ReadOp(PhysicalOp):
+    """Reads are maps over zero-input read tasks (reference:
+    planner/plan_read_op.py)."""
+
+    def __init__(self, read_tasks: List[Callable], ctx: DataContext):
+        self.name = "Read"
+        self._read_tasks = read_tasks
+        self._ctx = ctx
+
+    def execute(self, inp, stats):
+        target = self._ctx.target_max_block_size
+
+        def run_read(task_blob):
+            fn = cloudpickle.loads(task_blob)
+            return _put_blocks(list(fn()), target)
+
+        task = ray_tpu.remote(run_read).options(
+            name="Read", max_retries=self._ctx.task_max_retries)
+        queue = deque(cloudpickle.dumps(t) for t in self._read_tasks)
+
+        def submit():
+            if not queue:
+                return None
+            return task.remote(queue.popleft())
+
+        t0 = time.perf_counter()
+        yield from _window_run(
+            submit, self._ctx.max_tasks_in_flight_per_op, stats)
+        stats.wall_s += time.perf_counter() - t0
+
+
+class ActorMapOp(PhysicalOp):
+    def __init__(self, name: str, udf_cls, udf_args, udf_kwargs,
+                 make_fn: Callable, resources: dict, ctx: DataContext,
+                 concurrency: Optional[int]):
+        self.name = name
+        self._udf_blob = cloudpickle.dumps((udf_cls, udf_args, udf_kwargs))
+        self._make_fn_blob = cloudpickle.dumps(make_fn)
+        self._resources = resources
+        self._ctx = ctx
+        self._pool_size = concurrency or 2
+
+    def execute(self, inp, stats):
+        ctx = self._ctx
+        actor_cls = ray_tpu.remote(_MapWorker).options(**self._resources)
+        actors = [
+            actor_cls.remote(self._udf_blob, self._make_fn_blob,
+                             ctx.target_max_block_size)
+            for _ in range(self._pool_size)
+        ]
+        ray_tpu.get([a.ready.remote() for a in actors],
+                    timeout=ctx.wait_for_min_actors_s)
+        in_flight: deque = deque()  # (ref, actor_idx), FIFO for ordering
+        load: Dict[int, int] = {i: 0 for i in range(len(actors))}
+        it = iter(inp)
+        cap = ctx.max_tasks_in_flight_per_actor
+        t0 = time.perf_counter()
+        try:
+            done_in = False
+            while True:
+                while (not done_in
+                       and len(in_flight) < self._pool_size * cap):
+                    bundle = next(it, None)
+                    if bundle is None:
+                        done_in = True
+                        break
+                    # least-loaded actor (reference: actor pool picks the
+                    # actor with fewest in-flight tasks)
+                    i = min(load, key=load.get)
+                    ref = actors[i].map.remote(
+                        *[r for r, _ in bundle])
+                    in_flight.append((ref, i))
+                    load[i] += 1
+                    stats.tasks += 1
+                if not in_flight:
+                    return
+                head, i = in_flight.popleft()
+                load[i] -= 1
+                result = ray_tpu.get(head)
+                for _, meta in result:
+                    stats.rows += meta.num_rows
+                yield result
+        finally:
+            stats.wall_s += time.perf_counter() - t0
+            for a in actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+
+
+class LimitOp(PhysicalOp):
+    def __init__(self, limit: int):
+        self.name = f"Limit[{limit}]"
+        self._limit = limit
+
+    def execute(self, inp, stats):
+        remaining = self._limit
+
+        def truncate(b, n):
+            t = b.slice(0, n)
+            return [(ray_tpu.put(t), BlockMetadata.of(t))]
+
+        trunc = ray_tpu.remote(truncate)
+        for bundle in inp:
+            out = []
+            for ref, meta in bundle:
+                if remaining <= 0:
+                    break
+                if meta.num_rows <= remaining:
+                    out.append((ref, meta))
+                    remaining -= meta.num_rows
+                else:
+                    out.extend(ray_tpu.get(trunc.remote(ref, remaining)))
+                    remaining = 0
+            if out:
+                stats.rows += sum(m.num_rows for _, m in out)
+                yield out
+            if remaining <= 0:
+                return
+
+
+class AllToAllOp(PhysicalOp):
+    """Barrier: materialize upstream, hand the full bundle list to bulk_fn
+    (reference: _internal/planner/exchange/* shuffle task schedulers)."""
+
+    def __init__(self, name: str, bulk_fn: Callable, ctx: DataContext):
+        self.name = name
+        self._bulk_fn = bulk_fn
+        self._ctx = ctx
+
+    def execute(self, inp, stats):
+        bundles: List[RefBundle] = []
+        for b in inp:
+            bundles.extend(b)
+        t0 = time.perf_counter()
+        out = self._bulk_fn(bundles, self._ctx)
+        stats.wall_s += time.perf_counter() - t0
+        stats.tasks += len(out)
+        for pair in out:
+            stats.rows += pair[1].num_rows
+            yield [pair]
+
+
+def _compose(f, g):
+    def chained(blocks):
+        return g(f(blocks))
+
+    return chained
+
+
+def plan_physical(plan: "L.LogicalPlan", ctx: DataContext
+                  ) -> List[PhysicalOp]:
+    """Lower logical → physical with map fusion."""
+    ops: List[PhysicalOp] = []
+    pending_chain: Optional[Callable] = None
+    pending_names: List[str] = []
+    pending_res: dict = {}
+
+    def flush_chain():
+        nonlocal pending_chain, pending_names, pending_res
+        if pending_chain is not None:
+            ops.append(TaskMapOp("+".join(pending_names), pending_chain,
+                                 pending_res, ctx))
+            pending_chain, pending_names, pending_res = None, [], {}
+
+    for op in plan.ops:
+        if isinstance(op, L.InputData):
+            flush_chain()
+            ops.append(InputOp(op.bundles))
+        elif isinstance(op, L.Read):
+            flush_chain()
+            ops.append(ReadOp(op.read_tasks, ctx))
+        elif isinstance(op, L.OneToOne):
+            res = {}
+            if op.num_cpus:
+                res["num_cpus"] = op.num_cpus
+            if op.num_tpus:
+                res["num_tpus"] = op.num_tpus
+            if op.memory:
+                res["memory"] = op.memory
+            if op.compute == "actors":
+                prefix = pending_chain
+                make_user_fn = op.block_fn  # factory: udf -> block_fn
+
+                def make_fn(udf, _prefix=prefix, _make=make_user_fn):
+                    fn = _make(udf)
+                    return fn if _prefix is None else _compose(_prefix, fn)
+
+                pending_chain, pending_names, pending_res = None, [], {}
+                ops.append(ActorMapOp(op.name, op.udf_cls, op.udf_args,
+                                      op.udf_kwargs, make_fn, res, ctx,
+                                      op.concurrency))
+            else:
+                if pending_chain is None:
+                    pending_chain = op.block_fn
+                else:
+                    pending_chain = _compose(pending_chain, op.block_fn)
+                pending_names.append(op.name)
+                pending_res.update(res)
+        elif isinstance(op, L.AllToAll):
+            flush_chain()
+            ops.append(AllToAllOp(op.name, op.bulk_fn, ctx))
+        elif isinstance(op, L.Limit):
+            flush_chain()
+            ops.append(LimitOp(op.limit))
+        elif isinstance(op, L.Union):
+            flush_chain()
+            ops.append(UnionOp(op.others, ctx))
+        elif isinstance(op, L.Zip):
+            flush_chain()
+            ops.append(ZipOp(op.other, ctx))
+        else:
+            raise TypeError(f"unknown logical op: {op}")
+    flush_chain()
+    return ops
+
+
+class UnionOp(PhysicalOp):
+    def __init__(self, other_plans, ctx):
+        self.name = "Union"
+        self._others = other_plans
+        self._ctx = ctx
+
+    def execute(self, inp, stats):
+        for bundle in inp:
+            yield bundle
+        for plan in self._others:
+            for bundle in execute_streaming(plan, self._ctx):
+                stats.rows += sum(m.num_rows for _, m in bundle)
+                yield bundle
+
+
+class ZipOp(PhysicalOp):
+    def __init__(self, other_plan, ctx):
+        self.name = "Zip"
+        self._other = other_plan
+        self._ctx = ctx
+
+    def execute(self, inp, stats):
+        left: List[RefBundle] = [p for b in inp for p in b]
+        right: List[RefBundle] = [
+            p for b in execute_streaming(self._other, self._ctx) for p in b]
+
+        def zip_all(refs_l, refs_r):
+            lt = block_mod.concat(list(ray_tpu.get(refs_l)))
+            rt = block_mod.concat(list(ray_tpu.get(refs_r)))
+            if lt.num_rows != rt.num_rows:
+                raise ValueError(
+                    f"zip requires equal row counts: {lt.num_rows} vs "
+                    f"{rt.num_rows}")
+            for name in rt.column_names:
+                col = name if name not in lt.column_names else name + "_1"
+                lt = lt.append_column(col, rt.column(name))
+            return _put_blocks([lt], DataContext.get_current(
+            ).target_max_block_size)
+
+        task = ray_tpu.remote(zip_all)
+        result = ray_tpu.get(task.remote([r for r, _ in left],
+                                         [r for r, _ in right]))
+        stats.tasks += 1
+        for pair in result:
+            stats.rows += pair[1].num_rows
+            yield [pair]
+
+
+def execute_streaming(plan: "L.LogicalPlan", ctx: Optional[DataContext]
+                      = None, stats_out: Optional[ExecStats] = None
+                      ) -> Iterator[List[RefBundle]]:
+    """Execute a logical plan, yielding output bundles as they materialize."""
+    ctx = ctx or DataContext.get_current()
+    phys = plan_physical(plan, ctx)
+    stream: Iterator[List[RefBundle]] = iter(())
+    stats = stats_out or ExecStats()
+    for op in phys:
+        s = OpStats(name=op.name)
+        stats.ops.append(s)
+        stream = op.execute(stream, s)
+    return stream

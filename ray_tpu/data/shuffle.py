@@ -1,0 +1,251 @@
+"""All-to-all exchanges: repartition, random_shuffle, sort, groupby.
+
+Counterpart of the reference's exchange planners
+(/root/reference/python/ray/data/_internal/planner/exchange/
+shuffle_task_scheduler.py, sort_task_spec.py, and the hash_shuffle /
+hash_aggregate physical operators): two-phase map/reduce over object-store
+refs — map tasks partition each input block and ``put`` the pieces, reduce
+tasks fetch their partition's pieces and combine.  All phases are ordinary
+tasks on the core runtime, so the scheduler's backpressure and retries apply.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+import ray_tpu
+from ray_tpu.data import block as block_mod
+from ray_tpu.data.block import Block, BlockMetadata
+
+
+def _reduce_submit(parts_lists, num_parts: int, combine: Callable,
+                   name: str) -> List[Tuple[Any, BlockMetadata]]:
+    """Fan reduce tasks over partitions; parts_lists[i][j] = ref of input i's
+    piece for partition j."""
+
+    def reduce_task(piece_refs):
+        blocks = [b for b in ray_tpu.get(list(piece_refs))
+                  if b is not None and b.num_rows >= 0]
+        out = combine(block_mod.concat(blocks)) if blocks else pa.table({})
+        return ray_tpu.put(out), BlockMetadata.of(out)
+
+    task = ray_tpu.remote(reduce_task).options(name=name)
+    futs = [task.remote([plist[j] for plist in parts_lists])
+            for j in range(num_parts)]
+    return [ray_tpu.get(f) for f in futs]
+
+
+def _map_submit(bundles, map_fn: Callable, name: str) -> List[List[Any]]:
+    """map_fn(block) -> list of blocks (one per partition); tasks put each
+    piece and return its refs."""
+
+    def map_task(b):
+        return [ray_tpu.put(piece) for piece in map_fn(b)]
+
+    task = ray_tpu.remote(map_task).options(name=name)
+    futs = [task.remote(ref) for ref, _ in bundles]
+    return [ray_tpu.get(f) for f in futs]
+
+
+def repartition_fn(num_blocks: int):
+    def bulk(bundles, ctx):
+        total = sum(m.num_rows for _, m in bundles)
+        bounds = np.linspace(0, total, num_blocks + 1, dtype=np.int64)
+        # Assign each output block a global row range; map tasks slice out
+        # the overlap of their input block with each range.
+        starts = []
+        acc = 0
+        for _, m in bundles:
+            starts.append(acc)
+            acc += m.num_rows
+
+        def make_map(start_row):
+            def fn(b):
+                pieces = []
+                for j in range(num_blocks):
+                    lo = int(max(bounds[j] - start_row, 0))
+                    hi = int(min(bounds[j + 1] - start_row, b.num_rows))
+                    pieces.append(b.slice(lo, max(0, hi - lo)))
+                return pieces
+
+            return fn
+
+        def map_task(b, start_row):
+            return [ray_tpu.put(p) for p in make_map(start_row)(b)]
+
+        task = ray_tpu.remote(map_task).options(name="RepartitionMap")
+        parts = [ray_tpu.get(task.remote(ref, starts[i]))
+                 for i, (ref, _) in enumerate(bundles)]
+        return _reduce_submit(parts, num_blocks, lambda t: t,
+                              "RepartitionReduce")
+
+    return bulk
+
+
+def random_shuffle_fn(seed: Optional[int] = None,
+                      num_blocks: Optional[int] = None):
+    def bulk(bundles, ctx):
+        n_out = num_blocks or max(1, len(bundles))
+        # Fresh entropy per unseeded shuffle so per-epoch shuffles differ.
+        rng_seed = seed if seed is not None else int(
+            np.random.SeedSequence().entropy % (2 ** 31))
+
+        def map_fn_for(i):
+            def fn(b):
+                rng = np.random.default_rng(rng_seed + 7919 * i)
+                idx = rng.permutation(b.num_rows)
+                assign = rng.integers(0, n_out, size=b.num_rows)
+                shuffled = b.take(pa.array(idx))
+                return [shuffled.filter(pa.array(assign == j))
+                        for j in range(n_out)]
+
+            return fn
+
+        def map_task(b, i):
+            return [ray_tpu.put(p) for p in map_fn_for(i)(b)]
+
+        task = ray_tpu.remote(map_task).options(name="ShuffleMap")
+        parts = [ray_tpu.get(task.remote(ref, i))
+                 for i, (ref, _) in enumerate(bundles)]
+
+        def combine(t, _seed=rng_seed):
+            rng = np.random.default_rng(_seed ^ 0xABCDEF)
+            if t.num_rows == 0:
+                return t
+            return t.take(pa.array(rng.permutation(t.num_rows)))
+
+        return _reduce_submit(parts, n_out, combine, "ShuffleReduce")
+
+    return bulk
+
+
+def _sample_boundaries(bundles, key: str, n_parts: int) -> List[Any]:
+    """Sample input blocks to pick range-partition boundaries (reference:
+    sort_task_spec.py SortTaskSpec.sample_boundaries)."""
+
+    def sample(b):
+        col = b.column(key)
+        k = min(100, b.num_rows)
+        if k == 0:
+            return []
+        idx = np.linspace(0, b.num_rows - 1, k, dtype=np.int64)
+        return b.take(pa.array(idx)).column(key).to_pylist()
+
+    task = ray_tpu.remote(sample).options(name="SortSample")
+    samples: List[Any] = []
+    for vals in ray_tpu.get([task.remote(ref) for ref, _ in bundles]):
+        samples.extend(vals)
+    if not samples:
+        return []
+    samples.sort()
+    return [samples[int(len(samples) * (j + 1) / n_parts) - 1]
+            for j in range(n_parts - 1)]
+
+
+def sort_fn(key: str, descending: bool = False):
+    def bulk(bundles, ctx):
+        if not bundles:
+            return []
+        n_out = max(1, len(bundles))
+        bounds = _sample_boundaries(bundles, key, n_out)
+
+        def map_task(b):
+            col = b.column(key).to_pylist()
+            if not bounds:
+                assign = np.zeros(b.num_rows, dtype=np.int64)
+            else:
+                try:
+                    assign = np.searchsorted(np.asarray(bounds), col,
+                                             side="left")
+                except (TypeError, ValueError):
+                    assign = np.asarray(
+                        [sum(1 for bd in bounds if v > bd) for v in col],
+                        dtype=np.int64)
+            return [ray_tpu.put(b.filter(pa.array(assign == j)))
+                    for j in range(n_out)]
+
+        task = ray_tpu.remote(map_task).options(name="SortMap")
+        parts = [ray_tpu.get(task.remote(ref)) for ref, _ in bundles]
+
+        def combine(t):
+            order = "descending" if descending else "ascending"
+            return t.sort_by([(key, order)])
+
+        out = _reduce_submit(parts, n_out, combine, "SortReduce")
+        return list(reversed(out)) if descending else out
+
+    return bulk
+
+
+# name -> (pyarrow aggregate function, output column suffix); mirrors the
+# reference's AggregateFn zoo (python/ray/data/aggregate.py).
+_AGGS = {
+    "count": ("count", "count()"),
+    "sum": ("sum", "sum"),
+    "min": ("min", "min"),
+    "max": ("max", "max"),
+    "mean": ("mean", "mean"),
+    "std": ("stddev", "std"),
+}
+
+
+def groupby_agg_fn(key: Optional[str], aggs: List[Tuple[str, Optional[str]]]):
+    """aggs: list of (agg_name, on_column).  key=None → global aggregation."""
+
+    def bulk(bundles, ctx):
+        n_out = max(1, min(len(bundles), 8)) if key else 1
+
+        def map_task(b):
+            if key is None:
+                return [ray_tpu.put(b)]
+            arr = b.column(key)
+            # Deterministic cross-process hash — Python's hash() is salted
+            # per process and map tasks run in different workers.
+            import zlib
+
+            hashed = np.asarray(
+                [zlib.crc32(repr(v).encode()) % n_out
+                 for v in arr.to_pylist()], dtype=np.int64)
+            return [ray_tpu.put(b.filter(pa.array(hashed == j)))
+                    for j in range(n_out)]
+
+        task = ray_tpu.remote(map_task).options(name="AggMap")
+        parts = [ray_tpu.get(task.remote(ref)) for ref, _ in bundles]
+
+        def combine(t):
+            if t.num_rows == 0:
+                return t
+            specs = []
+            for agg_name, on in aggs:
+                pa_fn, _ = _AGGS[agg_name]
+                col = on
+                if col is None:
+                    col = key or t.column_names[0]
+                specs.append((col, pa_fn))
+            if key is None:
+                cols = {}
+                for (col, pa_fn), (agg_name, on) in zip(specs, aggs):
+                    val = pc.count(t.column(col)) if pa_fn == "count" else \
+                        getattr(pc, pa_fn)(t.column(col))
+                    label = (f"{agg_name}({on})" if on
+                             else f"{agg_name}()")
+                    cols[label] = [val.as_py()]
+                return pa.table(cols)
+            grouped = t.group_by(key).aggregate(specs)
+            # normalize pyarrow's "<col>_<fn>" names to "<fn>(<col>)"
+            renames = {}
+            for (col, pa_fn), (agg_name, on) in zip(specs, aggs):
+                src = f"{col}_{pa_fn}"
+                dst = f"{agg_name}({on})" if on else f"{agg_name}()"
+                renames[src] = dst
+            names = [renames.get(n, n) for n in grouped.column_names]
+            return grouped.rename_columns(names)
+
+        out = _reduce_submit(parts, n_out, combine, "AggReduce")
+        return [(r, m) for r, m in out if m.num_rows > 0] or out[:1]
+
+    return bulk

@@ -1,0 +1,95 @@
+"""streaming_split: one execution shared by n consumer shards.
+
+Counterpart of the reference's StreamSplitDataIterator + OutputSplitter
+(/root/reference/python/ray/data/_internal/execution/operators/
+output_splitter.py, dataset.py:1731): a coordinator actor runs the plan on a
+background thread and round-robins output bundles into per-shard queues with
+bounded depth (backpressure: a slow shard stalls only its own queue, and
+eventually the shared executor).  Train workers each pull their shard —
+reference Train does exactly this per worker (_internal/data_config.py:119).
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+from typing import List
+
+import ray_tpu
+
+_DONE = "__done__"
+_ERR = "__err__"
+
+
+class SplitCoordinator:
+    """Actor: executes the plan once, feeds n shard queues."""
+
+    def __init__(self, plan, n: int, max_queued_per_shard: int = 8):
+        self._plan = plan
+        self._n = n
+        self._queues: List[queue_mod.Queue] = [
+            queue_mod.Queue(maxsize=max_queued_per_shard) for _ in range(n)]
+        self._started = False
+        self._error: str = ""
+
+    def start(self) -> str:
+        if self._started:
+            return "ok"
+        self._started = True
+
+        def feed():
+            try:
+                from ray_tpu.data.executor import execute_streaming
+
+                i = 0
+                for bundle in execute_streaming(self._plan):
+                    for pair in bundle:
+                        self._queues[i % self._n].put(pair)
+                        i += 1
+                for q in self._queues:
+                    q.put(_DONE)
+            except BaseException as e:  # noqa: BLE001
+                # Record the error out-of-band (a full shard queue must not
+                # block the broadcast), then nudge each queue best-effort.
+                self._error = repr(e)
+                for q in self._queues:
+                    try:
+                        q.put_nowait((_ERR, self._error))
+                    except queue_mod.Full:
+                        pass
+
+        threading.Thread(target=feed, daemon=True).start()
+        return "ok"
+
+    def get_next(self, shard: int):
+        """Blocking pop; returns (ref, meta) or the _DONE sentinel.  Runs on
+        the actor's thread pool (max_concurrency > n) so shards can block
+        concurrently."""
+        while True:
+            try:
+                item = self._queues[shard].get(timeout=0.5)
+            except queue_mod.Empty:
+                if self._error:
+                    raise RuntimeError(
+                        f"streaming_split execution failed: {self._error}")
+                continue
+            if (isinstance(item, tuple) and len(item) == 2
+                    and item[0] == _ERR):
+                raise RuntimeError(
+                    f"streaming_split execution failed: {item[1]}")
+            return item
+
+
+class ShardIterable:
+    """Iterable over one shard's bundles; handed to a DataIterator."""
+
+    def __init__(self, coordinator, shard: int):
+        self._coord = coordinator
+        self._shard = shard
+
+    def __iter__(self):
+        while True:
+            item = ray_tpu.get(self._coord.get_next.remote(self._shard))
+            if item == _DONE:
+                return
+            yield item
