@@ -1,0 +1,48 @@
+"""ray_tpu.tune: hyperparameter search over the core actor runtime.
+
+Counterpart of Ray Tune (/root/reference/python/ray/tune/): Tuner.fit runs
+trial actors under a controller event loop with pluggable searchers
+(grid/random + Searcher ABC) and schedulers (ASHA, median stopping, PBT).
+"""
+
+from ray_tpu.tune.context import get_checkpoint, report
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    Searcher,
+    choice,
+    grid_search,
+    loguniform,
+    quniform,
+    randint,
+    uniform,
+)
+from ray_tpu.tune.tuner import Result, ResultGrid, TuneConfig, Tuner
+
+__all__ = [
+    "ASHAScheduler",
+    "BasicVariantGenerator",
+    "FIFOScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "Result",
+    "ResultGrid",
+    "Searcher",
+    "TrialScheduler",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "grid_search",
+    "loguniform",
+    "quniform",
+    "randint",
+    "report",
+    "uniform",
+]

@@ -1,0 +1,250 @@
+"""Tuner: the experiment controller event loop.
+
+Counterpart of the reference's Tuner + TuneController
+(/root/reference/python/ray/tune/tuner.py:43 Tuner.fit,
+tune/execution/tune_controller.py:68): launches trial runner actors up to
+max_concurrent, polls their reports, feeds each result to the scheduler
+(early stop) and — for PBT — clones checkpoints from strong trials into weak
+ones with perturbed configs.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig
+from ray_tpu.tune.schedulers import (
+    CONTINUE,
+    STOP,
+    FIFOScheduler,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+from ray_tpu.tune.trial import (
+    ERRORED,
+    RUNNING,
+    TERMINATED,
+    Trial,
+    TrialRunnerActor,
+)
+
+
+@dataclass
+class TuneConfig:
+    """Reference: python/ray/tune/tune_config.py."""
+
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    search_alg: Optional[Searcher] = None
+    scheduler: Optional[TrialScheduler] = None
+    seed: Optional[int] = None
+    resources_per_trial: Dict[str, float] = field(
+        default_factory=lambda: {"num_cpus": 1})
+
+
+@dataclass
+class Result:
+    """Reference: python/ray/air/result.py."""
+
+    metrics: Optional[dict]
+    config: Dict[str, Any]
+    checkpoint: Optional[Checkpoint]
+    path: str
+    error: Optional[str] = None
+
+    @property
+    def metrics_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([self.metrics]) if self.metrics else None
+
+
+class ResultGrid:
+    """Reference: python/ray/tune/result_grid.py."""
+
+    def __init__(self, results: List[Result], metric: Optional[str],
+                 mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    @property
+    def errors(self) -> List[str]:
+        return [r.error for r in self._results if r.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        sign = 1.0 if mode == "max" else -1.0
+        candidates = [r for r in self._results
+                      if r.metrics and metric in r.metrics]
+        if not candidates:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        return max(candidates,
+                   key=lambda r: sign * float(r.metrics[metric]))
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        rows = []
+        for r in self._results:
+            row = dict(r.metrics or {})
+            row.update({f"config/{k}": v for k, v in r.config.items()})
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+class Tuner:
+    def __init__(self, trainable: Callable[[dict], Any], *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self._trainable = trainable
+        self._param_space = param_space or {}
+        self._tune = tune_config or TuneConfig()
+        self._run = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        tc = self._tune
+        searcher = tc.search_alg or BasicVariantGenerator(
+            self._param_space, tc.num_samples, seed=tc.seed)
+        scheduler = tc.scheduler or FIFOScheduler()
+        if tc.metric:
+            scheduler.set_metric(tc.metric, tc.mode)
+        exp_name = self._run.name or f"tune_{uuid.uuid4().hex[:8]}"
+        storage = os.path.join(
+            self._run.storage_path or "/tmp/ray_tpu_results", exp_name)
+        os.makedirs(storage, exist_ok=True)
+
+        # materialize trials from the searcher
+        trials: List[Trial] = []
+        i = 0
+        while True:
+            tid = f"trial_{i:05d}"
+            cfg = searcher.suggest(tid)
+            if cfg is None:
+                break
+            trials.append(Trial(trial_id=tid, config=cfg,
+                                trial_dir=os.path.join(storage, tid)))
+            i += 1
+            if (not isinstance(searcher, BasicVariantGenerator)
+                    and len(trials) >= tc.num_samples):
+                break
+
+        max_conc = tc.max_concurrent_trials or len(trials)
+        pending = list(trials)
+        running: List[Trial] = []
+        scores: Dict[str, float] = {}
+        sign = 1.0 if tc.mode == "max" else -1.0
+
+        def launch(trial: Trial, restore_from: Optional[str] = None):
+            actor = ray_tpu.remote(TrialRunnerActor).options(
+                **tc.resources_per_trial).remote()
+            ray_tpu.get(actor.start.remote(
+                self._trainable, trial.config, trial.trial_dir,
+                restore_from))
+            trial.actor = actor
+            trial.status = RUNNING
+            running.append(trial)
+
+        def finalize(trial: Trial, status: str, error: Optional[str] = None):
+            trial.status = status
+            trial.error = error
+            running.remove(trial)
+            if trial.actor is not None:
+                try:
+                    ray_tpu.kill(trial.actor)
+                except Exception:
+                    pass
+                trial.actor = None
+
+        def record(trial: Trial, rep: dict):
+            metrics = rep["metrics"]
+            trial.reports.append(metrics)
+            trial.last_result = metrics
+            if rep.get("checkpoint_dir"):
+                trial.checkpoint_dir = os.path.join(
+                    trial.trial_dir, rep["checkpoint_dir"])
+            if tc.metric and tc.metric in metrics:
+                s = sign * float(metrics[tc.metric])
+                scores[trial.trial_id] = s
+                if (trial.best_result is None
+                        or s >= sign * float(
+                            trial.best_result[tc.metric])):
+                    trial.best_result = metrics
+
+        while pending or running:
+            while pending and len(running) < max_conc:
+                launch(pending.pop(0))
+            progressed = False
+            for trial in list(running):
+                poll = ray_tpu.get(trial.actor.poll.remote())
+                for rep in poll["reports"]:
+                    progressed = True
+                    record(trial, rep)
+                    if rep.get("final"):
+                        continue
+                    # Heartbeat reports without the tune metric pass through
+                    # (reference logs a warning rather than crashing).
+                    decision = scheduler.on_result(
+                        trial.trial_id, rep["metrics"]) \
+                        if tc.metric and tc.metric in rep["metrics"] \
+                        else CONTINUE
+                    if decision == STOP:
+                        ray_tpu.get(trial.actor.stop.remote())
+                        finalize(trial, TERMINATED)
+                        searcher.on_trial_complete(
+                            trial.trial_id, trial.last_result)
+                        break
+                    src_id = scheduler.exploit_decision(
+                        trial.trial_id, rep["metrics"], scores) \
+                        if isinstance(scheduler, PopulationBasedTraining) \
+                        else None
+                    if src_id is not None and src_id != trial.trial_id:
+                        src = next(t for t in trials
+                                   if t.trial_id == src_id)
+                        if src.checkpoint_dir:
+                            # exploit: restart from the stronger trial's
+                            # checkpoint with a perturbed config
+                            ray_tpu.get(trial.actor.stop.remote())
+                            finalize(trial, TERMINATED)
+                            trial.config = scheduler.perturb(src.config)
+                            launch(trial,
+                                   restore_from=src.checkpoint_dir)
+                            break
+                else:
+                    if trial in running and poll["status"] in (
+                            TERMINATED, ERRORED):
+                        finalize(trial, poll["status"], poll["error"])
+                        searcher.on_trial_complete(
+                            trial.trial_id, trial.last_result,
+                            error=poll["status"] == ERRORED)
+                        progressed = True
+            if not progressed:
+                time.sleep(0.05)
+
+        results = []
+        for trial in trials:
+            ckpt = (Checkpoint(trial.checkpoint_dir)
+                    if trial.checkpoint_dir else None)
+            results.append(Result(
+                metrics=trial.best_result or trial.last_result,
+                config=trial.config, checkpoint=ckpt,
+                path=trial.trial_dir, error=trial.error))
+        return ResultGrid(results, tc.metric, tc.mode)

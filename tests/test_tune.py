@@ -1,0 +1,150 @@
+"""ray_tpu.tune tests — modeled on the reference's tune test strategy
+(/root/reference/python/ray/tune/tests/: test_tune_controller.py,
+test_trial_scheduler.py, test_searchers.py)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_grid_and_random_search_space():
+    gen = tune.BasicVariantGenerator(
+        {"a": tune.grid_search([1, 2, 3]), "b": tune.uniform(0, 1),
+         "c": "const"},
+        num_samples=2, seed=0)
+    configs = []
+    while True:
+        cfg = gen.suggest(f"t{len(configs)}")
+        if cfg is None:
+            break
+        configs.append(cfg)
+    assert len(configs) == 6
+    assert sorted(c["a"] for c in configs) == [1, 1, 2, 2, 3, 3]
+    assert all(0 <= c["b"] <= 1 and c["c"] == "const" for c in configs)
+
+
+def test_tuner_basic_fit(tmp_path):
+    def objective(config):
+        return {"score": config["x"] ** 2}
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1, 2, 3, 4])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    max_concurrent_trials=2),
+        run_config=RunConfig(name="basic", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 4
+    best = grid.get_best_result()
+    assert best.config["x"] == 4 and best.metrics["score"] == 16
+    assert grid.get_best_result(mode="min").config["x"] == 1
+
+
+def test_tuner_report_loop_and_asha(tmp_path):
+    def objective(config):
+        for i in range(1, 10):
+            tune.report({"loss": config["lr"] * 10 + (10 - i),
+                         "training_iteration": i})
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"lr": tune.grid_search([0.1, 1.0, 10.0, 100.0])},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min",
+            scheduler=tune.ASHAScheduler(grace_period=2,
+                                         reduction_factor=2, max_t=9),
+            max_concurrent_trials=4),
+        run_config=RunConfig(name="asha", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.config["lr"] == 0.1
+    # poor trials should have been stopped early (fewer reports recorded)
+    worst = max(grid, key=lambda r: r.config["lr"])
+    assert worst.metrics["loss"] > best.metrics["loss"]
+
+
+def test_trial_error_is_captured(tmp_path):
+    def objective(config):
+        if config["x"] == 2:
+            raise RuntimeError("boom")
+        return {"score": config["x"]}
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1, 2, 3])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="err", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(grid.errors) == 1 and "boom" in grid.errors[0]
+    assert grid.get_best_result().config["x"] == 3
+
+
+def test_checkpointing_and_pbt(tmp_path):
+    """PBT: weak trials must adopt (perturbed) configs + checkpoints from
+    strong ones and improve."""
+    import json
+    import time
+
+    def objective(config):
+        ckpt = tune.get_checkpoint()
+        start, inherited = 0, None
+        if ckpt:
+            with open(os.path.join(ckpt.path, "state.json")) as f:
+                state = json.load(f)
+            start, inherited = state["step"], state.get("factor")
+        factor = config["factor"]
+        score = inherited if inherited is not None else 0.0
+        for step in range(start, start + 20):
+            time.sleep(0.05)  # pace reports so the controller interleaves
+            score = score + factor
+            cdir = os.path.join(config["tmp"], f"w{os.getpid()}_{step}")
+            os.makedirs(cdir, exist_ok=True)
+            with open(os.path.join(cdir, "state.json"), "w") as f:
+                json.dump({"step": step + 1, "factor": score}, f)
+            tune.report({"score": score, "training_iteration": step + 1},
+                        checkpoint=Checkpoint.from_directory(cdir))
+
+    pbt = tune.PopulationBasedTraining(
+        perturbation_interval=5,
+        hyperparam_mutations={"factor": tune.uniform(0.5, 2.0)},
+        seed=0)
+    grid = tune.Tuner(
+        objective,
+        param_space={"factor": tune.grid_search([0.01, 1.0]),
+                     "tmp": str(tmp_path / "work")},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    scheduler=pbt,
+                                    max_concurrent_trials=2),
+        run_config=RunConfig(name="pbt", storage_path=str(tmp_path)),
+    ).fit()
+    # Both trials should finish with a decent score: the weak one exploits
+    # the strong one's checkpoint instead of plodding at 0.01/step.
+    scores = sorted(r.metrics["score"] for r in grid)
+    assert scores[0] > 0.01 * 45  # far better than never exploiting
+    assert all(r.checkpoint is not None for r in grid)
+
+
+def test_median_stopping():
+    sched = tune.MedianStoppingRule(grace_period=2, min_samples_required=3)
+    sched.set_metric("acc", "max")
+    assert sched.on_result("a", {"acc": 1.0, "training_iteration": 3}) \
+        == tune.schedulers.CONTINUE
+    assert sched.on_result("b", {"acc": 0.9, "training_iteration": 3}) \
+        == tune.schedulers.CONTINUE
+    # c is far below the median of running averages -> stopped
+    assert sched.on_result("c", {"acc": 0.1, "training_iteration": 3}) \
+        == tune.schedulers.STOP
