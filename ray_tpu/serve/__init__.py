@@ -1,0 +1,37 @@
+"""ray_tpu.serve: model serving on the core actor runtime.
+
+Counterpart of Ray Serve (/root/reference/python/ray/serve/): controller
+actor reconciles deployment replica sets; aiohttp proxy routes HTTP to the
+ingress deployment; DeploymentHandles route calls with power-of-two-choices;
+autoscaling follows replica queue lengths.
+"""
+
+from ray_tpu.serve.api import (
+    delete,
+    get_app_handle,
+    http_port,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.deployment import Application, Deployment, deployment
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "Application",
+    "AutoscalingConfig",
+    "Deployment",
+    "DeploymentConfig",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "delete",
+    "deployment",
+    "get_app_handle",
+    "http_port",
+    "run",
+    "shutdown",
+    "start",
+    "status",
+]

@@ -1,0 +1,113 @@
+"""serve.run / start / shutdown / status — the public Serve API.
+
+Counterpart of /root/reference/python/ray/serve/api.py (serve.run :687,
+serve.start, serve.shutdown, serve.status, serve.get_app_handle).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import ray_tpu
+from ray_tpu.serve.controller import ServeController
+from ray_tpu.serve.deployment import Application, flatten_app
+from ray_tpu.serve.handle import CONTROLLER_NAME, DeploymentHandle
+from ray_tpu.serve.proxy import ProxyActor
+
+_PROXY_NAME = "SERVE_PROXY"
+
+
+def _get_or_create_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        return ray_tpu.remote(ServeController).options(
+            name=CONTROLLER_NAME, max_concurrency=32).remote()
+
+
+def start(http_host: str = "127.0.0.1", http_port: int = 0,
+          proxy: bool = True):
+    """Start Serve system actors (controller + HTTP proxy)."""
+    controller = _get_or_create_controller()
+    if proxy:
+        try:
+            ray_tpu.get_actor(_PROXY_NAME)
+        except Exception:
+            p = ray_tpu.remote(ProxyActor).options(
+                name=_PROXY_NAME, max_concurrency=16).remote(
+                http_host, http_port)
+            port = ray_tpu.get(p.get_port.remote(), timeout=60)
+            ray_tpu.get(controller.set_http_port.remote(port), timeout=30)
+    return controller
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: str = "/", _blocking_timeout_s: float = 60.0,
+        proxy: bool = True) -> DeploymentHandle:
+    """Deploy an application; block until RUNNING; return ingress handle."""
+    controller = start(proxy=proxy)
+    ingress, specs = flatten_app(app, name)
+    ray_tpu.get(controller.deploy_application.remote(
+        name, route_prefix, ingress, specs), timeout=60)
+    deadline = time.monotonic() + _blocking_timeout_s
+    while time.monotonic() < deadline:
+        status = ray_tpu.get(controller.get_app_status.remote(name),
+                             timeout=30)
+        if status["status"] == "RUNNING":
+            return DeploymentHandle(name, ingress)
+        time.sleep(0.1)
+    raise TimeoutError(
+        f"application {name!r} did not become RUNNING: {status}")
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    status = ray_tpu.get(controller.get_app_status.remote(name), timeout=30)
+    if status["status"] == "NOT_FOUND":
+        raise ValueError(f"no application named {name!r}")
+    table = ray_tpu.get(controller.get_routing_table.remote(), timeout=30)
+    for route in table["routes"].values():
+        if route["app"] == name:
+            return DeploymentHandle(name, route["ingress"])
+    raise ValueError(f"application {name!r} has no route")
+
+
+def http_port() -> int:
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    port = ray_tpu.get(controller.get_http_port.remote(), timeout=30)
+    if port is None:
+        raise RuntimeError("HTTP proxy is not running")
+    return port
+
+
+def status() -> dict:
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    table = ray_tpu.get(controller.get_routing_table.remote(), timeout=30)
+    out = {}
+    for prefix, route in table["routes"].items():
+        st = ray_tpu.get(
+            controller.get_app_status.remote(route["app"]), timeout=30)
+        out[route["app"]] = {"route_prefix": prefix, **st}
+    return out
+
+
+def delete(name: str):
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    ray_tpu.get(controller.delete_application.remote(name), timeout=60)
+
+
+def shutdown():
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        return
+    try:
+        ray_tpu.get(controller.shutdown.remote(), timeout=60)
+    except Exception:
+        pass
+    for actor_name in (_PROXY_NAME, CONTROLLER_NAME):
+        try:
+            ray_tpu.kill(ray_tpu.get_actor(actor_name))
+        except Exception:
+            pass

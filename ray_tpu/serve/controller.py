@@ -1,0 +1,418 @@
+"""ServeController: the control-plane actor for applications/deployments.
+
+Counterpart of the reference's controller + deployment state machines
+(/root/reference/python/ray/serve/_private/controller.py:87 ServeController,
+deployment_state.py:1360 DeploymentState / :2469 DeploymentStateManager,
+autoscaling_state.py:81): holds target state per deployment, runs a
+reconcile thread (spawn/stop replica actors, replace dead or unhealthy
+ones), an autoscaler on replica queue lengths (+ handle-reported pressure
+for scale-from-zero), and bumps a version number that handles/proxies watch
+(the reference's LongPollHost broadcast, here a condition variable served
+over a high-concurrency actor method).
+
+Concurrency notes: actor methods (deploy/delete) run on the actor's thread
+pool concurrently with the reconcile daemon thread — `_lock` guards all
+state mutation; the blocking replica-ready wait happens OUTSIDE the lock and
+re-checks deployment generation before tracking the new replica (a replica
+spawned for a deleted/redeployed generation is killed, not leaked).
+Liveness comes from the GCS actor table, not from probing the replica's
+(possibly saturated) request thread pool.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu._private.worker import global_worker
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.replica import ReplicaActor
+
+
+@dataclass
+class _DeploymentState:
+    name: str
+    app_name: str
+    cls_blob: bytes
+    init_args_blob: bytes
+    config: DeploymentConfig
+    generation: int = 0
+    target_replicas: int = 1
+    replicas: List[Any] = field(default_factory=list)  # ActorHandles
+    deleted: bool = False
+    # autoscaling bookkeeping
+    over_since: Optional[float] = None
+    under_since: Optional[float] = None
+    last_probe: float = 0.0
+    last_loads: List[int] = field(default_factory=list)
+    # scale-from-zero: handles report queued requests when no replicas
+    pending_reports: float = 0.0
+    pending_ts: float = 0.0
+    # health checks
+    health_failures: Dict[bytes, int] = field(default_factory=dict)
+    last_health: float = 0.0
+
+
+@dataclass
+class _AppState:
+    name: str
+    route_prefix: str
+    ingress: str
+    deployments: Dict[str, _DeploymentState] = field(default_factory=dict)
+    status: str = "DEPLOYING"
+
+
+def _actor_is_dead(handle) -> bool:
+    try:
+        state = global_worker().rpc("actor_state",
+                                    {"actor_id": handle.actor_id})
+        return state == "DEAD"
+    except Exception:
+        return False  # control-plane hiccup: do not treat as death
+
+
+class ServeController:
+    def __init__(self):
+        self._apps: Dict[str, _AppState] = {}
+        self._version = 0
+        self._cond = threading.Condition()
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._http_port: Optional[int] = None
+        self._reconcile_thread = threading.Thread(
+            target=self._loop, daemon=True)
+        self._reconcile_thread.start()
+
+    # ------------------------- deploy API ---------------------------------
+
+    def deploy_application(self, name: str, route_prefix: str,
+                           ingress: str, deployments: List[dict]) -> str:
+        with self._lock:
+            old = self._apps.get(name)
+            app = _AppState(name=name, route_prefix=route_prefix,
+                            ingress=ingress)
+            for spec in deployments:
+                cfg: DeploymentConfig = cloudpickle.loads(spec["config"])
+                prev = (old.deployments.get(spec["name"])
+                        if old is not None else None)
+                ds = _DeploymentState(
+                    name=spec["name"], app_name=name,
+                    cls_blob=spec["cls_blob"],
+                    init_args_blob=spec["init_args_blob"], config=cfg,
+                    generation=(prev.generation + 1 if prev else 0),
+                    target_replicas=(cfg.autoscaling_config.min_replicas
+                                     if cfg.autoscaling_config
+                                     else cfg.num_replicas))
+                app.deployments[ds.name] = ds
+            self._apps[name] = app
+            drained = []
+            if old is not None:
+                for ds in old.deployments.values():
+                    ds.deleted = True
+                    drained.extend(ds.replicas)
+                    ds.replicas = []
+        for r in drained:
+            self._drain_and_kill(r, 0.0)  # old code, no graceful drain
+        self._bump()
+        return "ok"
+
+    def delete_application(self, name: str) -> str:
+        with self._lock:
+            app = self._apps.pop(name, None)
+            drained = []
+            if app is not None:
+                for ds in app.deployments.values():
+                    ds.deleted = True
+                    drained.extend(ds.replicas)
+                    ds.replicas = []
+        for r in drained:
+            self._drain_and_kill(r, 0.0)
+        if app is not None:
+            self._bump()
+        return "ok"
+
+    def shutdown(self) -> str:
+        self._stop.set()
+        for name in list(self._apps):
+            self.delete_application(name)
+        return "ok"
+
+    def set_http_port(self, port: int) -> str:
+        self._http_port = port
+        return "ok"
+
+    def get_http_port(self) -> Optional[int]:
+        return self._http_port
+
+    # ------------------------- read API -----------------------------------
+
+    def get_replicas(self, app_name: str, deployment: str,
+                     known_version: int = -1) -> dict:
+        with self._lock:
+            app = self._apps.get(app_name)
+            ds = app.deployments.get(deployment) if app else None
+            return {"replicas": list(ds.replicas) if ds else [],
+                    "version": self._version}
+
+    def report_no_replica(self, app_name: str, deployment: str,
+                          queued: int = 1) -> str:
+        """Handles report queued requests against a zero-replica deployment
+        so the autoscaler can scale from zero (reference: handle-side
+        queue metrics feed autoscaling_state.py)."""
+        with self._lock:
+            app = self._apps.get(app_name)
+            ds = app.deployments.get(deployment) if app else None
+            if ds is not None:
+                ds.pending_reports = float(queued)
+                ds.pending_ts = time.monotonic()
+        return "ok"
+
+    def get_routing_table(self, known_version: int = -1,
+                          timeout_s: float = 0.0) -> dict:
+        """Long-poll when timeout_s > 0: blocks until version != known
+        (reference: long_poll.py LongPollHost.listen_for_change)."""
+        if timeout_s > 0:
+            with self._cond:
+                self._cond.wait_for(
+                    lambda: self._version != known_version,
+                    timeout=timeout_s)
+        with self._lock:
+            routes = {app.route_prefix: {"app": app.name,
+                                         "ingress": app.ingress,
+                                         "status": app.status}
+                      for app in self._apps.values()}
+            return {"routes": routes, "version": self._version}
+
+    def get_app_status(self, name: str) -> dict:
+        with self._lock:
+            app = self._apps.get(name)
+            if app is None:
+                return {"status": "NOT_FOUND"}
+            detail = {}
+            for ds in app.deployments.values():
+                detail[ds.name] = {"target": ds.target_replicas,
+                                   "running": len(ds.replicas)}
+            return {"status": app.status, "deployments": detail}
+
+    # ------------------------- reconcile loop ------------------------------
+
+    def _bump(self):
+        with self._cond:
+            self._version += 1
+            self._cond.notify_all()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                changed = False
+                with self._lock:
+                    snapshot = [(app, list(app.deployments.values()))
+                                for app in self._apps.values()]
+                for app, dss in snapshot:
+                    for ds in dss:
+                        changed |= self._reconcile(ds)
+                        changed |= self._probe_and_autoscale(ds)
+                        changed |= self._health_check(ds)
+                    with self._lock:
+                        ready = all(
+                            len(d.replicas) >= min(1, d.target_replicas)
+                            for d in app.deployments.values())
+                        new_status = "RUNNING" if ready else "DEPLOYING"
+                        if new_status != app.status:
+                            app.status = new_status
+                            changed = True
+                if changed:
+                    self._bump()
+            except Exception:  # noqa: BLE001 — keep the loop alive
+                traceback.print_exc()
+            time.sleep(0.1)
+
+    def _reconcile(self, ds: _DeploymentState) -> bool:
+        changed = False
+        # 1. drop replicas whose actor process died (GCS state, cheap and
+        #    immune to a saturated replica thread pool)
+        with self._lock:
+            replicas = list(ds.replicas)
+        dead = [r for r in replicas if _actor_is_dead(r)]
+        if dead:
+            with self._lock:
+                ds.replicas = [r for r in ds.replicas if r not in dead]
+                for r in dead:
+                    ds.health_failures.pop(r.actor_id, None)
+            changed = True
+        # 2. spawn up to target (ready-wait OUTSIDE the lock; re-check
+        #    generation before tracking)
+        while True:
+            with self._lock:
+                if ds.deleted or len(ds.replicas) >= ds.target_replicas:
+                    break
+                gen = ds.generation
+                opts = dict(ds.config.ray_actor_options)
+                opts.setdefault("max_concurrency",
+                                ds.config.max_ongoing_requests)
+            replica = ray_tpu.remote(ReplicaActor).options(**opts).remote(
+                ds.cls_blob, ds.init_args_blob, ds.config.user_config,
+                ds.app_name)
+            try:
+                ray_tpu.get(replica.ready.remote(), timeout=60)
+            except Exception:
+                traceback.print_exc()
+                try:
+                    ray_tpu.kill(replica)
+                except Exception:
+                    pass
+                break
+            with self._lock:
+                if ds.deleted or ds.generation != gen:
+                    stale = True
+                else:
+                    ds.replicas.append(replica)
+                    stale = False
+                    changed = True
+            if stale:
+                try:
+                    ray_tpu.kill(replica)
+                except Exception:
+                    pass
+                break
+        # 3. scale down with graceful drain
+        with self._lock:
+            excess = []
+            while len(ds.replicas) > ds.target_replicas:
+                excess.append(ds.replicas.pop())
+            grace = ds.config.graceful_shutdown_timeout_s
+        for r in excess:
+            self._drain_and_kill(r, grace)
+            changed = True
+        return changed
+
+    def _drain_and_kill(self, replica, grace_s: float):
+        """Wait (async) for in-flight requests to finish, then kill
+        (reference: replica graceful_shutdown loop)."""
+
+        def drain():
+            deadline = time.monotonic() + grace_s
+            while time.monotonic() < deadline:
+                try:
+                    if ray_tpu.get(replica.queue_len.remote(),
+                                   timeout=5) == 0:
+                        break
+                except Exception:
+                    break
+                time.sleep(0.2)
+            try:
+                ray_tpu.kill(replica)
+            except Exception:
+                pass
+
+        if grace_s <= 0:
+            try:
+                ray_tpu.kill(replica)
+            except Exception:
+                pass
+        else:
+            threading.Thread(target=drain, daemon=True).start()
+
+    def _probe_and_autoscale(self, ds: _DeploymentState) -> bool:
+        """One concurrent queue_len probe round per ~0.5s serves the
+        autoscaler; saturated replicas that miss the probe deadline are
+        counted at max_ongoing_requests (they are busy, not dead)."""
+        ac = ds.config.autoscaling_config
+        if ac is None:
+            return False
+        now = time.monotonic()
+        if now - ds.last_probe < 0.5:
+            return False
+        ds.last_probe = now
+        with self._lock:
+            replicas = list(ds.replicas)
+        if replicas:
+            refs = [r.queue_len.remote() for r in replicas]
+            ready, not_ready = ray_tpu.wait(
+                refs, num_returns=len(refs), timeout=2.0)
+            loads = []
+            for ref in refs:
+                if ref in ready:
+                    try:
+                        loads.append(ray_tpu.get(ref))
+                    except Exception:
+                        loads.append(0)
+                else:
+                    loads.append(ds.config.max_ongoing_requests)
+            total = float(sum(loads))
+        else:
+            total = 0.0
+        # scale-from-zero pressure from handles (expires after 5s)
+        if ds.pending_reports and now - ds.pending_ts < 5.0:
+            total += ds.pending_reports
+        desired = max(
+            ac.min_replicas,
+            min(ac.max_replicas,
+                int(-(-total // max(ac.target_ongoing_requests, 1e-9)))))
+        changed = False
+        with self._lock:
+            if desired > ds.target_replicas:
+                ds.under_since = None
+                if ds.over_since is None:
+                    ds.over_since = now
+                if now - ds.over_since >= ac.upscale_delay_s:
+                    ds.target_replicas = desired
+                    ds.over_since = None
+                    changed = True
+            elif desired < ds.target_replicas:
+                ds.over_since = None
+                if ds.under_since is None:
+                    ds.under_since = now
+                if now - ds.under_since >= ac.downscale_delay_s:
+                    ds.target_replicas = desired
+                    ds.under_since = None
+                    changed = True
+            else:
+                ds.over_since = ds.under_since = None
+        return changed
+
+    def _health_check(self, ds: _DeploymentState) -> bool:
+        """Run user health checks every health_check_period_s.  Probe
+        timeouts (saturated pool) do NOT count as failures — only explicit
+        exceptions do; process death is handled by the GCS path."""
+        period = ds.config.health_check_period_s
+        now = time.monotonic()
+        if period <= 0 or now - ds.last_health < period:
+            return False
+        ds.last_health = now
+        with self._lock:
+            replicas = list(ds.replicas)
+        if not replicas:
+            return False
+        refs = [r.check_health.remote() for r in replicas]
+        ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=2.0)
+        to_replace = []
+        for r, ref in zip(replicas, refs):
+            if ref not in ready:
+                continue  # busy, not unhealthy
+            try:
+                ray_tpu.get(ref)
+                ds.health_failures.pop(r.actor_id, None)
+            except Exception:
+                n = ds.health_failures.get(r.actor_id, 0) + 1
+                ds.health_failures[r.actor_id] = n
+                if n >= 3:
+                    to_replace.append(r)
+        if not to_replace:
+            return False
+        with self._lock:
+            ds.replicas = [r for r in ds.replicas if r not in to_replace]
+            for r in to_replace:
+                ds.health_failures.pop(r.actor_id, None)
+        for r in to_replace:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        return True

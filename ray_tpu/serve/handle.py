@@ -1,0 +1,179 @@
+"""DeploymentHandle: the client-side router to a deployment's replicas.
+
+Counterpart of the reference's handle + router
+(/root/reference/python/ray/serve/handle.py:340 DeploymentHandle,
+_private/router.py:341, _private/request_router/pow_2_router.py:27
+PowerOfTwoChoicesRequestRouter): a handle keeps a cached replica set
+(refreshed from the controller when its version bumps) and picks, per
+request, the less-loaded of two random replicas — load = this handle's own
+in-flight count per replica, the same queue-len signal the reference probes.
+Handles are plain data (app/deployment names) and can be pickled into other
+replicas for model composition.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core.object_ref import ObjectRef
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class DeploymentResponse:
+    """Future-ish result of handle.remote() (reference: handle.py
+    DeploymentResponse).  Passing a response as an argument to another
+    handle call forwards the underlying ObjectRef, so the downstream
+    replica resolves it from the object store without a driver round-trip.
+    """
+
+    def __init__(self, ref: ObjectRef, on_done=None):
+        self._ref = ref
+        self._on_done = on_done
+        self._done = False
+
+    def result(self, timeout_s: Optional[float] = None):
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout_s)
+        finally:
+            self._settle()
+
+    def _to_object_ref(self) -> ObjectRef:
+        self._settle()
+        return self._ref
+
+    def _settle(self):
+        if not self._done and self._on_done is not None:
+            self._done = True
+            self._on_done()
+
+    def __del__(self):
+        # Fire-and-forget callers never invoke result(); settle on GC so
+        # the handle's per-replica in-flight counters don't skew routing.
+        try:
+            self._settle()
+        except Exception:
+            pass
+
+
+class _MethodCaller:
+    def __init__(self, handle: "DeploymentHandle", method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._handle._call(self._method, args, kwargs)
+
+
+class DeploymentHandle:
+    def __init__(self, app_name: str, deployment_name: str):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self._replicas: List[Any] = []
+        self._version = -1
+        self._inflight: Dict[bytes, int] = defaultdict(int)
+        self._lock = threading.Lock()
+        self._last_refresh = 0.0
+
+    # -- replica set maintenance -----------------------------------------
+
+    def _controller(self):
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        with self._lock:
+            if (self._replicas and not force
+                    and now - self._last_refresh < 1.0):
+                return
+        info = ray_tpu.get(self._controller().get_replicas.remote(
+            self.app_name, self.deployment_name, self._version))
+        with self._lock:
+            self._replicas = info["replicas"]
+            self._version = info["version"]
+            self._last_refresh = now
+            # prune counters for replicas that left the set
+            current = {r.actor_id for r in self._replicas}
+            for rid in list(self._inflight):
+                if rid not in current and self._inflight[rid] <= 0:
+                    del self._inflight[rid]
+
+    # -- routing ----------------------------------------------------------
+
+    def _choose(self):
+        """Power-of-two-choices on this handle's per-replica in-flight count
+        (reference: pow_2_router.py choose_replicas)."""
+        with self._lock:
+            reps = list(self._replicas)
+        if not reps:
+            raise RuntimeError(
+                f"deployment {self.deployment_name} has no running replicas")
+        if len(reps) == 1:
+            return reps[0]
+        a, b = random.sample(reps, 2)
+        with self._lock:
+            return a if (self._inflight[a.actor_id]
+                         <= self._inflight[b.actor_id]) else b
+
+    def _call(self, method: str, args, kwargs) -> DeploymentResponse:
+        deadline = time.monotonic() + 30.0
+        reported = False
+        while True:
+            self._refresh()
+            try:
+                replica = self._choose()
+                break
+            except RuntimeError:
+                if time.monotonic() > deadline:
+                    raise
+                if not reported:
+                    # scale-from-zero signal (reference: handles push queue
+                    # metrics to the controller's autoscaling state)
+                    try:
+                        self._controller().report_no_replica.remote(
+                            self.app_name, self.deployment_name, 1)
+                    except Exception:
+                        pass
+                    reported = True
+                time.sleep(0.2)
+                self._refresh(force=True)
+        # unwrap DeploymentResponses into raw refs (composition fast path)
+        args = tuple(a._to_object_ref()
+                     if isinstance(a, DeploymentResponse) else a
+                     for a in args)
+        kwargs = {k: (v._to_object_ref()
+                      if isinstance(v, DeploymentResponse) else v)
+                  for k, v in kwargs.items()}
+        rid = replica.actor_id
+        with self._lock:
+            self._inflight[rid] += 1
+
+        def done():
+            with self._lock:
+                self._inflight[rid] -= 1
+
+        ref = replica.handle_request.remote(method, args, kwargs)
+        return DeploymentResponse(ref, on_done=done)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._call("__call__", args, kwargs)
+
+    def options(self, **_ignored) -> "DeploymentHandle":
+        return self
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _MethodCaller(self, name)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.app_name, self.deployment_name))
+
+    def __repr__(self):
+        return (f"DeploymentHandle(app={self.app_name!r}, "
+                f"deployment={self.deployment_name!r})")

@@ -1,0 +1,135 @@
+"""HTTP proxy actor: routes requests to deployment replicas.
+
+Counterpart of the reference's proxy
+(/root/reference/python/ray/serve/_private/proxy.py HTTPProxy :709): an
+aiohttp server inside a dedicated actor.  It watches the controller's
+routing table via long-poll, matches the longest route prefix, parses the
+body (JSON when content-type says so), and dispatches to the app's ingress
+deployment handle on an executor thread (handle calls block on the object
+store).  Responses: dict/list → JSON, str → text, bytes → raw.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve.handle import CONTROLLER_NAME, DeploymentHandle
+
+
+class ProxyActor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host = host
+        self._port = port
+        self._routes: Dict[str, dict] = {}
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._version = -1
+        self._ready = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        self._watcher = threading.Thread(target=self._watch, daemon=True)
+        self._watcher.start()
+
+    # -- control plane ----------------------------------------------------
+
+    def _watch(self):
+        """Long-poll the routing table (reference: proxies subscribe to
+        LongPollHost route updates)."""
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        while True:
+            try:
+                info = ray_tpu.get(controller.get_routing_table.remote(
+                    self._version, 10.0), timeout=30)
+                self._routes = info["routes"]
+                self._version = info["version"]
+            except Exception:
+                import time
+
+                time.sleep(1.0)
+
+    def _handle_for(self, prefix: str) -> DeploymentHandle:
+        route = self._routes[prefix]
+        key = f"{route['app']}:{route['ingress']}"
+        h = self._handles.get(key)
+        if h is None:
+            h = DeploymentHandle(route["app"], route["ingress"])
+            self._handles[key] = h
+        return h
+
+    # -- data plane -------------------------------------------------------
+
+    def _serve(self):
+        from aiohttp import web
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+
+        async def dispatch(request: web.Request) -> web.StreamResponse:
+            path = request.path
+            if path == "/-/healthz":
+                return web.Response(text="ok")
+            if path == "/-/routes":
+                return web.json_response(
+                    {p: r["app"] for p, r in self._routes.items()})
+            # longest-prefix match (reference: proxy route matching)
+            match = None
+            for prefix in sorted(self._routes, key=len, reverse=True):
+                if path == prefix or path.startswith(
+                        prefix.rstrip("/") + "/") or prefix == "/":
+                    match = prefix
+                    break
+            if match is None:
+                return web.json_response(
+                    {"error": f"no route for {path}"}, status=404)
+            body = await request.read()
+            arg: Any = None
+            if body:
+                ctype = request.headers.get("content-type", "")
+                if "json" in ctype or body[:1] in (b"{", b"["):
+                    try:
+                        arg = json.loads(body)
+                    except json.JSONDecodeError:
+                        arg = body
+                else:
+                    arg = body
+            elif request.query:
+                arg = dict(request.query)
+            handle = self._handle_for(match)
+
+            def call():
+                resp = (handle.remote(arg) if arg is not None
+                        else handle.remote())
+                return resp.result(timeout_s=60)
+
+            try:
+                out = await loop.run_in_executor(None, call)
+            except Exception as e:  # noqa: BLE001 — surface to client
+                return web.json_response(
+                    {"error": type(e).__name__, "detail": str(e)},
+                    status=500)
+            if isinstance(out, bytes):
+                return web.Response(body=out)
+            if isinstance(out, str):
+                return web.Response(text=out)
+            return web.json_response(out)
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", dispatch)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, self._host, self._port)
+        loop.run_until_complete(site.start())
+        self._port = site._server.sockets[0].getsockname()[1]
+        self._ready.set()
+        loop.run_forever()
+
+    def get_port(self) -> int:
+        self._ready.wait(timeout=30)
+        return self._port
+
+    def ready(self) -> str:
+        self._ready.wait(timeout=30)
+        return "ok"

@@ -1,0 +1,99 @@
+"""Replica actor: hosts one instance of a deployment's user callable.
+
+Counterpart of the reference's replica runtime
+(/root/reference/python/ray/serve/_private/replica.py): constructs the user
+class, tracks ongoing-request count (the router's and autoscaler's load
+signal), runs optional user health checks and reconfigure(user_config).
+"""
+
+from __future__ import annotations
+
+import inspect
+import threading
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+
+def _resolve_handles(obj, app_name: str):
+    """Replace {"__serve_handle__": name} placeholders from the bound DAG
+    with live DeploymentHandles (composition — reference: deployments
+    receive handles to their bound children)."""
+    from ray_tpu.serve.handle import DeploymentHandle
+
+    if isinstance(obj, dict):
+        if set(obj) == {"__serve_handle__"}:
+            return DeploymentHandle(app_name, obj["__serve_handle__"])
+        return {k: _resolve_handles(v, app_name) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_resolve_handles(v, app_name) for v in obj)
+    return obj
+
+
+class ReplicaActor:
+    def __init__(self, serialized_cls: bytes, init_args: bytes,
+                 user_config: Optional[dict] = None,
+                 app_name: str = "default"):
+        cls = cloudpickle.loads(serialized_cls)
+        args, kwargs = cloudpickle.loads(init_args)
+        args = _resolve_handles(args, app_name)
+        kwargs = _resolve_handles(kwargs, app_name)
+        self._user = cls(*args, **kwargs)
+        self._ongoing = 0
+        self._lock = threading.Lock()
+        self._total = 0
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    def ready(self) -> str:
+        return "ok"
+
+    def handle_request(self, method: str, args, kwargs):
+        # Resolve forwarded DeploymentResponse refs (composition chaining):
+        # they arrive nested inside the args tuple, below the worker's
+        # top-level arg resolution.
+        import ray_tpu
+        from ray_tpu.core.object_ref import ObjectRef
+
+        args = tuple(ray_tpu.get(a) if isinstance(a, ObjectRef) else a
+                     for a in args)
+        kwargs = {k: ray_tpu.get(v) if isinstance(v, ObjectRef) else v
+                  for k, v in kwargs.items()}
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            target = (self._user if method == "__call__"
+                      else getattr(self._user, method))
+            if method == "__call__" and not callable(self._user):
+                raise AttributeError(
+                    f"{type(self._user).__name__} is not callable; "
+                    f"call a method instead")
+            out = target(*args, **kwargs)
+            if inspect.iscoroutine(out):
+                import asyncio
+
+                out = asyncio.run(out)
+            return out
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def queue_len(self) -> int:
+        return self._ongoing
+
+    def stats(self) -> Dict[str, Any]:
+        return {"ongoing": self._ongoing, "total": self._total}
+
+    def check_health(self) -> str:
+        fn = getattr(self._user, "check_health", None)
+        if fn is not None:
+            fn()
+        return "ok"
+
+    def reconfigure(self, user_config: dict) -> str:
+        fn = getattr(self._user, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+        return "ok"
