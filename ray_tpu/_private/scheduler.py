@@ -40,6 +40,21 @@ TASK = "task"
 ACTOR_CREATION = "actor_creation"
 ACTOR_METHOD = "actor_method"
 
+# Scheduler event tracing for debugging scheduling/routing issues: set
+# RTPU_DEBUG_SCHED to a file path.  Call sites are gated on _DEBUG_SCHED so
+# the hot dispatch path pays a single falsy check when disabled.
+_DEBUG_SCHED = os.environ.get("RTPU_DEBUG_SCHED")
+
+
+def _dbg(msg):
+    # best-effort only: a debug sink failure (bad path, full disk) must
+    # never abort scheduler state transitions mid-mutation
+    try:
+        with open(_DEBUG_SCHED, "a") as f:
+            f.write(f"{time.time():.3f} {msg}\n")
+    except OSError:
+        pass
+
 
 @dataclass
 class TaskSpec:
@@ -437,6 +452,10 @@ class Scheduler:
             if spec is None:
                 return
             if spec.kind == ACTOR_CREATION:
+                if _DEBUG_SCHED:
+                    _dbg(f"done CREATE actor={spec.actor_id.hex()[:8]} "
+                         f"worker={worker.worker_id.hex()[:8]} "
+                         f"ok={msg['ok']} err={msg.get('error')}")
                 if msg["ok"]:
                     self.gcs.update_actor(spec.actor_id, state=gcs_mod.ALIVE,
                                           worker_id=worker.worker_id)
@@ -459,6 +478,10 @@ class Scheduler:
                 return
             worker.alive = False
             worker.idle = False
+            if _DEBUG_SCHED:
+                _dbg(f"worker DEATH {worker.worker_id.hex()[:8]} "
+                     f"actor={worker.actor_id.hex()[:8] if worker.actor_id else None} "
+                     f"inflight={[s.name for s in worker.in_flight.values()]}")
             self._release_worker_grants(worker)
             in_flight = list(worker.in_flight.values())
             worker.in_flight.clear()
@@ -604,6 +627,10 @@ class Scheduler:
                     remaining.append(spec)
                     continue
                 w.in_flight[spec.task_id] = spec
+                if _DEBUG_SCHED:
+                    _dbg(f"dispatch METHOD {spec.name} "
+                         f"actor={spec.actor_id.hex()[:8]} "
+                         f"-> worker={worker_id.hex()[:8]}")
                 self._dispatch(w, spec)
                 progress = True
                 continue
@@ -627,6 +654,10 @@ class Scheduler:
                 w.actor_id = spec.actor_id
                 self._actor_workers[spec.actor_id] = w.worker_id
                 self.gcs.update_actor(spec.actor_id, state=gcs_mod.PENDING_CREATION)
+                if _DEBUG_SCHED:
+                    _dbg(f"dispatch CREATE {spec.name} "
+                         f"actor={spec.actor_id.hex()[:8]} "
+                         f"-> worker={w.worker_id.hex()[:8]}")
             self._dispatch(w, spec)
             progress = True
         self._pending = remaining

@@ -26,16 +26,24 @@ from ray_tpu.exceptions import RayTpuError, TaskError
 _task_error_types: dict[type, type] = {}
 
 
-def _as_raisable(exc: BaseException, tb: str) -> BaseException:
+def _as_raisable(exc: BaseException, tb: str,
+                 raised_by_task: bool = False) -> BaseException:
     """Convert a stored remote exception into the exception to raise locally.
 
     System errors (ActorDiedError, WorkerCrashedError, ...) raise as
-    themselves.  User exceptions raise as a dynamic subclass of both TaskError
-    and the original type, so ``except ValueError`` catches a remote
-    ValueError — same trick as the reference's RayTaskError
+    themselves — UNLESS they were raised by task code (e.g. user code did a
+    ``get`` on a ref owned by a dead upstream actor and the error propagated
+    through): those wrap in the TaskError dual so callers can tell "this
+    actor died" from "this actor ran and re-raised a system error" (the
+    Serve handle uses this to avoid failing over a healthy replica).  User
+    exceptions raise as a dynamic subclass of both TaskError and the
+    original type, so ``except ValueError`` catches a remote ValueError —
+    same trick as the reference's RayTaskError
     (/root/reference/python/ray/exceptions.py make_dual_exception_type).
     """
-    if isinstance(exc, RayTpuError):
+    if isinstance(exc, TaskError):
+        return exc  # already wrapped (e.g. relayed through another task)
+    if isinstance(exc, RayTpuError) and not raised_by_task:
         return exc
     cause_t = type(exc)
     dual = _task_error_types.get(cause_t)
@@ -101,25 +109,28 @@ def write_payload(buf: memoryview, token) -> None:
         buf[1 : 1 + len(blob)] = blob
 
 
-def serialize_error(exc: BaseException, tb: str) -> bytes:
+def serialize_error(exc: BaseException, tb: str,
+                    raised_by_task: bool = False) -> bytes:
     # cloudpickle, not pickle: driver-defined exception classes (__main__)
     # must survive by-value so `except MyError` keeps matching at the caller.
     try:
-        payload = cloudpickle.dumps((exc, tb))
+        payload = cloudpickle.dumps((exc, tb, raised_by_task))
     except Exception:
         # Truly unpicklable exception: degrade to a RuntimeError with repr.
-        payload = cloudpickle.dumps((RuntimeError(repr(exc)), tb))
+        payload = cloudpickle.dumps(
+            (RuntimeError(repr(exc)), tb, raised_by_task))
     return bytes([TAG_ERROR]) + payload
 
 
-def store_error_best_effort(store, oid: bytes, exc: BaseException, tb: str) -> bool:
+def store_error_best_effort(store, oid: bytes, exc: BaseException, tb: str,
+                            raised_by_task: bool = False) -> bool:
     """Write an error payload to the store, degrading rather than leaving the
     return object absent (an absent return hangs blocking ``get``s forever).
     """
     fallback = serialize_error(
         RuntimeError(f"original error unrecordable: {type(exc).__name__}: "
-                     f"{str(exc)[:200]}"), "")
-    for payload in (serialize_error(exc, tb), fallback):
+                     f"{str(exc)[:200]}"), "", raised_by_task)
+    for payload in (serialize_error(exc, tb, raised_by_task), fallback):
         try:
             store.put(oid, payload)
             return True
@@ -152,10 +163,12 @@ def deserialize(view: memoryview, release_cb=None):
             release_cb()
         return value
     if tag == TAG_ERROR:
-        exc, tb = pickle.loads(view[1:])
+        payload = pickle.loads(view[1:])
+        exc, tb = payload[0], payload[1]
+        raised_by_task = payload[2] if len(payload) > 2 else False
         if release_cb:
             release_cb()
-        raise _as_raisable(exc, tb)
+        raise _as_raisable(exc, tb, raised_by_task)
     if tag == TAG_ARRAY:
         (meta_len,) = _U32.unpack(view[1 : 1 + _U32.size])
         off = 1 + _U32.size

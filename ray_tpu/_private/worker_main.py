@@ -161,7 +161,11 @@ class WorkerRuntime:
             ok, error = False, repr(e)
             tb = traceback.format_exc()
             for oid in spec.return_ids:
-                if not store_error_best_effort(self.store, oid, e, tb):
+                # raised_by_task distinguishes "this task ran and raised"
+                # (even a propagated ActorDiedError from an upstream get)
+                # from transport-level failures the scheduler records
+                if not store_error_best_effort(self.store, oid, e, tb,
+                                               raised_by_task=True):
                     print(f"FATAL: could not record error for "
                           f"{oid.hex()[:12]}", file=sys.stderr, flush=True)
         finally:
@@ -171,7 +175,35 @@ class WorkerRuntime:
                         "error": error})
 
 
+def _apply_jax_platform_env():
+    """Honor JAX_PLATFORMS in workers despite eager jax import.
+
+    The interpreter environment may pre-import jax via sitecustomize, which
+    snapshots JAX_PLATFORMS before this process's inherited env is consulted
+    lazily — on such hosts a worker would silently initialize the default
+    (hardware) backend even when the driver pinned the cluster to CPU (e.g.
+    the virtual 8-device CPU mesh used by tests, SURVEY.md §4).  Re-assert
+    the env var through jax.config, which is authoritative at backend init.
+    """
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if not platforms or "jax" not in sys.modules:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
+    except Exception:
+        pass
+
+
 def main():
+    _apply_jax_platform_env()
+    # `ray stack` analogue (reference: scripts.py:2683 py-spy dumps): signal
+    # a worker with SIGUSR1 to dump all thread stacks to stderr.
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
     p = argparse.ArgumentParser()
     p.add_argument("--scheduler-socket", required=True)
     p.add_argument("--store-socket", required=True)

@@ -13,7 +13,13 @@ import os
 # JAX_PLATFORMS env var is already snapshotted — jax.config.update is the
 # effective path.  XLA_FLAGS is read by the XLA client at backend init, which
 # is still lazy, so the env var works for the device count.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+#
+# MUST be a hard overwrite, not setdefault: the host environment pins
+# JAX_PLATFORMS=axon (tunneled TPU), and worker processes inherit os.environ
+# — with setdefault every worker would lazily initialize the axon backend and
+# pay tunnel round-trips on each jitted call (observed: 100x slowdowns in
+# actor-heavy tests).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -23,6 +29,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Persistent compilation cache: the heavyweight jitted programs (e.g. the
+# PPO scan-of-scans update) compile once per machine instead of once per
+# pytest run.  Harmless for correctness — keyed on HLO + flags.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
 
 import pytest  # noqa: E402
 
@@ -31,11 +42,17 @@ import pytest  # noqa: E402
 def ray_cluster():
     import ray_tpu
 
+    # The ONE canonical cluster config for the whole pytest session: module
+    # fixtures depend on this fixture instead of calling init themselves,
+    # so no selection/ordering of test modules can create the cluster with
+    # a different config.  CPU is virtualized (the CI host has 1 real
+    # core); 8 covers the serve tests' controller+proxy+3 replicas.
     node = ray_tpu.init(
         min_workers=2,
         max_workers=8,
         object_store_memory=1 << 28,
-        resources={"CPU": 4.0},  # virtualized: the CI host has 1 real core
+        resources={"CPU": 8.0},
+        ignore_reinit_error=True,
     )
     yield node
     ray_tpu.shutdown()

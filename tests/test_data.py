@@ -12,10 +12,10 @@ from ray_tpu import data as rd
 
 
 @pytest.fixture(scope="module", autouse=True)
-def _cluster():
-    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+def _cluster(ray_cluster):
+    # join the session cluster (conftest.ray_cluster owns the
+    # canonical config); never shut down here
     yield
-    ray_tpu.shutdown()
 
 
 def test_range_count_take():

@@ -13,10 +13,10 @@ from ray_tpu.train.config import RunConfig
 
 
 @pytest.fixture(scope="module", autouse=True)
-def _cluster():
-    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+def _cluster(ray_cluster):
+    # join the session cluster (conftest.ray_cluster owns the
+    # canonical config); never shut down here
     yield
-    ray_tpu.shutdown()
 
 
 def test_grid_and_random_search_space():
@@ -108,6 +108,20 @@ def test_checkpointing_and_pbt(tmp_path):
             start, inherited = state["step"], state.get("factor")
         factor = config["factor"]
         score = inherited if inherited is not None else 0.0
+        if start == 0:
+            # start barrier: PBT can only exploit if the trials overlap in
+            # time, but worker spawn (~2s jax import) can exceed a whole
+            # trial's runtime on a loaded 1-core host — without this the
+            # weak trial can finish before the strong one starts
+            os.makedirs(config["tmp"], exist_ok=True)
+            open(os.path.join(config["tmp"], f"started_{factor}"), "w").close()
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                started = [f for f in os.listdir(config["tmp"])
+                           if f.startswith("started_")]
+                if len(started) >= 2:
+                    break
+                time.sleep(0.05)
         for step in range(start, start + 20):
             time.sleep(0.05)  # pace reports so the controller interleaves
             score = score + factor
