@@ -18,12 +18,43 @@ from ray_tpu.serve.proxy import ProxyActor
 _PROXY_NAME = "SERVE_PROXY"
 
 
+def _get_or_create_named(name: str, ping, create):
+    """Resolve actor `name`, or create it via `create()` if absent.
+
+    kill is async: after serve.shutdown() a name can briefly resolve to a
+    dying actor, so `ping(handle)` (must raise on a corpse) gates every
+    resolved handle, and we wait out the name-cleanup race rather than
+    using a dead system actor.  `create()` may raise ValueError on a lost
+    name race with a concurrent creator; that retries too.
+    """
+    deadline = time.monotonic() + 15.0
+    while True:
+        try:
+            existing = ray_tpu.get_actor(name)
+        except Exception:
+            existing = None
+        if existing is not None:
+            try:
+                ping(existing)
+                return existing
+            except Exception:
+                pass  # dying/dead: wait for the name to clear
+        else:
+            try:
+                return create()
+            except ValueError:
+                pass  # lost a name race with a concurrent creator
+        if time.monotonic() > deadline:
+            raise RuntimeError(f"could not obtain a live {name} actor")
+        time.sleep(0.2)
+
+
 def _get_or_create_controller():
-    try:
-        return ray_tpu.get_actor(CONTROLLER_NAME)
-    except Exception:
-        return ray_tpu.remote(ServeController).options(
-            name=CONTROLLER_NAME, max_concurrency=32).remote()
+    return _get_or_create_named(
+        CONTROLLER_NAME,
+        ping=lambda c: ray_tpu.get(c.get_http_port.remote(), timeout=10),
+        create=lambda: ray_tpu.remote(ServeController).options(
+            name=CONTROLLER_NAME, max_concurrency=32).remote())
 
 
 def start(http_host: str = "127.0.0.1", http_port: int = 0,
@@ -31,14 +62,16 @@ def start(http_host: str = "127.0.0.1", http_port: int = 0,
     """Start Serve system actors (controller + HTTP proxy)."""
     controller = _get_or_create_controller()
     if proxy:
-        try:
-            ray_tpu.get_actor(_PROXY_NAME)
-        except Exception:
-            p = ray_tpu.remote(ProxyActor).options(
+        p = _get_or_create_named(
+            _PROXY_NAME,
+            ping=lambda pr: ray_tpu.get(pr.get_port.remote(), timeout=10),
+            create=lambda: ray_tpu.remote(ProxyActor).options(
                 name=_PROXY_NAME, max_concurrency=16).remote(
-                http_host, http_port)
-            port = ray_tpu.get(p.get_port.remote(), timeout=60)
-            ray_tpu.get(controller.set_http_port.remote(port), timeout=30)
+                http_host, http_port))
+        # register unconditionally: the controller may be fresh (recreated
+        # after a shutdown that left the proxy alive) and not know the port
+        port = ray_tpu.get(p.get_port.remote(), timeout=60)
+        ray_tpu.get(controller.set_http_port.remote(port), timeout=30)
     return controller
 
 
@@ -56,6 +89,12 @@ def run(app: Application, *, name: str = "default",
                              timeout=30)
         if status["status"] == "RUNNING":
             return DeploymentHandle(name, ingress)
+        if status["status"] == "DEPLOY_FAILED":
+            errs = {d: s["last_error"]
+                    for d, s in status["deployments"].items()
+                    if s.get("last_error")}
+            raise RuntimeError(
+                f"application {name!r} failed to deploy: {errs}")
         time.sleep(0.1)
     raise TimeoutError(
         f"application {name!r} did not become RUNNING: {status}")
@@ -111,3 +150,13 @@ def shutdown():
             ray_tpu.kill(ray_tpu.get_actor(actor_name))
         except Exception:
             pass
+    # kill is async; wait for the names to clear so a subsequent
+    # serve.start() cannot resolve a dying controller/proxy
+    for actor_name in (_PROXY_NAME, CONTROLLER_NAME):
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            try:
+                ray_tpu.get_actor(actor_name)
+            except Exception:
+                break
+            time.sleep(0.1)

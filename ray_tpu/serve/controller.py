@@ -46,6 +46,12 @@ class _DeploymentState:
     target_replicas: int = 1
     replicas: List[Any] = field(default_factory=list)  # ActorHandles
     deleted: bool = False
+    # replicas spawned but not yet ready: (handle, ready_ref, deadline)
+    starting: List[Any] = field(default_factory=list)
+    # replica-creation failure tracking (backoff + deploy-failed surface)
+    failures: int = 0
+    last_error: str = ""
+    next_attempt: float = 0.0
     # autoscaling bookkeeping
     over_since: Optional[float] = None
     under_since: Optional[float] = None
@@ -64,6 +70,7 @@ class _AppState:
     name: str
     route_prefix: str
     ingress: str
+    http_method: str = "__call__"
     deployments: Dict[str, _DeploymentState] = field(default_factory=dict)
     status: str = "DEPLOYING"
 
@@ -93,10 +100,20 @@ class ServeController:
 
     def deploy_application(self, name: str, route_prefix: str,
                            ingress: str, deployments: List[dict]) -> str:
+        http_method = "__call__"
+        for spec in deployments:
+            if spec["name"] == ingress:
+                http_method = spec.get("http_method", "__call__")
         with self._lock:
+            for other in self._apps.values():
+                if other.name != name and other.route_prefix == route_prefix:
+                    raise ValueError(
+                        f"route_prefix {route_prefix!r} is already used by "
+                        f"application {other.name!r} (reference Serve also "
+                        f"rejects duplicate prefixes at deploy time)")
             old = self._apps.get(name)
             app = _AppState(name=name, route_prefix=route_prefix,
-                            ingress=ingress)
+                            ingress=ingress, http_method=http_method)
             for spec in deployments:
                 cfg: DeploymentConfig = cloudpickle.loads(spec["config"])
                 prev = (old.deployments.get(spec["name"])
@@ -116,7 +133,8 @@ class ServeController:
                 for ds in old.deployments.values():
                     ds.deleted = True
                     drained.extend(ds.replicas)
-                    ds.replicas = []
+                    drained.extend(r for r, _, _ in ds.starting)
+                    ds.replicas, ds.starting = [], []
         for r in drained:
             self._drain_and_kill(r, 0.0)  # old code, no graceful drain
         self._bump()
@@ -130,7 +148,8 @@ class ServeController:
                 for ds in app.deployments.values():
                     ds.deleted = True
                     drained.extend(ds.replicas)
-                    ds.replicas = []
+                    drained.extend(r for r, _, _ in ds.starting)
+                    ds.replicas, ds.starting = [], []
         for r in drained:
             self._drain_and_kill(r, 0.0)
         if app is not None:
@@ -152,8 +171,7 @@ class ServeController:
 
     # ------------------------- read API -----------------------------------
 
-    def get_replicas(self, app_name: str, deployment: str,
-                     known_version: int = -1) -> dict:
+    def get_replicas(self, app_name: str, deployment: str) -> dict:
         with self._lock:
             app = self._apps.get(app_name)
             ds = app.deployments.get(deployment) if app else None
@@ -185,6 +203,7 @@ class ServeController:
         with self._lock:
             routes = {app.route_prefix: {"app": app.name,
                                          "ingress": app.ingress,
+                                         "http_method": app.http_method,
                                          "status": app.status}
                       for app in self._apps.values()}
             return {"routes": routes, "version": self._version}
@@ -195,10 +214,15 @@ class ServeController:
             if app is None:
                 return {"status": "NOT_FOUND"}
             detail = {}
+            failed = False
             for ds in app.deployments.values():
                 detail[ds.name] = {"target": ds.target_replicas,
-                                   "running": len(ds.replicas)}
-            return {"status": app.status, "deployments": detail}
+                                   "running": len(ds.replicas),
+                                   "failures": ds.failures,
+                                   "last_error": ds.last_error}
+                failed |= ds.failures >= 3
+            status = "DEPLOY_FAILED" if failed else app.status
+            return {"status": status, "deployments": detail}
 
     # ------------------------- reconcile loop ------------------------------
 
@@ -246,41 +270,79 @@ class ServeController:
                 for r in dead:
                     ds.health_failures.pop(r.actor_id, None)
             changed = True
-        # 2. spawn up to target (ready-wait OUTSIDE the lock; re-check
-        #    generation before tracking)
-        while True:
-            with self._lock:
-                if ds.deleted or len(ds.replicas) >= ds.target_replicas:
-                    break
-                gen = ds.generation
-                opts = dict(ds.config.ray_actor_options)
-                opts.setdefault("max_concurrency",
-                                ds.config.max_ongoing_requests)
+        # 2. poll replicas that are still starting (non-blocking — one slow
+        #    init must not stall other deployments; the reference controller
+        #    likewise starts replicas concurrently and polls readiness)
+        now = time.monotonic()
+        with self._lock:
+            starting = list(ds.starting)
+        for entry in starting:
+            replica, ready_ref, deadline = entry
+            ready, _ = ray_tpu.wait([ready_ref], num_returns=1, timeout=0)
+            if ready:
+                with self._lock:
+                    if entry not in ds.starting:
+                        # a concurrent delete/redeploy drained this entry
+                        # and owns killing its replica
+                        continue
+                    ds.starting.remove(entry)
+                try:
+                    ray_tpu.get(ready_ref)
+                except Exception as e:  # noqa: BLE001
+                    self._note_failure(ds, e)
+                    self._kill_quiet(replica)
+                    continue
+                with self._lock:
+                    ds.failures = 0
+                    ds.last_error = ""
+                    if ds.deleted or len(ds.replicas) >= ds.target_replicas:
+                        stale = True
+                    else:
+                        ds.replicas.append(replica)
+                        stale = False
+                        changed = True
+                if stale:
+                    self._kill_quiet(replica)
+            elif now > deadline:
+                with self._lock:
+                    if entry not in ds.starting:
+                        continue
+                    ds.starting.remove(entry)
+                self._note_failure(
+                    ds, TimeoutError("replica start timed out"))
+                self._kill_quiet(replica)
+        # 3. spawn (without blocking) up to target, honoring the failure
+        #    backoff.  get() on creation args happens on the worker side.
+        with self._lock:
+            # >=3 consecutive creation failures surfaces DEPLOY_FAILED
+            # (get_application_status), but the spawn loop keeps retrying
+            # on the capped backoff (30s once failing persistently): a
+            # permanently broken deployment churns at most one worker
+            # process per backoff period, while a previously healthy app
+            # hit by transient failures self-heals without a redeploy
+            # (reference Serve likewise never stops reconciling).
+            want = (0 if ds.deleted or now < ds.next_attempt
+                    else ds.target_replicas - len(ds.replicas)
+                    - len(ds.starting))
+            opts = dict(ds.config.ray_actor_options)
+            opts.setdefault("max_concurrency",
+                            ds.config.max_ongoing_requests)
+        for _ in range(max(0, want)):
             replica = ray_tpu.remote(ReplicaActor).options(**opts).remote(
                 ds.cls_blob, ds.init_args_blob, ds.config.user_config,
                 ds.app_name)
-            try:
-                ray_tpu.get(replica.ready.remote(), timeout=60)
-            except Exception:
-                traceback.print_exc()
-                try:
-                    ray_tpu.kill(replica)
-                except Exception:
-                    pass
-                break
             with self._lock:
-                if ds.deleted or ds.generation != gen:
-                    stale = True
+                if ds.deleted:
+                    # deleted between the `want` computation and now: the
+                    # drain already ran, so this entry would never be
+                    # polled again — kill instead of leaking the actor
+                    stale_spawn = True
                 else:
-                    ds.replicas.append(replica)
-                    stale = False
-                    changed = True
-            if stale:
-                try:
-                    ray_tpu.kill(replica)
-                except Exception:
-                    pass
-                break
+                    ds.starting.append((replica, replica.ready.remote(),
+                                        now + 120.0))
+                    stale_spawn = False
+            if stale_spawn:
+                self._kill_quiet(replica)
         # 3. scale down with graceful drain
         with self._lock:
             excess = []
@@ -291,6 +353,22 @@ class ServeController:
             self._drain_and_kill(r, grace)
             changed = True
         return changed
+
+    def _note_failure(self, ds: _DeploymentState, exc: BaseException):
+        # not always called from an except block (e.g. start timeouts), so
+        # log the passed exception, not the (possibly absent) active one
+        traceback.print_exception(type(exc), exc, exc.__traceback__)
+        with self._lock:
+            ds.failures += 1
+            ds.last_error = repr(exc)
+            ds.next_attempt = time.monotonic() + min(
+                0.2 * (2 ** ds.failures), 30.0)
+
+    def _kill_quiet(self, replica):
+        try:
+            ray_tpu.kill(replica)
+        except Exception:
+            pass
 
     def _drain_and_kill(self, replica, grace_s: float):
         """Wait (async) for in-flight requests to finish, then kill
@@ -306,16 +384,10 @@ class ServeController:
                 except Exception:
                     break
                 time.sleep(0.2)
-            try:
-                ray_tpu.kill(replica)
-            except Exception:
-                pass
+            self._kill_quiet(replica)
 
         if grace_s <= 0:
-            try:
-                ray_tpu.kill(replica)
-            except Exception:
-                pass
+            self._kill_quiet(replica)
         else:
             threading.Thread(target=drain, daemon=True).start()
 
@@ -411,8 +483,5 @@ class ServeController:
             for r in to_replace:
                 ds.health_failures.pop(r.actor_id, None)
         for r in to_replace:
-            try:
-                ray_tpu.kill(r)
-            except Exception:
-                pass
+            self._kill_quiet(r)
         return True

@@ -115,6 +115,13 @@ def flatten_app(app: Application, app_name: str) -> Tuple[str, List[dict]]:
             "init_args_blob": cloudpickle.dumps((args, kwargs)),
             "config": cloudpickle.dumps(dep.config),
         }
+        # Path-aware ingress: a deployment exposing handle_http(request)
+        # receives {path, method, body, query} instead of just the body
+        # (reference: serve replicas receive the full ASGI scope).  Recorded
+        # here so every deploy path (run(), config deploys, direct
+        # controller calls) carries it in the spec.
+        if hasattr(dep._cls, "handle_http"):
+            spec["http_method"] = "handle_http"
         prev = specs.get(name)
         if prev is None:
             specs[name] = spec

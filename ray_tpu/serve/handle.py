@@ -32,14 +32,35 @@ class DeploymentResponse:
     replica resolves it from the object store without a driver round-trip.
     """
 
-    def __init__(self, ref: ObjectRef, on_done=None):
+    def __init__(self, ref: ObjectRef, on_done=None, retry=None):
         self._ref = ref
         self._on_done = on_done
+        self._retry = retry  # () -> new ObjectRef on a fresh replica
         self._done = False
 
     def result(self, timeout_s: Optional[float] = None):
+        from ray_tpu.exceptions import (ActorDiedError, TaskError,
+                                        WorkerCrashedError)
+
+        attempts = 3
         try:
-            return ray_tpu.get(self._ref, timeout=timeout_s)
+            while True:
+                try:
+                    return ray_tpu.get(self._ref, timeout=timeout_s)
+                except (ActorDiedError, WorkerCrashedError) as e:
+                    # Routed from a stale cache to a dead replica: fail
+                    # over to a live one (reference: router retries on
+                    # replica death).  A TaskError dual means the replica
+                    # is alive and its code re-raised an upstream system
+                    # error (e.g. get on a dead composed deployment) —
+                    # re-executing on another replica can't help and may
+                    # duplicate side effects.
+                    if isinstance(e, TaskError):
+                        raise
+                    attempts -= 1
+                    if self._retry is None or attempts <= 0:
+                        raise
+                    self._ref = self._retry()
         finally:
             self._settle()
 
@@ -92,7 +113,7 @@ class DeploymentHandle:
                     and now - self._last_refresh < 1.0):
                 return
         info = ray_tpu.get(self._controller().get_replicas.remote(
-            self.app_name, self.deployment_name, self._version))
+            self.app_name, self.deployment_name))
         with self._lock:
             self._replicas = info["replicas"]
             self._version = info["version"]
@@ -150,15 +171,43 @@ class DeploymentHandle:
                       if isinstance(v, DeploymentResponse) else v)
                   for k, v in kwargs.items()}
         rid = replica.actor_id
+        state = {"rid": rid}
         with self._lock:
             self._inflight[rid] += 1
 
         def done():
             with self._lock:
-                self._inflight[rid] -= 1
+                self._inflight[state["rid"]] -= 1
+
+        def retry():
+            # Failover must WAIT for the controller to notice the death and
+            # start a replacement (its reconcile tick is ~100ms; a replica
+            # restart takes seconds) — an immediate re-pick would just find
+            # the same dead replica in the cache and burn all attempts in
+            # microseconds.
+            deadline = time.monotonic() + 15.0
+            while True:
+                self._refresh(force=True)
+                try:
+                    rep = self._choose()
+                except RuntimeError:
+                    rep = None
+                if rep is not None and rep.actor_id != state["rid"]:
+                    # move the in-flight accounting to the new replica so
+                    # pow-2 routing sees the failed-over load
+                    with self._lock:
+                        self._inflight[state["rid"]] -= 1
+                        self._inflight[rep.actor_id] += 1
+                    state["rid"] = rep.actor_id
+                    return rep.handle_request.remote(method, args, kwargs)
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"deployment {self.deployment_name}: no replacement "
+                        f"replica appeared for failover")
+                time.sleep(0.25)
 
         ref = replica.handle_request.remote(method, args, kwargs)
-        return DeploymentResponse(ref, on_done=done)
+        return DeploymentResponse(ref, on_done=done, retry=retry)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         return self._call("__call__", args, kwargs)

@@ -37,10 +37,11 @@ class ProxyActor:
 
     def _watch(self):
         """Long-poll the routing table (reference: proxies subscribe to
-        LongPollHost route updates)."""
-        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        LongPollHost route updates).  The controller handle is re-resolved
+        every iteration so a restarted controller is picked up."""
         while True:
             try:
+                controller = ray_tpu.get_actor(CONTROLLER_NAME)
                 info = ray_tpu.get(controller.get_routing_table.remote(
                     self._version, 10.0), timeout=30)
                 self._routes = info["routes"]
@@ -50,8 +51,7 @@ class ProxyActor:
 
                 time.sleep(1.0)
 
-    def _handle_for(self, prefix: str) -> DeploymentHandle:
-        route = self._routes[prefix]
+    def _handle_for(self, route: dict) -> DeploymentHandle:
         key = f"{route['app']}:{route['ingress']}"
         h = self._handles.get(key)
         if h is None:
@@ -71,12 +71,15 @@ class ProxyActor:
             path = request.path
             if path == "/-/healthz":
                 return web.Response(text="ok")
+            # snapshot: the watcher thread swaps self._routes wholesale, so
+            # every lookup below must use one consistent table
+            routes = self._routes
             if path == "/-/routes":
                 return web.json_response(
-                    {p: r["app"] for p, r in self._routes.items()})
+                    {p: r["app"] for p, r in routes.items()})
             # longest-prefix match (reference: proxy route matching)
             match = None
-            for prefix in sorted(self._routes, key=len, reverse=True):
+            for prefix in sorted(routes, key=len, reverse=True):
                 if path == prefix or path.startswith(
                         prefix.rstrip("/") + "/") or prefix == "/":
                     match = prefix
@@ -91,17 +94,36 @@ class ProxyActor:
                 if "json" in ctype or body[:1] in (b"{", b"["):
                     try:
                         arg = json.loads(body)
-                    except json.JSONDecodeError:
+                    except json.JSONDecodeError as e:
+                        if "json" in ctype:
+                            # declared JSON that doesn't parse is a client
+                            # error — reject at the proxy instead of
+                            # shipping raw bytes to dict-expecting handlers
+                            return web.json_response(
+                                {"error": "invalid JSON body",
+                                 "detail": str(e)}, status=400)
                         arg = body
                 else:
                     arg = body
             elif request.query:
                 arg = dict(request.query)
-            handle = self._handle_for(match)
+            route = routes[match]
+            handle = self._handle_for(route)
+            http_method = route.get("http_method", "__call__")
 
             def call():
-                resp = (handle.remote(arg) if arg is not None
-                        else handle.remote())
+                if http_method == "handle_http":
+                    rel = path[len(match.rstrip("/")):] or "/"
+                    # the query-to-arg fallback is a convenience of the
+                    # __call__ path only; here query has its own field and
+                    # body must stay None when the request had none
+                    resp = handle.handle_http.remote({
+                        "path": rel, "method": request.method,
+                        "body": arg if body else None,
+                        "query": dict(request.query)})
+                else:
+                    resp = (handle.remote(arg) if arg is not None
+                            else handle.remote())
                 return resp.result(timeout_s=60)
 
             try:

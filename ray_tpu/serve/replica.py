@@ -50,20 +50,23 @@ class ReplicaActor:
         return "ok"
 
     def handle_request(self, method: str, args, kwargs):
-        # Resolve forwarded DeploymentResponse refs (composition chaining):
-        # they arrive nested inside the args tuple, below the worker's
-        # top-level arg resolution.
+        # Count the request as ongoing BEFORE resolving forwarded refs —
+        # a composed request blocked on its upstream must still register as
+        # load (drain + autoscaling read queue_len).
         import ray_tpu
         from ray_tpu.core.object_ref import ObjectRef
 
-        args = tuple(ray_tpu.get(a) if isinstance(a, ObjectRef) else a
-                     for a in args)
-        kwargs = {k: ray_tpu.get(v) if isinstance(v, ObjectRef) else v
-                  for k, v in kwargs.items()}
         with self._lock:
             self._ongoing += 1
             self._total += 1
         try:
+            # Resolve forwarded DeploymentResponse refs (composition
+            # chaining): they arrive nested inside the args tuple, below
+            # the worker's top-level arg resolution.
+            args = tuple(ray_tpu.get(a) if isinstance(a, ObjectRef) else a
+                         for a in args)
+            kwargs = {k: ray_tpu.get(v) if isinstance(v, ObjectRef) else v
+                      for k, v in kwargs.items()}
             target = (self._user if method == "__call__"
                       else getattr(self._user, method))
             if method == "__call__" and not callable(self._user):

@@ -12,11 +12,11 @@ from ray_tpu import serve
 
 
 @pytest.fixture(scope="module", autouse=True)
-def _cluster():
-    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+def _cluster(ray_cluster):
+    # join the session cluster (conftest.ray_cluster owns the
+    # canonical config); never shut down here
     yield
     serve.shutdown()
-    ray_tpu.shutdown()
 
 
 @pytest.fixture(autouse=True)
@@ -279,3 +279,20 @@ def test_scale_from_zero():
         raise AssertionError("did not scale to zero")
     # a request against zero replicas must scale back up and succeed
     assert handle.remote().result(timeout_s=60) == "up"
+
+
+def test_broken_deployment_fails_fast():
+    @serve.deployment
+    class Broken:
+        def __init__(self):
+            raise RuntimeError("bad init")
+
+        def __call__(self, x=None):
+            return "never"
+
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="failed to deploy"):
+        serve.run(Broken.bind(), name="broken", route_prefix="/broken",
+                  _blocking_timeout_s=60)
+    assert time.monotonic() - t0 < 50  # surfaced well before the timeout
+    serve.delete("broken")
