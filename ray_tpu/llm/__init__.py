@@ -1,0 +1,30 @@
+"""ray_tpu.llm: TPU-native LLM serving + batch inference.
+
+Counterpart of the reference's Serve LLM / Data LLM
+(/root/reference/python/ray/llm/): where the reference wraps vLLM, the
+engine here is native — paged KV cache, bucketed prefill, one compiled
+decode step, continuous batching (engine.py, model.py, paged_cache.py) —
+served OpenAI-compatibly on ray_tpu.serve (server.py) and over Datasets
+(batch.py).
+"""
+
+from ray_tpu.llm.batch import ProcessorConfig, build_llm_processor
+from ray_tpu.llm.engine import EngineConfig, LLMEngine, SamplingParams
+from ray_tpu.llm.paged_cache import CacheConfig, PageAllocator
+from ray_tpu.llm.server import LLMConfig, LLMServer, build_openai_app
+from ray_tpu.llm.tokenizer import ByteTokenizer, get_tokenizer
+
+__all__ = [
+    "ByteTokenizer",
+    "CacheConfig",
+    "EngineConfig",
+    "LLMConfig",
+    "LLMEngine",
+    "LLMServer",
+    "PageAllocator",
+    "ProcessorConfig",
+    "SamplingParams",
+    "build_llm_processor",
+    "build_openai_app",
+    "get_tokenizer",
+]

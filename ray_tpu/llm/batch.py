@@ -1,0 +1,88 @@
+"""Batch LLM inference over ray_tpu.data (the reference's ray.data.llm).
+
+Counterpart of /root/reference/python/ray/llm/_internal/batch/processor/
+(vllm_engine_proc.py + stages/): build_llm_processor returns a
+Dataset -> Dataset callable whose stages are map_batches ops — tokenize →
+engine generate (actor pool, one engine per actor) → detokenize.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.llm.engine import EngineConfig, LLMEngine, SamplingParams
+from ray_tpu.llm.tokenizer import get_tokenizer
+
+
+@dataclass
+class ProcessorConfig:
+    """Reference: batch/processor/__init__.py ProcessorConfig lineage."""
+
+    model_loader: Callable = None  # () -> (params, LlamaConfig)
+    tokenizer: Optional[str] = None
+    engine_config: EngineConfig = field(default_factory=EngineConfig)
+    concurrency: int = 1  # engine actors
+    batch_size: int = 16
+    sampling: Dict[str, Any] = field(default_factory=dict)
+    num_tpus: Optional[float] = None
+
+
+class _EngineUDF:
+    """Actor-pool UDF hosting one engine (reference:
+    vllm_engine_proc.py engine stage)."""
+
+    def __init__(self, config: ProcessorConfig):
+        params, model_cfg = config.model_loader()
+        self._tok = get_tokenizer(config.tokenizer)
+        self._engine = LLMEngine(params, model_cfg, config.engine_config)
+        self._engine.start()
+        self._sampling = config.sampling
+
+    def __call__(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        prompts = [str(p) for p in batch["prompt"]]
+        reqs = []
+        eos = getattr(self._tok, "eos_id", None)
+        sp = dict(self._sampling)
+        if eos is not None:
+            sp.setdefault("stop_token_ids", (eos,))
+        for p in prompts:
+            reqs.append(self._engine.submit(
+                self._tok.encode(p), SamplingParams(**sp)))
+        outs = []
+        for r in reqs:
+            toks = []
+            while True:
+                item = r.out_queue.get(timeout=600)
+                if item is None:
+                    break
+                if isinstance(item, Exception):
+                    raise item
+                toks.append(item)
+            outs.append(self._tok.decode(toks))
+        out_batch = dict(batch)
+        out_batch["generated_text"] = outs
+        return out_batch
+
+
+def build_llm_processor(config: ProcessorConfig,
+                        preprocess: Optional[Callable] = None,
+                        postprocess: Optional[Callable] = None):
+    """Returns Dataset -> Dataset.  Rows need a "prompt" column (or supply
+    ``preprocess`` to create one)."""
+
+    def processor(ds):
+        if preprocess is not None:
+            ds = ds.map_batches(preprocess)
+        ds = ds.map_batches(
+            _EngineUDF,
+            fn_constructor_args=(config,),
+            concurrency=config.concurrency,
+            batch_size=config.batch_size,
+            num_tpus=config.num_tpus,
+            batch_format="numpy")
+        if postprocess is not None:
+            ds = ds.map_batches(postprocess)
+        return ds
+
+    return processor

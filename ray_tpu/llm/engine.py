@@ -1,0 +1,297 @@
+"""Continuous-batching LLM engine for TPU.
+
+Counterpart of the vLLM engine the reference wraps
+(/root/reference/python/ray/llm/_internal/serve/deployments/llm/vllm/
+vllm_engine.py:181, engine start :312): an admission queue + slot table in
+front of two compiled programs — a per-bucket prefill and ONE batched decode
+step (llm/model.py).  The scheduler thread admits waiting requests into free
+slots whenever pages are available (prefill), then advances every active
+slot one token per iteration (decode), streaming tokens into per-request
+queues.  Static shapes throughout: no recompiles after warmup.
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.llm import model as lm
+from ray_tpu.llm.paged_cache import CacheConfig, PageAllocator, init_cache
+from ray_tpu.models.llama import LlamaConfig
+
+
+@dataclass
+class EngineConfig:
+    max_slots: int = 8  # concurrent sequences in the decode batch
+    num_pages: int = 512
+    page_size: int = 16
+    max_seq_len: int = 1024
+    prefill_buckets: tuple = (32, 64, 128, 256, 512, 1024)
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"prompt length {n} exceeds largest bucket "
+                         f"{self.prefill_buckets[-1]}")
+
+
+@dataclass
+class SamplingParams:
+    max_tokens: int = 64
+    temperature: float = 0.0  # 0 => greedy
+    top_p: float = 1.0
+    stop_token_ids: tuple = ()
+    seed: Optional[int] = None
+
+
+@dataclass
+class _Request:
+    request_id: str
+    prompt_tokens: List[int]
+    params: SamplingParams
+    out_queue: queue_mod.Queue = field(default_factory=queue_mod.Queue)
+    submitted_at: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class _Slot:
+    request: _Request
+    pages: List[int]
+    num_tokens: int  # tokens with KV in cache (prompt + generated)
+    last_token: int
+    generated: List[int] = field(default_factory=list)
+    rng: Optional[np.random.Generator] = None
+
+
+class LLMEngine:
+    """Single-process engine; wrap in an actor for serving (server.py)."""
+
+    def __init__(self, params, model_cfg: LlamaConfig,
+                 cfg: Optional[EngineConfig] = None):
+        self.cfg = cfg or EngineConfig()
+        self.model_cfg = model_cfg
+        self.params = params
+        ccfg = CacheConfig(
+            n_layers=model_cfg.n_layers, n_kv_heads=model_cfg.n_kv_heads,
+            head_dim=model_cfg.head_dim, num_pages=self.cfg.num_pages,
+            page_size=self.cfg.page_size, dtype=model_cfg.dtype)
+        self.cache_k, self.cache_v = init_cache(ccfg)
+        self.allocator = PageAllocator(self.cfg.num_pages)
+        self.max_pages_per_seq = -(-self.cfg.max_seq_len
+                                   // self.cfg.page_size)
+        self._waiting: queue_mod.Queue = queue_mod.Queue()
+        # Single-writer design: _slots, the allocator, and _stats are
+        # mutated ONLY by the scheduler thread (_loop); other threads
+        # submit through the thread-safe _waiting queue and read counters
+        # via stats(), whose individual reads are GIL-atomic.  Do not add
+        # cross-thread mutation without introducing a real lock.
+        self._slots: List[Optional[_Slot]] = [None] * self.cfg.max_slots
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # decode-state host mirrors (device arrays rebuilt when they change)
+        self._stats = {"prefills": 0, "decode_steps": 0,
+                       "tokens_generated": 0, "preempted": 0}
+
+    # ------------------------- public API ---------------------------------
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def submit(self, prompt_tokens: List[int],
+               params: Optional[SamplingParams] = None) -> _Request:
+        params = params or SamplingParams()
+        total = len(prompt_tokens) + params.max_tokens
+        if total > self.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt+max_tokens = {total} exceeds max_seq_len "
+                f"{self.cfg.max_seq_len}")
+        # Page 0 is the reserved null page, so only num_pages-1 are ever
+        # allocatable: an infeasible request would otherwise sit at the
+        # queue head forever, wedging the engine for everyone behind it.
+        n_pages = -(-total // self.cfg.page_size)
+        if n_pages > self.cfg.num_pages - 1:
+            raise ValueError(
+                f"request needs {n_pages} KV pages but the cache has only "
+                f"{self.cfg.num_pages - 1} allocatable pages")
+        req = _Request(request_id=uuid.uuid4().hex[:12],
+                       prompt_tokens=list(prompt_tokens), params=params)
+        self._waiting.put(req)
+        return req
+
+    def generate(self, prompt_tokens: List[int],
+                 params: Optional[SamplingParams] = None,
+                 timeout_s: float = 300.0) -> List[int]:
+        """Blocking convenience: submit + drain to completion."""
+        self.start()
+        req = self.submit(prompt_tokens, params)
+        out: List[int] = []
+        deadline = time.monotonic() + timeout_s
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(f"generation {req.request_id} timed out")
+            item = req.out_queue.get(timeout=remaining)
+            if item is None:
+                return out
+            if isinstance(item, Exception):
+                raise item
+            out.append(item)
+
+    def stats(self) -> dict:
+        active = sum(s is not None for s in self._slots)
+        return {**self._stats, "active_slots": active,
+                "free_pages": self.allocator.num_free(),
+                "waiting": self._waiting.qsize()}
+
+    # ------------------------- scheduler loop ------------------------------
+
+    def _loop(self):
+        while not self._stop.is_set():
+            admitted = self._admit()
+            stepped = self._decode_all()
+            if not admitted and not stepped:
+                time.sleep(0.002)
+
+    def _admit(self) -> bool:
+        """Move waiting requests into free slots while pages last
+        (vLLM analogue: Scheduler admitting to the running batch)."""
+        admitted = False
+        while True:
+            free_slot = next((i for i, s in enumerate(self._slots)
+                              if s is None), None)
+            if free_slot is None:
+                return admitted
+            try:
+                req = self._waiting.get_nowait()
+            except queue_mod.Empty:
+                return admitted
+            n_pages = -(-(len(req.prompt_tokens) + req.params.max_tokens)
+                        // self.cfg.page_size)
+            if not self.allocator.can_allocate(n_pages):
+                # put back; wait for a slot to finish and free pages
+                self._waiting.queue.appendleft(req)  # type: ignore[attr-defined]
+                return admitted
+            pages = self.allocator.allocate(n_pages)
+            rng = (np.random.default_rng(req.params.seed)
+                   if req.params.temperature > 0 else None)
+            try:
+                last = self._prefill(req, pages, rng)
+            except Exception as e:  # noqa: BLE001 — surface to caller
+                self.allocator.free(pages)
+                req.out_queue.put(e)
+                req.out_queue.put(None)
+                continue
+            slot = _Slot(request=req, pages=pages,
+                         num_tokens=len(req.prompt_tokens),
+                         last_token=last, rng=rng)
+            if last in req.params.stop_token_ids:
+                req.out_queue.put(None)
+                self.allocator.free(pages)
+            else:
+                slot.generated.append(last)
+                self._emit(slot, last)
+                if len(slot.generated) >= req.params.max_tokens:
+                    req.out_queue.put(None)
+                    self.allocator.free(pages)
+                else:
+                    self._slots[free_slot] = slot
+            admitted = True
+
+    def _prefill(self, req: _Request, pages: List[int],
+                 rng: Optional[np.random.Generator]) -> int:
+        n = len(req.prompt_tokens)
+        bucket = self.cfg.bucket_for(n)
+        tokens = np.zeros(bucket, np.int32)
+        tokens[:n] = req.prompt_tokens
+        # map each padded position to (page, slot); positions beyond the
+        # allocated pages land in the null page (masked out of attention)
+        page_rows = np.zeros(bucket, np.int32)
+        for i in range(bucket):
+            pi = i // self.cfg.page_size
+            page_rows[i] = pages[pi] if pi < len(pages) else 0
+        slot_positions = np.arange(bucket, dtype=np.int32) \
+            % self.cfg.page_size
+        logits, self.cache_k, self.cache_v = lm.prefill(
+            self.params, jnp.asarray(tokens), self.cache_k, self.cache_v,
+            jnp.asarray(page_rows), jnp.int32(n),
+            jnp.asarray(slot_positions), self.model_cfg)
+        self._stats["prefills"] += 1
+        return self._sample_one(np.asarray(logits), req.params, rng)
+
+    def _decode_all(self) -> bool:
+        active_slots = [(i, s) for i, s in enumerate(self._slots)
+                        if s is not None]
+        if not active_slots:
+            return False
+        B = self.cfg.max_slots
+        P = self.max_pages_per_seq
+        tokens = np.zeros(B, np.int32)
+        positions = np.zeros(B, np.int32)
+        tables = np.zeros((B, P), np.int32)
+        active = np.zeros(B, bool)
+        for i, s in active_slots:
+            tokens[i] = s.last_token
+            positions[i] = s.num_tokens  # position of the new token
+            tables[i, :len(s.pages)] = s.pages
+            active[i] = True
+        logits, self.cache_k, self.cache_v = lm.decode_step(
+            self.params, jnp.asarray(tokens), self.cache_k, self.cache_v,
+            jnp.asarray(tables), jnp.asarray(positions),
+            jnp.asarray(active), self.model_cfg)
+        logits_np = np.asarray(logits)
+        self._stats["decode_steps"] += 1
+        for i, s in active_slots:
+            tok = self._sample_one(logits_np[i], s.request.params, s.rng)
+            s.num_tokens += 1  # last_token's KV is now in the cache
+            sp = s.request.params
+            if tok in sp.stop_token_ids:
+                s.request.out_queue.put(None)
+                self.allocator.free(s.pages)
+                self._slots[i] = None
+                continue
+            s.generated.append(tok)
+            self._emit(s, tok)
+            if len(s.generated) >= sp.max_tokens:
+                s.request.out_queue.put(None)
+                self.allocator.free(s.pages)
+                self._slots[i] = None
+            else:
+                s.last_token = tok
+        return True
+
+    def _emit(self, slot: _Slot, token: int):
+        self._stats["tokens_generated"] += 1
+        slot.request.out_queue.put(int(token))
+
+    def _sample_one(self, logits: np.ndarray, params: SamplingParams,
+                    rng: Optional[np.random.Generator]) -> int:
+        if params.temperature <= 0 or rng is None:
+            return int(np.argmax(logits))
+        probs = logits / params.temperature
+        probs = np.exp(probs - probs.max())
+        probs /= probs.sum()
+        if params.top_p < 1.0:
+            order = np.argsort(-probs)
+            csum = np.cumsum(probs[order])
+            cut = np.searchsorted(csum, params.top_p) + 1
+            keep = order[:cut]
+            mask = np.zeros_like(probs)
+            mask[keep] = probs[keep]
+            probs = mask / mask.sum()
+        return int(rng.choice(len(probs), p=probs))

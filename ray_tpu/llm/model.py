@@ -1,0 +1,143 @@
+"""Cache-aware Llama forward passes: bucketed prefill + batched paged decode.
+
+The training-side model (models/llama.py) has no KV cache; these are the
+inference twins, built for XLA's compilation model: ONE compiled decode step
+for the whole engine (static [max_slots] batch; inactive slots masked) and
+one compiled prefill per length bucket.  All control flow that depends on
+sequence length is expressed with masks and gathers, never Python branches.
+The reference gets this from vLLM's CUDA kernels; here it is jax/XLA native
+(SURVEY.md §7 step 8: "continuous-batching engine on TPU, paged attention,
+static-shape token buckets to avoid recompiles").
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.llama import LlamaConfig, rms_norm, rope
+
+
+def _qkv(cfg: LlamaConfig, p, h):
+    q = (h @ p["attn"]["wq"].astype(h.dtype)).reshape(
+        *h.shape[:-1], cfg.n_heads, cfg.head_dim)
+    k = (h @ p["attn"]["wk"].astype(h.dtype)).reshape(
+        *h.shape[:-1], cfg.n_kv_heads, cfg.head_dim)
+    v = (h @ p["attn"]["wv"].astype(h.dtype)).reshape(
+        *h.shape[:-1], cfg.n_kv_heads, cfg.head_dim)
+    return q, k, v
+
+
+def _mlp(p, h):
+    gate = jax.nn.silu(h @ p["mlp"]["w_gate"].astype(h.dtype))
+    up = h @ p["mlp"]["w_up"].astype(h.dtype)
+    return (gate * up) @ p["mlp"]["w_down"].astype(h.dtype)
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2, 3))
+def prefill(params, tokens, cache_k, cache_v, page_rows, true_len,
+            slot_positions, cfg: LlamaConfig):
+    """Prefill ONE sequence padded to a length bucket.
+
+    tokens: [L] int32 (padded); page_rows: [L] page id per token position;
+    slot_positions: [L] slot inside the page; true_len: scalar.
+    Writes K/V for positions < true_len into the paged cache and returns
+    (logits_at_last_token [V], cache_k, cache_v).
+    """
+    L = tokens.shape[0]
+    x = params["embed"].astype(jnp.dtype(cfg.dtype))[tokens]  # [L, D]
+    positions = jnp.arange(L)
+    causal = positions[None, :] <= positions[:, None]  # [L, L]
+    valid = positions[None, :] < true_len
+    mask = causal & valid
+
+    def body(x, layer):
+        p, ck_l, cv_l = layer
+        h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+        q, k, v = _qkv(cfg, p, h)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        # write k/v into this layer's pages (beyond true_len the rows
+        # write into the sequence's own pages — masked out of attention)
+        ck_l = ck_l.at[page_rows, slot_positions].set(k)
+        cv_l = cv_l.at[page_rows, slot_positions].set(v)
+        # full-sequence causal attention (GQA: repeat kv heads)
+        rep = cfg.n_heads // cfg.n_kv_heads
+        kf = jnp.repeat(k, rep, axis=1)
+        vf = jnp.repeat(v, rep, axis=1)
+        scores = jnp.einsum("qhd,khd->hqk", q, kf) / (cfg.head_dim ** 0.5)
+        scores = jnp.where(mask[None], scores, -1e30)
+        attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        out = jnp.einsum("hqk,khd->qhd", attn.astype(vf.dtype), vf)
+        x = x + out.reshape(L, -1) @ p["attn"]["wo"].astype(x.dtype)
+        h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+        x = x + _mlp(p, h)
+        return x, (ck_l, cv_l)
+
+    x, (cache_k, cache_v) = jax.lax.scan(
+        body, x, (params["layers"], cache_k, cache_v))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last = jnp.take(x, jnp.maximum(true_len - 1, 0), axis=0)
+    logits = last.astype(jnp.float32) @ params["lm_head"]
+    return logits, cache_k, cache_v
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2, 3))
+def decode_step(params, tokens, cache_k, cache_v, page_tables, positions,
+                active, cfg: LlamaConfig):
+    """One token for EVERY slot (the continuous-batching hot loop).
+
+    tokens: [B] int32 current token per slot; positions: [B] its position;
+    page_tables: [B, P] page ids (0 = null page); active: [B] bool.
+    Returns (logits [B, V], cache_k, cache_v).
+    """
+    B = tokens.shape[0]
+    P = page_tables.shape[1]
+    page_size = cache_k.shape[2]
+    x = params["embed"].astype(jnp.dtype(cfg.dtype))[tokens]  # [B, D]
+
+    # where this step's k/v lands: slot b writes page_tables[b, pos//ps]
+    write_page = jnp.take_along_axis(
+        page_tables, (positions // page_size)[:, None], axis=1)[:, 0]
+    # inactive slots write into the null page (page 0) — harmless scratch
+    write_page = jnp.where(active, write_page, 0)
+    write_slot = positions % page_size
+
+    def body(x, layer):
+        p, ck_l, cv_l = layer
+        h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+        q, k, v = _qkv(cfg, p, h)  # q: [B, H, d]; k,v: [B, Hkv, d]
+        q = rope(q[:, None], positions[:, None],
+                 cfg.rope_theta)[:, 0]
+        k = rope(k[:, None], positions[:, None],
+                 cfg.rope_theta)[:, 0]
+        ck_l = ck_l.at[write_page, write_slot].set(k)
+        cv_l = cv_l.at[write_page, write_slot].set(v)
+        # gather each slot's pages: [B, P, ps, Hkv, d] -> [B, P*ps, Hkv, d]
+        keys = ck_l[page_tables].reshape(B, P * page_size,
+                                         cfg.n_kv_heads, cfg.head_dim)
+        vals = cv_l[page_tables].reshape(B, P * page_size,
+                                         cfg.n_kv_heads, cfg.head_dim)
+        rep = cfg.n_heads // cfg.n_kv_heads
+        keys = jnp.repeat(keys, rep, axis=2)  # [B, T, H, d]
+        vals = jnp.repeat(vals, rep, axis=2)
+        scores = jnp.einsum("bhd,bthd->bht", q, keys) \
+            / (cfg.head_dim ** 0.5)
+        tpos = jnp.arange(P * page_size)[None]  # [1, T]
+        mask = tpos <= positions[:, None]  # attend up to current token
+        scores = jnp.where(mask[:, None, :], scores, -1e30)
+        attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        out = jnp.einsum("bht,bthd->bhd", attn.astype(vals.dtype), vals)
+        x = x + out.reshape(B, -1) @ p["attn"]["wo"].astype(x.dtype)
+        h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+        x = x + _mlp(p, h)
+        return x, (ck_l, cv_l)
+
+    x, (cache_k, cache_v) = jax.lax.scan(
+        body, x, (params["layers"], cache_k, cache_v))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x.astype(jnp.float32) @ params["lm_head"]
+    return logits, cache_k, cache_v

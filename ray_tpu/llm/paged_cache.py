@@ -1,0 +1,66 @@
+"""Paged KV cache: fixed-shape page pool + host-side page allocator.
+
+TPU-native replacement for the paged attention the reference delegates to
+vLLM (/root/reference/python/ray/llm/_internal/serve/deployments/llm/vllm/
+vllm_engine.py:181 — engine kwargs `block_size`, `gpu_memory_utilization`):
+KV lives in a static [n_layers, num_pages, page_size, n_kv, head_dim] pool
+so every decode step has one compiled shape regardless of sequence lengths;
+sequences map to pages through an integer page table.  The allocator is a
+trivial host-side free list — allocation happens at admission time, never
+inside the jitted step.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class CacheConfig:
+    n_layers: int
+    n_kv_heads: int
+    head_dim: int
+    num_pages: int = 256
+    page_size: int = 16
+    dtype: str = "bfloat16"
+
+    @property
+    def tokens_capacity(self) -> int:
+        return self.num_pages * self.page_size
+
+
+def init_cache(cfg: CacheConfig):
+    shape = (cfg.n_layers, cfg.num_pages, cfg.page_size,
+             cfg.n_kv_heads, cfg.head_dim)
+    dt = jnp.dtype(cfg.dtype)
+    return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+
+class PageAllocator:
+    """Host-side free list (reference analogue: vLLM's BlockManager)."""
+
+    def __init__(self, num_pages: int):
+        # page 0 is reserved as the "null" page that padded page-table
+        # entries point at; attention masks it out by position.
+        self._free: List[int] = list(range(1, num_pages))
+        self.num_pages = num_pages
+
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def can_allocate(self, n: int) -> bool:
+        return len(self._free) >= n
+
+    def allocate(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise MemoryError(f"needs {n} pages, {len(self._free)} free")
+        out, self._free = self._free[:n], self._free[n:]
+        return out
+
+    def free(self, pages: List[int]) -> None:
+        self._free.extend(p for p in pages if p != 0)

@@ -1,0 +1,155 @@
+"""OpenAI-compatible LLM serving on ray_tpu.serve.
+
+Counterpart of the reference's Serve LLM stack
+(/root/reference/python/ray/llm/_internal/serve/deployments/llm/
+llm_server.py:410 LLMServer, configs/openai_api_models.py router,
+builders/application_builders.py build_openai_app): an LLMServer deployment
+owns a continuous-batching engine (llm/engine.py); the path-aware ingress
+implements /v1/completions, /v1/chat/completions, and /v1/models.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu import serve
+from ray_tpu.llm.engine import EngineConfig, LLMEngine, SamplingParams
+from ray_tpu.llm.tokenizer import get_tokenizer
+from ray_tpu.models.llama import LlamaConfig
+
+
+@dataclass
+class LLMConfig:
+    """Reference: llm/_internal/serve/configs/server_models.py LLMConfig
+    (model_loading_config + engine_kwargs + deployment_config)."""
+
+    model_id: str = "llama-tiny"
+    # callable returning (params, LlamaConfig) — checkpoint loading hook
+    model_loader: Optional[Callable] = None
+    tokenizer: Optional[str] = None  # None/"byte" or HF name
+    engine_config: EngineConfig = field(default_factory=EngineConfig)
+    num_replicas: int = 1
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    default_max_tokens: int = 64
+
+
+class LLMServer:
+    """The engine-owning deployment (one engine per replica)."""
+
+    def __init__(self, llm_config: LLMConfig):
+        self._config = llm_config
+        if llm_config.model_loader is None:
+            raise ValueError("LLMConfig.model_loader is required")
+        params, model_cfg = llm_config.model_loader()
+        self._tok = get_tokenizer(llm_config.tokenizer)
+        self._engine = LLMEngine(params, model_cfg,
+                                 llm_config.engine_config)
+        self._engine.start()
+
+    def _params_from(self, body: dict) -> SamplingParams:
+        stop_ids = tuple(body.get("stop_token_ids", ()))
+        eos = getattr(self._tok, "eos_id", None)
+        if eos is not None and not body.get("ignore_eos"):
+            stop_ids = stop_ids + (eos,)
+        return SamplingParams(
+            max_tokens=int(body.get("max_tokens",
+                                    self._config.default_max_tokens)),
+            temperature=float(body.get("temperature", 0.0)),
+            top_p=float(body.get("top_p", 1.0)),
+            stop_token_ids=stop_ids,
+            seed=body.get("seed"))
+
+    def completions(self, body: dict) -> dict:
+        prompt = body.get("prompt", "")
+        tokens = (list(prompt) if isinstance(prompt, list)
+                  and prompt and isinstance(prompt[0], int)
+                  else self._tok.encode(str(prompt)))
+        params = self._params_from(body)
+        out = self._engine.generate(tokens, params)
+        text = self._tok.decode(out)
+        return {
+            "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": body.get("model", self._config.model_id),
+            "choices": [{"index": 0, "text": text,
+                         "finish_reason": "stop"
+                         if len(out) < params.max_tokens else "length"}],
+            "usage": {"prompt_tokens": len(tokens),
+                      "completion_tokens": len(out),
+                      "total_tokens": len(tokens) + len(out)},
+        }
+
+    def chat(self, body: dict) -> dict:
+        messages = body.get("messages", [])
+        prompt = self._tok.apply_chat_template(messages)
+        tokens = self._tok.encode(prompt)
+        params = self._params_from(body)
+        out = self._engine.generate(tokens, params)
+        text = self._tok.decode(out)
+        return {
+            "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": body.get("model", self._config.model_id),
+            "choices": [{"index": 0,
+                         "message": {"role": "assistant", "content": text},
+                         "finish_reason": "stop"
+                         if len(out) < params.max_tokens else "length"}],
+            "usage": {"prompt_tokens": len(tokens),
+                      "completion_tokens": len(out),
+                      "total_tokens": len(tokens) + len(out)},
+        }
+
+    def generate_tokens(self, prompt_tokens: List[int],
+                        **params) -> List[int]:
+        """Raw token API (used by data-plane batch inference)."""
+        return self._engine.generate(list(prompt_tokens),
+                                     SamplingParams(**params))
+
+    def engine_stats(self) -> dict:
+        return self._engine.stats()
+
+    def check_health(self):
+        if self._engine._thread is not None \
+                and not self._engine._thread.is_alive() \
+                and not self._engine._stop.is_set():
+            raise RuntimeError("engine loop died")
+
+
+class OpenAIRouter:
+    """Path-aware ingress translating OpenAI REST to LLMServer calls
+    (reference: configs/openai_api_models.py OpenAI router deployment)."""
+
+    def __init__(self, server_handle, model_id: str):
+        self._server = server_handle
+        self._model_id = model_id
+
+    def handle_http(self, request: dict):
+        path = request.get("path", "/")
+        body = request.get("body") or {}
+        if path.endswith("/v1/models") or path == "/models":
+            return {"object": "list",
+                    "data": [{"id": self._model_id, "object": "model"}]}
+        if path.endswith("/chat/completions"):
+            return self._server.chat.remote(body).result(timeout_s=300)
+        if path.endswith("/completions"):
+            return self._server.completions.remote(body).result(
+                timeout_s=300)
+        return {"error": f"unknown endpoint {path}"}
+
+
+def build_openai_app(llm_config: LLMConfig) -> serve.Application:
+    """Reference: builders/application_builders.py build_openai_app."""
+    server = serve.deployment(LLMServer).options(
+        name=f"LLMServer:{llm_config.model_id}",
+        num_replicas=llm_config.num_replicas,
+        ray_actor_options=llm_config.ray_actor_options,
+        max_ongoing_requests=llm_config.engine_config.max_slots * 2,
+    ).bind(llm_config)
+    router = serve.deployment(OpenAIRouter).options(
+        name="OpenAIRouter").bind(server, llm_config.model_id)
+    return router

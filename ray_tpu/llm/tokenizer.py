@@ -1,0 +1,64 @@
+"""Tokenizers for the LLM stack.
+
+The reference delegates tokenization to HuggingFace via vLLM
+(/root/reference/python/ray/llm/_internal/batch/stages/: tokenize stage).
+Here: a dependency-free reversible byte tokenizer as the default (works with
+randomly initialized models and air-gapped machines), plus a HuggingFace
+adapter when a local tokenizer is available.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class ByteTokenizer:
+    """UTF-8 bytes + specials.  ids: 0=pad, 1=bos, 2=eos, byte b -> b+3."""
+
+    vocab_size = 256 + 3
+    pad_id, bos_id, eos_id = 0, 1, 2
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = [b + 3 for b in text.encode("utf-8")]
+        return [self.bos_id] + ids if add_bos else ids
+
+    def decode(self, ids: List[int]) -> str:
+        data = bytes(i - 3 for i in ids if i >= 3)
+        return data.decode("utf-8", errors="replace")
+
+    def apply_chat_template(self, messages: List[dict]) -> str:
+        parts = [f"{m.get('role', 'user')}: {m.get('content', '')}"
+                 for m in messages]
+        return "\n".join(parts) + "\nassistant:"
+
+
+class HFTokenizer:
+    """Adapter over a locally available HuggingFace tokenizer."""
+
+    def __init__(self, name_or_path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(name_or_path)
+        self.vocab_size = self._tok.vocab_size
+        self.eos_id = self._tok.eos_token_id
+        self.bos_id = self._tok.bos_token_id
+        self.pad_id = self._tok.pad_token_id or 0
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        return self._tok.encode(text)
+
+    def decode(self, ids: List[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+    def apply_chat_template(self, messages: List[dict]) -> str:
+        try:
+            return self._tok.apply_chat_template(
+                messages, tokenize=False, add_generation_prompt=True)
+        except Exception:
+            return ByteTokenizer.apply_chat_template(self, messages)
+
+
+def get_tokenizer(name: Optional[str] = None):
+    if name is None or name == "byte":
+        return ByteTokenizer()
+    return HFTokenizer(name)

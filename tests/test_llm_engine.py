@@ -1,0 +1,136 @@
+"""LLM engine tests: paged decode must match full-forward generation.
+
+The reference trusts vLLM's kernels; here the paged path is ours, so the
+core invariant is exactness vs the training-side forward
+(/root/reference has no analogue — net-new per SURVEY.md §7 step 8)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.llm.engine import EngineConfig, LLMEngine, SamplingParams
+from ray_tpu.models import llama
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = llama.LlamaConfig(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=256, dtype="float32", remat=False)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    return params, cfg
+
+
+def reference_greedy(params, cfg, prompt, n_new):
+    """Greedy generation via the full training forward (no cache)."""
+    toks = list(prompt)
+    for _ in range(n_new):
+        logits = llama.apply(params, jnp.asarray([toks]), cfg)
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+def make_engine(tiny_model, **kw):
+    params, cfg = tiny_model
+    ecfg = EngineConfig(max_slots=4, num_pages=64, page_size=8,
+                        max_seq_len=256,
+                        prefill_buckets=(16, 32, 64, 128), **kw)
+    return LLMEngine(params, cfg, ecfg)
+
+
+def test_greedy_matches_full_forward(tiny_model):
+    params, cfg = tiny_model
+    engine = make_engine(tiny_model)
+    prompt = [1, 17, 93, 5, 42, 7]
+    want = reference_greedy(params, cfg, prompt, 12)
+    got = engine.generate(prompt, SamplingParams(max_tokens=12))
+    engine.stop()
+    assert got == want
+
+
+def test_concurrent_requests_match_solo_runs(tiny_model):
+    params, cfg = tiny_model
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, 128, size=n))
+               for n in (3, 9, 14, 30, 6, 21)]
+    want = [reference_greedy(params, cfg, p, 8) for p in prompts]
+
+    engine = make_engine(tiny_model)
+    engine.start()
+    reqs = [engine.submit(p, SamplingParams(max_tokens=8)) for p in prompts]
+    got = []
+    for r in reqs:
+        toks = []
+        while True:
+            item = r.out_queue.get(timeout=120)
+            if item is None:
+                break
+            if isinstance(item, Exception):
+                raise item
+            toks.append(item)
+        got.append(toks)
+    engine.stop()
+    assert got == want
+    # continuous batching actually batched: fewer decode rounds than the
+    # sum of solo decodes would need
+    assert engine.stats()["decode_steps"] < sum(8 for _ in prompts)
+
+
+def test_stop_tokens_and_max_tokens(tiny_model):
+    engine = make_engine(tiny_model)
+    prompt = [3, 14, 15]
+    full = engine.generate(prompt, SamplingParams(max_tokens=10))
+    assert len(full) == 10
+    # stop on a generated token whose FIRST occurrence is at its index
+    # (stop fires at first occurrence, so earlier repeats would shift it)
+    idx = next(i for i in range(1, 10) if full[i] not in full[:i])
+    stop = full[idx]
+    stopped = engine.generate(
+        prompt, SamplingParams(max_tokens=10, stop_token_ids=(stop,)))
+    engine.stop()
+    assert stopped == full[:idx]
+
+
+def test_page_exhaustion_queues_requests(tiny_model):
+    # 15 usable pages (page 0 reserved), each request needs 5 pages
+    engine = make_engine(tiny_model)
+    engine.cfg.num_pages = 16
+    from ray_tpu.llm.paged_cache import PageAllocator
+
+    engine.allocator = PageAllocator(16)
+    engine.start()
+    prompts = [[i + 1] * 8 for i in range(6)]
+    reqs = [engine.submit(p, SamplingParams(max_tokens=30))
+            for p in prompts]
+    outs = []
+    for r in reqs:
+        toks = []
+        while True:
+            item = r.out_queue.get(timeout=120)
+            if item is None:
+                break
+            if isinstance(item, Exception):
+                raise item
+            toks.append(item)
+        outs.append(toks)
+    engine.stop()
+    assert all(len(o) == 30 for o in outs)
+
+
+def test_temperature_sampling_seeded(tiny_model):
+    engine = make_engine(tiny_model)
+    p = SamplingParams(max_tokens=8, temperature=0.8, seed=42)
+    a = engine.generate([5, 6, 7], p)
+    b = engine.generate([5, 6, 7], SamplingParams(
+        max_tokens=8, temperature=0.8, seed=42))
+    c = engine.generate([5, 6, 7], SamplingParams(
+        max_tokens=8, temperature=0.8, seed=43))
+    engine.stop()
+    assert a == b
+    assert len(a) == 8
+    assert a != c or True  # different seed usually differs; no hard assert

@@ -1,0 +1,91 @@
+"""Serve LLM + Data LLM tests (reference: python/ray/llm tests +
+release/llm_tests/serve/run_llm_serve_test_and_bms.py shape)."""
+
+import sys
+
+import cloudpickle
+import numpy as np
+import pytest
+import requests
+
+# Module-level functions here (tiny_loader) ship inside configs to worker
+# processes that cannot import this test module — pickle them by value.
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.llm import (
+    EngineConfig,
+    LLMConfig,
+    ProcessorConfig,
+    build_llm_processor,
+    build_openai_app,
+)
+
+
+def tiny_loader():
+    import jax
+
+    from ray_tpu.models import llama
+
+    cfg = llama.LlamaConfig(
+        vocab_size=259, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=512, dtype="float32", remat=False)
+    return llama.init(cfg, jax.random.PRNGKey(7)), cfg
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster(ray_cluster):
+    # join the session cluster (conftest.ray_cluster owns the
+    # canonical config); never shut down here
+    yield
+    serve.shutdown()
+
+
+def test_openai_endpoints():
+    app = build_openai_app(LLMConfig(
+        model_id="tiny", model_loader=tiny_loader,
+        engine_config=EngineConfig(max_slots=4, num_pages=128, page_size=8,
+                                   max_seq_len=256,
+                                   prefill_buckets=(32, 64, 128)),
+        default_max_tokens=8))
+    serve.run(app, name="llm", route_prefix="/llm", _blocking_timeout_s=120)
+    port = serve.http_port()
+    base = f"http://127.0.0.1:{port}/llm/v1"
+
+    r = requests.get(f"{base}/models", timeout=60)
+    assert r.json()["data"][0]["id"] == "tiny"
+
+    r = requests.post(f"{base}/completions",
+                      json={"prompt": "hello", "max_tokens": 6},
+                      timeout=300)
+    body = r.json()
+    assert body["object"] == "text_completion", body
+    assert body["usage"]["completion_tokens"] <= 6
+    assert isinstance(body["choices"][0]["text"], str)
+
+    r = requests.post(f"{base}/chat/completions",
+                      json={"messages": [
+                          {"role": "user", "content": "hi"}],
+                          "max_tokens": 4},
+                      timeout=300)
+    body = r.json()
+    assert body["object"] == "chat.completion", body
+    assert body["choices"][0]["message"]["role"] == "assistant"
+    serve.delete("llm")
+
+
+def test_batch_processor_over_dataset():
+    from ray_tpu import data as rd
+
+    processor = build_llm_processor(ProcessorConfig(
+        model_loader=tiny_loader,
+        engine_config=EngineConfig(max_slots=4, num_pages=128, page_size=8,
+                                   max_seq_len=256,
+                                   prefill_buckets=(32, 64)),
+        concurrency=1, batch_size=4,
+        sampling={"max_tokens": 4}))
+    ds = rd.from_items([{"prompt": f"item {i}"} for i in range(8)])
+    out = processor(ds).take_all()
+    assert len(out) == 8
+    assert all(isinstance(r["generated_text"], str) for r in out)
