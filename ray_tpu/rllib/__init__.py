@@ -1,0 +1,21 @@
+"""ray_tpu.rllib: reinforcement learning on the core runtime.
+
+Counterpart of RLlib (/root/reference/rllib/), minimum viable slice per
+SURVEY.md §7 step 9: PPO with env-runner sampling actors and a jitted
+JAX learner (module.py RLModule, env_runner.py, ppo.py).
+"""
+
+from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.module import MLPConfig, forward, greedy_action, init_mlp
+from ray_tpu.rllib.ppo import PPO, PPOConfig, compute_gae
+
+__all__ = [
+    "EnvRunner",
+    "MLPConfig",
+    "PPO",
+    "PPOConfig",
+    "compute_gae",
+    "forward",
+    "greedy_action",
+    "init_mlp",
+]

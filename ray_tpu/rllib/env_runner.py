@@ -1,0 +1,113 @@
+"""EnvRunner: sampling actor collecting rollouts from gymnasium envs.
+
+Counterpart of the reference's SingleAgentEnvRunner
+(/root/reference/rllib/env/single_agent_env_runner.py:68) driven by
+EnvRunnerGroup (env_runner_group.py:71): each runner owns num_envs
+environments, steps them with the current policy params (pushed by the
+algorithm each iteration), and returns fixed-length fragments plus episode
+metrics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from ray_tpu.rllib import module as module_mod
+
+
+class EnvRunner:
+    def __init__(self, env_maker: Union[str, Callable], num_envs: int = 1,
+                 seed: int = 0):
+        import gymnasium as gym
+
+        if isinstance(env_maker, str):
+            self._envs = [gym.make(env_maker) for _ in range(num_envs)]
+        else:
+            self._envs = [env_maker() for _ in range(num_envs)]
+        self._obs = []
+        for i, env in enumerate(self._envs):
+            obs, _ = env.reset(seed=seed + i)
+            self._obs.append(obs)
+        self._ep_return = [0.0] * num_envs
+        self._ep_len = [0] * num_envs
+        self._completed_returns: List[float] = []
+        self._completed_lens: List[int] = []
+        self._seed = seed
+        self._steps = 0
+
+    def env_spec(self) -> Dict[str, int]:
+        env = self._envs[0]
+        return {"obs_dim": int(np.prod(env.observation_space.shape)),
+                "n_actions": int(env.action_space.n)}
+
+    def sample(self, params, num_steps: int) -> Dict[str, np.ndarray]:
+        """Collect num_steps per env with the given policy params."""
+        import jax
+
+        n = len(self._envs)
+        obs_buf, act_buf, logp_buf, val_buf = [], [], [], []
+        rew_buf, done_buf = [], []
+        truncated_next: list = []  # (t, env_idx, next_obs) at truncations
+        for t in range(num_steps):
+            obs = np.stack(self._obs).astype(np.float32)
+            key = jax.random.PRNGKey(
+                (self._seed * 1_000_003 + self._steps) & 0x7FFFFFFF)
+            action, logp, value = module_mod.action_dist(params, obs, key)
+            action = np.asarray(action)
+            obs_buf.append(obs)
+            act_buf.append(action)
+            logp_buf.append(np.asarray(logp))
+            val_buf.append(np.asarray(value))
+            rews, dones = np.zeros(n, np.float32), np.zeros(n, bool)
+            for i, env in enumerate(self._envs):
+                nobs, r, term, trunc, _ = env.step(int(action[i]))
+                rews[i] = r
+                self._ep_return[i] += float(r)
+                self._ep_len[i] += 1
+                if term or trunc:
+                    dones[i] = True
+                    if trunc and not term:
+                        # time-limit truncation: the episode did NOT end in
+                        # an absorbing state, so bootstrap with V(s') rather
+                        # than 0 (reference: RLlib new-stack GAE bootstraps
+                        # at truncations).  Folding gamma*V(s') into the
+                        # reward keeps compute_gae unchanged (dones cuts
+                        # the trace there either way).
+                        truncated_next.append(
+                            (t, i, np.asarray(nobs, np.float32)))
+                    self._completed_returns.append(self._ep_return[i])
+                    self._completed_lens.append(self._ep_len[i])
+                    self._ep_return[i], self._ep_len[i] = 0.0, 0
+                    nobs, _ = env.reset()
+                self._obs[i] = nobs
+            rew_buf.append(rews)
+            done_buf.append(dones)
+            self._steps += 1
+        last_obs = np.stack(self._obs).astype(np.float32)
+        # V(s') at time-limit truncations (zero elsewhere); the learner
+        # folds gamma * trunc_values into rewards before GAE
+        trunc_values = np.zeros((num_steps, n), np.float32)
+        if truncated_next:
+            batch = np.stack([o for _, _, o in truncated_next])
+            _, v = module_mod.forward(params, batch)
+            v = np.asarray(v)
+            for k, (t, i, _) in enumerate(truncated_next):
+                trunc_values[t, i] = v[k]
+        return {
+            "obs": np.stack(obs_buf),          # [T, n, obs_dim]
+            "actions": np.stack(act_buf),       # [T, n]
+            "logp": np.stack(logp_buf),         # [T, n]
+            "values": np.stack(val_buf),        # [T, n]
+            "rewards": np.stack(rew_buf),       # [T, n]
+            "dones": np.stack(done_buf),        # [T, n]
+            "trunc_values": trunc_values,       # [T, n]
+            "last_obs": last_obs,               # [n, obs_dim]
+        }
+
+    def get_metrics(self) -> Dict[str, Any]:
+        out = {"episode_returns": list(self._completed_returns),
+               "episode_lens": list(self._completed_lens)}
+        self._completed_returns, self._completed_lens = [], []
+        return out

@@ -1,0 +1,68 @@
+"""RLModule: the policy/value network abstraction, functional JAX.
+
+Counterpart of the reference's RLModule
+(/root/reference/rllib/core/rl_module/rl_module.py, new API stack): a
+params pytree + pure apply functions (jit-able, mesh-shardable) instead of
+a torch nn.Module.  MLPModule covers discrete-action control; the ABC keeps
+the inference/exploration/train forward split.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class MLPConfig:
+    obs_dim: int
+    n_actions: int
+    hidden: Tuple[int, ...] = (64, 64)
+
+
+def init_mlp(cfg: MLPConfig, key) -> Dict[str, Any]:
+    """Shared torso + policy/value heads (reference:
+    rllib/core/rl_module/default_model_config.py MLP defaults)."""
+    sizes = (cfg.obs_dim,) + cfg.hidden
+    keys = jax.random.split(key, len(sizes) + 1)
+    layers = []
+    for i, (fin, fout) in enumerate(zip(sizes[:-1], sizes[1:])):
+        w = jax.random.normal(keys[i], (fin, fout)) * (2.0 / fin) ** 0.5
+        layers.append({"w": w, "b": jnp.zeros(fout)})
+    kp, kv = keys[-1], jax.random.split(keys[-1])[0]
+    return {
+        "torso": layers,
+        "pi": {"w": jax.random.normal(kp, (sizes[-1], cfg.n_actions))
+               * 0.01, "b": jnp.zeros(cfg.n_actions)},
+        "vf": {"w": jax.random.normal(kv, (sizes[-1], 1)) * 1.0,
+               "b": jnp.zeros(1)},
+    }
+
+
+def forward(params, obs):
+    """obs [B, obs_dim] -> (logits [B, A], value [B])."""
+    x = obs
+    for layer in params["torso"]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    logits = x @ params["pi"]["w"] + params["pi"]["b"]
+    value = (x @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+    return logits, value
+
+
+@jax.jit
+def action_dist(params, obs, key):
+    """Sample actions + logp + value for exploration rollouts."""
+    logits, value = forward(params, obs)
+    action = jax.random.categorical(key, logits)
+    logp = jax.nn.log_softmax(logits)[
+        jnp.arange(logits.shape[0]), action]
+    return action, logp, value
+
+
+@jax.jit
+def greedy_action(params, obs):
+    logits, _ = forward(params, obs)
+    return jnp.argmax(logits, axis=-1)

@@ -1,0 +1,279 @@
+"""PPO: env-runner actors + jitted learner.
+
+Counterpart of the reference's PPO on the new API stack
+(/root/reference/rllib/algorithms/ppo/ppo.py, Algorithm.step
+rllib/algorithms/algorithm.py:986, training_step :2004;
+Learner.update rllib/core/learner/learner.py:107): Algorithm.train() =
+parallel sample on runner actors → GAE → minibatched clipped-surrogate
+epochs in ONE jitted update (lax.scan over minibatches — the torch learner's
+python loop becomes a compiled scan), metrics back.  Tune-compatible: train
+returns a result dict; save/restore via pickle pytrees.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib import module as module_mod
+from ray_tpu.rllib.env_runner import EnvRunner
+
+
+@dataclass
+class PPOConfig:
+    """Reference: rllib/algorithms/ppo/ppo.py PPOConfig (training() args)."""
+
+    env: Union[str, Callable] = "CartPole-v1"
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 4
+    rollout_fragment_length: int = 128
+    gamma: float = 0.99
+    lambda_: float = 0.95
+    lr: float = 3e-4
+    clip_param: float = 0.2
+    num_epochs: int = 4
+    minibatch_size: int = 256
+    entropy_coeff: float = 0.01
+    vf_loss_coeff: float = 0.5
+    grad_clip: float = 0.5
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+    # fluent-style helpers mirroring the reference's config builder
+    def environment(self, env) -> "PPOConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, num_env_runners: int = 2,
+                    num_envs_per_env_runner: int = 4,
+                    rollout_fragment_length: int = 128) -> "PPOConfig":
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_env_runner
+        self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kw) -> "PPOConfig":
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown PPO option {k!r}")
+            setattr(self, k, v)
+        return self
+
+
+def compute_gae(rewards, values, dones, last_value, gamma, lam):
+    """[T, n] arrays -> (advantages, returns), numpy."""
+    T = rewards.shape[0]
+    adv = np.zeros_like(rewards)
+    last_adv = np.zeros(rewards.shape[1], rewards.dtype)
+    next_value = last_value
+    for t in range(T - 1, -1, -1):
+        nonterminal = 1.0 - dones[t].astype(rewards.dtype)
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        last_adv = delta + gamma * lam * nonterminal * last_adv
+        adv[t] = last_adv
+        next_value = values[t]
+    return adv, adv + values
+
+
+@partial(jax.jit, static_argnames=("num_epochs", "minibatch_size",
+                                   "clip", "ent_coeff", "vf_coeff",
+                                   "grad_clip", "lr"))
+def ppo_update(params, opt_state, batch, key, *, num_epochs: int,
+               minibatch_size: int, clip: float, ent_coeff: float,
+               vf_coeff: float, grad_clip: float, lr: float):
+    """All epochs + minibatches in one compiled program."""
+    import optax
+
+    tx = optax.chain(optax.clip_by_global_norm(grad_clip),
+                     optax.adam(lr))
+    N = batch["obs"].shape[0]
+    n_mb = max(1, N // minibatch_size)
+
+    def loss_fn(p, mb):
+        logits, value = module_mod.forward(p, mb["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, mb["actions"][:, None], axis=1)[:, 0]
+        ratio = jnp.exp(logp - mb["logp_old"])
+        adv = mb["adv"]
+        pg = -jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - clip, 1 + clip) * adv).mean()
+        vf = jnp.square(value - mb["returns"]).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        total = pg + vf_coeff * vf - ent_coeff * entropy
+        return total, (pg, vf, entropy)
+
+    def epoch_body(carry, key_e):
+        p, s = carry
+        perm = jax.random.permutation(key_e, N)
+
+        def mb_body(carry, idx):
+            p, s = carry
+            mb = {k: v[idx] for k, v in batch.items()}
+            (l, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(p, mb)
+            updates, s = tx.update(grads, s, p)
+            p = optax.apply_updates(p, updates)
+            return (p, s), (l, *aux)
+
+        idxs = perm[:n_mb * minibatch_size].reshape(n_mb, -1)
+        (p, s), losses = jax.lax.scan(mb_body, (p, s), idxs)
+        return (p, s), losses
+
+    keys = jax.random.split(key, num_epochs)
+    (params, opt_state), losses = jax.lax.scan(
+        epoch_body, (params, opt_state), keys)
+    stats = {"total_loss": losses[0].mean(),
+             "policy_loss": losses[1].mean(),
+             "vf_loss": losses[2].mean(),
+             "entropy": losses[3].mean()}
+    return params, opt_state, stats
+
+
+class PPO:
+    """Reference: Algorithm (rllib/algorithms/algorithm.py) minimum —
+    train/save/restore/stop + evaluate."""
+
+    def __init__(self, config: PPOConfig):
+        import optax
+
+        self.config = config
+        runner_cls = ray_tpu.remote(EnvRunner)
+        self.runners = [
+            runner_cls.remote(config.env, config.num_envs_per_runner,
+                              seed=config.seed + 1000 * i)
+            for i in range(config.num_env_runners)]
+        spec = ray_tpu.get(self.runners[0].env_spec.remote(), timeout=60)
+        self.module_cfg = module_mod.MLPConfig(
+            obs_dim=spec["obs_dim"], n_actions=spec["n_actions"],
+            hidden=config.hidden)
+        self.params = module_mod.init_mlp(
+            self.module_cfg, jax.random.PRNGKey(config.seed))
+        tx = optax.chain(optax.clip_by_global_norm(config.grad_clip),
+                         optax.adam(config.lr))
+        self.opt_state = tx.init(self.params)
+        self.iteration = 0
+        self._timesteps = 0
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        params_ref = ray_tpu.put(jax.device_get(self.params))
+        frags = ray_tpu.get(
+            [r.sample.remote(params_ref, cfg.rollout_fragment_length)
+             for r in self.runners], timeout=600)
+        # GAE per runner fragment, then flatten everything
+        obs, acts, logp, adv, rets = [], [], [], [], []
+        for f in frags:
+            last_value = np.asarray(module_mod.forward(
+                self.params, f["last_obs"])[1])
+            # bootstrap time-limit truncations with V(s') (runner reports
+            # it in trunc_values; dones still cuts the GAE trace there)
+            rewards = f["rewards"] + cfg.gamma * f.get(
+                "trunc_values", np.zeros_like(f["rewards"]))
+            a, r = compute_gae(rewards, f["values"], f["dones"],
+                               last_value, cfg.gamma, cfg.lambda_)
+            T, n = f["rewards"].shape
+            obs.append(f["obs"].reshape(T * n, -1))
+            acts.append(f["actions"].reshape(-1))
+            logp.append(f["logp"].reshape(-1))
+            adv.append(a.reshape(-1))
+            rets.append(r.reshape(-1))
+        adv_all = np.concatenate(adv)
+        adv_all = (adv_all - adv_all.mean()) / (adv_all.std() + 1e-8)
+        batch = {
+            "obs": jnp.asarray(np.concatenate(obs)),
+            "actions": jnp.asarray(np.concatenate(acts), jnp.int32),
+            "logp_old": jnp.asarray(np.concatenate(logp)),
+            "adv": jnp.asarray(adv_all),
+            "returns": jnp.asarray(np.concatenate(rets)),
+        }
+        self._timesteps += batch["obs"].shape[0]
+        self.params, self.opt_state, stats = ppo_update(
+            self.params, self.opt_state, batch,
+            jax.random.PRNGKey(self.iteration),
+            num_epochs=cfg.num_epochs,
+            minibatch_size=min(cfg.minibatch_size,
+                               batch["obs"].shape[0]),
+            clip=cfg.clip_param, ent_coeff=cfg.entropy_coeff,
+            vf_coeff=cfg.vf_loss_coeff, grad_clip=cfg.grad_clip,
+            lr=cfg.lr)
+        self.iteration += 1
+        metrics = [ray_tpu.get(r.get_metrics.remote(), timeout=60)
+                   for r in self.runners]
+        returns = [x for m in metrics for x in m["episode_returns"]]
+        lens = [x for m in metrics for x in m["episode_lens"]]
+        return {
+            "training_iteration": self.iteration,
+            "timesteps_total": self._timesteps,
+            "episode_return_mean": (float(np.mean(returns))
+                                    if returns else float("nan")),
+            "episode_len_mean": (float(np.mean(lens))
+                                 if lens else float("nan")),
+            "num_episodes": len(returns),
+            "time_this_iter_s": time.perf_counter() - t0,
+            **{k: float(v) for k, v in stats.items()},
+        }
+
+    def evaluate(self, num_episodes: int = 5) -> Dict[str, float]:
+        """Greedy policy evaluation on a fresh local env."""
+        import gymnasium as gym
+
+        env = (gym.make(self.config.env)
+               if isinstance(self.config.env, str) else self.config.env())
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=10_000 + ep)
+            done, total = False, 0.0
+            while not done:
+                a = int(module_mod.greedy_action(
+                    self.params, np.asarray(obs, np.float32)[None])[0])
+                obs, r, term, trunc, _ = env.step(a)
+                total += float(r)
+                done = term or trunc
+            returns.append(total)
+        return {"episode_return_mean": float(np.mean(returns))}
+
+    def save(self, path: str) -> str:
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump({"params": jax.device_get(self.params),
+                         "opt_state": jax.device_get(self.opt_state),
+                         "iteration": self.iteration,
+                         "timesteps": self._timesteps,
+                         "config": self.config}, f)
+        return path
+
+    @staticmethod
+    def restore(path: str) -> "PPO":
+        import os
+
+        with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        algo = PPO(state["config"])
+        algo.params = state["params"]
+        algo.opt_state = state["opt_state"]
+        algo.iteration = state["iteration"]
+        algo._timesteps = state["timesteps"]
+        return algo
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
