@@ -1,19 +1,28 @@
-"""Global control state (GCS) tables.
+"""Global control state (GCS): tables + socket service.
 
 Counterpart of the reference's GCS server
 (/root/reference/src/ray/gcs/gcs_server/gcs_server.cc): actor registry with a
-lifecycle FSM, named-actor index, internal KV, and node table.  In this round
-it runs in-process in the head node behind a lock; the interface is kept
-narrow and message-shaped so it can move behind a socket/native service
-without touching callers.
+lifecycle FSM, named-actor index, internal KV, node table with liveness
+(gcs_health_check_manager.cc), per-node load view (the ray_syncer
+RESOURCE_VIEW channel, src/ray/common/ray_syncer/ray_syncer.h:83), and the
+object location directory (the ownership directory's role,
+src/ray/object_manager/ownership_object_directory.cc, centralized here).
+
+The head node hosts the tables in-process and serves them to other nodes
+over a socket (``GcsServer``); non-head schedulers talk through
+``GcsClient``, which implements the same method surface, so callers are
+oblivious to which side of the socket they are on.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Optional
+
+from ray_tpu._private.protocol import Connection, connect, listener
 
 # Actor lifecycle states (reference: src/ray/design_docs/actor_states.rst).
 DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
@@ -22,6 +31,10 @@ ALIVE = "ALIVE"
 RESTARTING = "RESTARTING"
 DEAD = "DEAD"
 
+# A node is declared dead after this many seconds without a heartbeat
+# (reference: gcs_health_check_manager.cc failure threshold).
+NODE_DEATH_TIMEOUT_S = float(os.environ.get("RTPU_NODE_DEATH_TIMEOUT_S", 5.0))
+
 
 @dataclass
 class ActorInfo:
@@ -29,6 +42,7 @@ class ActorInfo:
     name: Optional[str] = None
     state: str = PENDING_CREATION
     worker_id: Optional[bytes] = None
+    node_id: Optional[bytes] = None
     num_restarts: int = 0
     max_restarts: int = 0
     death_cause: Optional[str] = None
@@ -41,6 +55,13 @@ class NodeInfo:
     resources: dict = field(default_factory=dict)
     alive: bool = True
     ts: float = field(default_factory=time.time)
+    # socket addresses other nodes use to reach this node
+    sched_socket: str = ""
+    store_socket: str = ""
+    is_head: bool = False
+    # live load view, refreshed by heartbeats
+    available: dict = field(default_factory=dict)
+    queued: int = 0
 
 
 class Gcs:
@@ -51,6 +72,8 @@ class Gcs:
         self.nodes: dict[bytes, NodeInfo] = {}
         self.kv: dict[tuple[str, bytes], bytes] = {}
         self.job_config: dict = {}
+        # object_id -> set of node_ids holding a sealed copy
+        self.object_locations: dict[bytes, set[bytes]] = {}
 
     # -- actors ------------------------------------------------------------
     def register_actor(self, info: ActorInfo):
@@ -87,11 +110,64 @@ class Gcs:
     # -- nodes -------------------------------------------------------------
     def register_node(self, info: NodeInfo):
         with self._lock:
+            info.available = dict(info.resources)
             self.nodes[info.node_id] = info
 
     def list_nodes(self) -> list[NodeInfo]:
         with self._lock:
             return list(self.nodes.values())
+
+    def get_node(self, node_id: bytes) -> Optional[NodeInfo]:
+        with self._lock:
+            return self.nodes.get(node_id)
+
+    def heartbeat(self, node_id: bytes, available: dict, queued: int):
+        with self._lock:
+            info = self.nodes.get(node_id)
+            if info is None or not info.alive:
+                return
+            info.ts = time.time()
+            info.available = available
+            info.queued = queued
+
+    def mark_node_dead(self, node_id: bytes) -> bool:
+        """Returns True if the node transitioned alive -> dead.  Schedulers
+        react via the heartbeat loop's alive-set diff (_on_node_dead)."""
+        with self._lock:
+            info = self.nodes.get(node_id)
+            if info is None or not info.alive:
+                return False
+            info.alive = False
+            # drop the dead node from every object's location set
+            for locs in self.object_locations.values():
+                locs.discard(node_id)
+        return True
+
+    def check_node_health(self) -> list[bytes]:
+        """Mark nodes silent past the timeout dead; returns their ids."""
+        now = time.time()
+        with self._lock:
+            stale = [i for i, n in self.nodes.items()
+                     if n.alive and not n.is_head
+                     and now - n.ts > NODE_DEATH_TIMEOUT_S]
+        return [i for i in stale if self.mark_node_dead(i)]
+
+    # -- object directory ---------------------------------------------------
+    def add_object_location(self, oid: bytes, node_id: bytes):
+        with self._lock:
+            self.object_locations.setdefault(oid, set()).add(node_id)
+
+    def remove_object_location(self, oid: bytes, node_id: bytes):
+        with self._lock:
+            locs = self.object_locations.get(oid)
+            if locs is not None:
+                locs.discard(node_id)
+                if not locs:
+                    del self.object_locations[oid]
+
+    def get_object_locations(self, oid: bytes) -> list[bytes]:
+        with self._lock:
+            return list(self.object_locations.get(oid, ()))
 
     # -- internal KV (function/class registry, cluster metadata) -----------
     def kv_put(self, namespace: str, key: bytes, value: bytes):
@@ -109,3 +185,107 @@ class Gcs:
     def kv_keys(self, namespace: str) -> list[bytes]:
         with self._lock:
             return [k for (ns, k) in self.kv if ns == namespace]
+
+
+# ---------------------------------------------------------------------------
+# Socket service: GcsServer exposes a Gcs to other nodes; GcsClient mirrors
+# the Gcs method surface over the socket (reference: the 11 gRPC services of
+# src/ray/protobuf/gcs_service.proto, collapsed to one generic call channel).
+# ---------------------------------------------------------------------------
+
+# methods callable over the wire (everything except the death callback hook)
+_GCS_METHODS = frozenset({
+    "register_actor", "update_actor", "get_actor", "get_actor_by_name",
+    "list_actors", "register_node", "list_nodes", "get_node", "heartbeat",
+    "mark_node_dead", "add_object_location", "remove_object_location",
+    "get_object_locations", "kv_put", "kv_get", "kv_del", "kv_keys",
+})
+
+
+class GcsServer:
+    def __init__(self, gcs: Gcs, socket_path: str):
+        self.gcs = gcs
+        self.socket_path = socket_path
+        self._listener = listener(socket_path)
+        self._shutdown = False
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="gcs-accept", daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._shutdown:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(Connection(sock),),
+                             daemon=True).start()
+
+    def _serve(self, conn: Connection):
+        while True:
+            msg = conn.recv()
+            if msg is None:
+                return
+            method = msg.get("m")
+            try:
+                if method not in _GCS_METHODS:
+                    raise ValueError(f"unknown GCS method {method!r}")
+                result = getattr(self.gcs, method)(
+                    *msg.get("a", ()), **msg.get("k", {}))
+                conn.send({"ok": True, "r": result})
+            except Exception as e:  # noqa: BLE001 — serialize to caller
+                conn.send({"ok": False, "e": e})
+
+    def shutdown(self):
+        self._shutdown = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+
+
+class GcsClient:
+    """Same method surface as Gcs, over the GcsServer socket.
+
+    One persistent connection, one in-flight request at a time (guarded by a
+    lock): callers are scheduler threads making small control-plane calls.
+    """
+
+    def __init__(self, socket_path: str):
+        self._socket_path = socket_path
+        self._conn = connect(socket_path)
+        self._lock = threading.Lock()
+
+    def _call(self, method: str, *args, **kwargs):
+        with self._lock:
+            try:
+                self._conn.send({"m": method, "a": args, "k": kwargs})
+                resp = self._conn.recv()
+            except OSError:
+                resp = None
+            if resp is None:
+                # one reconnect attempt (head may have restarted the server)
+                self._conn = connect(self._socket_path)
+                self._conn.send({"m": method, "a": args, "k": kwargs})
+                resp = self._conn.recv()
+                if resp is None:
+                    raise ConnectionError("GCS connection lost")
+        if not resp["ok"]:
+            raise resp["e"]
+        return resp["r"]
+
+
+def _make_proxy(name):
+    def proxy(self, *args, **kwargs):
+        return self._call(name, *args, **kwargs)
+
+    proxy.__name__ = name
+    return proxy
+
+
+for _m in _GCS_METHODS:
+    setattr(GcsClient, _m, _make_proxy(_m))

@@ -17,7 +17,7 @@ import sys
 import time
 from typing import Optional
 
-from ray_tpu._private.gcs import Gcs, NodeInfo
+from ray_tpu._private.gcs import Gcs, GcsClient, GcsServer, NodeInfo
 from ray_tpu._private.scheduler import Scheduler
 from ray_tpu.core.store_client import StoreClient, StoreServer
 
@@ -52,6 +52,14 @@ def default_resources() -> dict:
 
 
 class Node:
+    """One cluster node: object store + scheduler (+ GCS service on the head).
+
+    head=True starts the GCS tables and serves them on ``gcs.sock`` inside
+    the session dir; worker nodes (head=False) pass ``gcs_address`` (the
+    head's gcs.sock path) and join via a GcsClient — the reference analogue
+    is services.py start_gcs_server vs start_raylet (SURVEY §3.1).
+    """
+
     def __init__(
         self,
         resources: Optional[dict] = None,
@@ -59,11 +67,14 @@ class Node:
         min_workers: int = 2,
         max_workers: Optional[int] = None,
         session_dir: Optional[str] = None,
+        head: bool = True,
+        gcs_address: Optional[str] = None,
     ):
         self.node_id = os.urandom(16)
+        self.is_head = head
         ts = time.strftime("%Y-%m-%d_%H-%M-%S")
         self.session_dir = session_dir or (
-            f"/tmp/ray_tpu/session_{ts}_{os.getpid()}"
+            f"/tmp/ray_tpu/session_{ts}_{os.getpid()}_{self.node_id[:3].hex()}"
         )
         os.makedirs(self.session_dir, exist_ok=True)
 
@@ -79,10 +90,25 @@ class Node:
             shm_name=shm_name,
             capacity=capacity,
         )
-        self.gcs = Gcs()
-        self.gcs.register_node(NodeInfo(self.node_id, resources=dict(merged)))
+        sched_socket = os.path.join(self.session_dir, "sched.sock")
+        if head:
+            self.gcs = Gcs()
+            self.gcs_server = GcsServer(
+                self.gcs, os.path.join(self.session_dir, "gcs.sock"))
+            self.gcs_address = self.gcs_server.socket_path
+        else:
+            if gcs_address is None:
+                raise ValueError("worker nodes need gcs_address "
+                                 "(the head's gcs.sock path)")
+            self.gcs = GcsClient(gcs_address)
+            self.gcs_server = None
+            self.gcs_address = gcs_address
+        self.gcs.register_node(NodeInfo(
+            self.node_id, resources=dict(merged), is_head=head,
+            sched_socket=sched_socket,
+            store_socket=self.store_server.socket_path))
         self.scheduler = Scheduler(
-            socket_path=os.path.join(self.session_dir, "sched.sock"),
+            socket_path=sched_socket,
             store_socket=self.store_server.socket_path,
             shm_name=shm_name,
             store_capacity=capacity,
@@ -90,6 +116,8 @@ class Node:
             node_resources=merged,
             min_workers=min_workers,
             max_workers=max_workers or max(4, int(merged.get("CPU", 4)) * 2),
+            node_id=self.node_id,
+            is_head=head,
         )
 
     def new_store_client(self) -> StoreClient:
@@ -102,6 +130,8 @@ class Node:
     def shutdown(self):
         self.scheduler.shutdown()
         self.store_server.shutdown()
+        if self.gcs_server is not None:
+            self.gcs_server.shutdown()
 
 
 def _default_store_capacity() -> int:

@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ray_tpu._private import gcs as gcs_mod
+from ray_tpu._private import protocol
 from ray_tpu._private.protocol import Connection, listener
 from ray_tpu._private.serialization import store_error_best_effort
 from ray_tpu.core.store_client import StoreClient
@@ -39,6 +40,13 @@ from ray_tpu.exceptions import (
 TASK = "task"
 ACTOR_CREATION = "actor_creation"
 ACTOR_METHOD = "actor_method"
+
+# Cross-node object transfer chunk (reference: object_manager.h:53
+# object_chunk_size, ~1-5MB); bounds per-message memory during pulls.
+FETCH_CHUNK = 4 << 20
+# A task may spill between nodes at most this many times before it settles
+# where it is (prevents forwarding ping-pong under racing load reports).
+MAX_SPILLS = 4
 
 # Scheduler event tracing for debugging scheduling/routing issues: set
 # RTPU_DEBUG_SCHED to a file path.  Call sites are gated on _DEBUG_SCHED so
@@ -75,6 +83,13 @@ class TaskSpec:
     pg_id: Optional[bytes] = None
     pg_bundle: Optional[int] = None
     runtime_env: Optional[dict] = None
+    # cluster scheduling (reference: hybrid policy spillback,
+    # src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.cc, and
+    # NodeAffinitySchedulingStrategy, util/scheduling_strategies.py:41)
+    spill_count: int = 0
+    node_affinity: Optional[bytes] = None
+    affinity_soft: bool = True
+    origin_node: Optional[bytes] = None  # forwarder to notify on completion
 
 
 @dataclass
@@ -113,17 +128,21 @@ class Scheduler:
         store_socket: str,
         shm_name: str,
         store_capacity: int,
-        gcs: gcs_mod.Gcs,
+        gcs,
         node_resources: dict,
         min_workers: int = 2,
         max_workers: int = 64,
         worker_env: Optional[dict] = None,
+        node_id: Optional[bytes] = None,
+        is_head: bool = True,
     ):
         self.socket_path = socket_path
         self.store_socket = store_socket
         self.shm_name = shm_name
         self.store_capacity = store_capacity
         self.gcs = gcs
+        self.node_id = node_id or os.urandom(16)
+        self.is_head = is_head
         self.total_resources = dict(node_resources)
         self.available = dict(node_resources)
         self.min_workers = min_workers
@@ -144,6 +163,20 @@ class Scheduler:
             range(int(node_resources.get("TPU", 0))))
         self._shutdown = False
 
+        # -- cluster state (multi-node) ---------------------------------
+        # cached cluster view (NodeInfo list), refreshed by the heartbeat
+        # thread so the scheduling loop never blocks on a GCS round-trip
+        self._cluster_nodes: dict[bytes, "gcs_mod.NodeInfo"] = {}
+        self._known_alive: set[bytes] = set()
+        self._peers: dict[bytes, Connection] = {}  # node_id -> sched conn
+        self._peer_lock = threading.Lock()
+        # task_id -> (node_id, spec) for specs forwarded to other nodes
+        self._forwarded: dict[bytes, tuple[bytes, TaskSpec]] = {}
+        # actor_id -> (ts, ActorInfo): TTL cache for method routing
+        self._actor_info_cache: dict[bytes, tuple[float, object]] = {}
+        self._pulls: set[bytes] = set()  # oids with an in-flight pull
+        self._pull_lock = threading.Lock()
+
         self._store = StoreClient(store_socket, shm_name, store_capacity)
         self._listener = listener(socket_path)
         self._accept_thread = threading.Thread(
@@ -152,8 +185,12 @@ class Scheduler:
         self._sched_thread = threading.Thread(
             target=self._schedule_loop, name="sched-loop", daemon=True
         )
+        self._heartbeat_thread = threading.Thread(
+            target=self._heartbeat_loop, name="sched-heartbeat", daemon=True
+        )
         self._accept_thread.start()
         self._sched_thread.start()
+        self._heartbeat_thread.start()
         for _ in range(min_workers):
             self._spawn_worker()
 
@@ -181,6 +218,17 @@ class Scheduler:
             self._task_index[spec.task_id] = spec
             self._wake.notify_all()
 
+    def submit_spilled(self, spec: TaskSpec):
+        """Accept a spec forwarded by another node's scheduler (reference:
+        the spillback re-lease in normal_task_submitter.cc:352).  Skips
+        actor registration — the originating node already did it."""
+        with self._lock:
+            if self._shutdown:
+                return
+            self._pending.append(spec)
+            self._task_index[spec.task_id] = spec
+            self._wake.notify_all()
+
     def cancel(self, task_id: bytes, force: bool = False) -> bool:
         """Cancel a pending task; with force, kill the running worker too."""
         with self._lock:
@@ -202,10 +250,29 @@ class Scheduler:
                         return True
             return False
 
+    def _cancel_remote(self, task_id: bytes, force: bool) -> bool:
+        """Relay a cancel to the node a spec was forwarded to."""
+        with self._lock:
+            fwd = self._forwarded.get(task_id)
+        if fwd is None:
+            return False
+        return self._peer_send(fwd[0], {"t": "cancel", "task_id": task_id,
+                                        "force": force})
+
     def kill_actor(self, actor_id: bytes, no_restart: bool = True):
         with self._lock:
             worker_id = self._actor_workers.get(actor_id)
             if worker_id is None:
+                # not hosted here: maybe on another node
+                info = self.gcs.get_actor(actor_id)
+                if (info is not None and info.node_id is not None
+                        and info.node_id != self.node_id):
+                    if no_restart:
+                        self.gcs.update_actor(actor_id, max_restarts=0)
+                    self._peer_send(info.node_id, {
+                        "t": "kill_actor", "actor_id": actor_id,
+                        "no_restart": no_restart})
+                    return
                 self.gcs.update_actor(actor_id, state=gcs_mod.DEAD,
                                       death_cause="killed before placement")
                 # Drop queued creation/method tasks for it.
@@ -258,6 +325,7 @@ class Scheduler:
     def state_snapshot(self) -> dict:
         with self._lock:
             return {
+                "node_id": self.node_id,
                 "num_workers": len([w for w in self._workers.values() if w.alive]),
                 "num_idle": len([w for w in self._workers.values()
                                  if w.alive and w.idle]),
@@ -302,6 +370,7 @@ class Scheduler:
             os.path.abspath(__file__))))
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
         env["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main",
              "--scheduler-socket", self.socket_path,
@@ -352,6 +421,29 @@ class Scheduler:
             elif t == "actor_exit":
                 with self._lock:
                     self.gcs.update_actor(msg["actor_id"], max_restarts=0)
+            elif t == "sealed":
+                # a worker sealed an object into this node's store: record
+                # the location so other nodes can pull it
+                try:
+                    self.gcs.add_object_location(msg["oid"], self.node_id)
+                except Exception:
+                    pass
+            elif t == "submit_spilled":
+                self.submit_spilled(msg["spec"])
+            elif t == "spilled_done":
+                with self._lock:
+                    self._forwarded.pop(msg["task_id"], None)
+            elif t == "spill_moved":
+                # a relay moved our forwarded spec to another node: track
+                # the node actually executing it for death recovery
+                with self._lock:
+                    fwd = self._forwarded.get(msg["task_id"])
+                    if fwd is not None:
+                        self._forwarded[msg["task_id"]] = (msg["node"], fwd[1])
+            elif t == "kill_actor":
+                self.kill_actor(msg["actor_id"], msg.get("no_restart", True))
+            elif t == "cancel":
+                self.cancel(msg["task_id"], msg.get("force", False))
             elif t == "blocked":
                 if worker is not None:
                     self._on_worker_blocked(worker)
@@ -381,7 +473,11 @@ class Scheduler:
             self.kill_actor(params["actor_id"], params.get("no_restart", True))
             return True
         if method == "cancel":
-            return self.cancel(params["task_id"], params.get("force", False))
+            ok = self.cancel(params["task_id"], params.get("force", False))
+            if not ok:
+                ok = self._cancel_remote(params["task_id"],
+                                         params.get("force", False))
+            return ok
         if method == "create_placement_group":
             return self.create_placement_group(
                 params["pg_id"], params["bundles"], params["strategy"])
@@ -397,7 +493,272 @@ class Scheduler:
         if method == "kv_put":
             self.gcs.kv_put(params["namespace"], params["key"], params["value"])
             return True
+        if method == "pull":
+            return self.trigger_pull(params["oid"])
+        if method == "object_locations":
+            return self.gcs.get_object_locations(params["oid"])
+        if method == "fetch_object":
+            return self._serve_fetch(params["oid"], params.get("offset", 0),
+                                     params.get("chunk", FETCH_CHUNK))
+        if method == "note_sealed":
+            self.note_sealed(params["oid"])
+            return True
+        if method == "list_nodes":
+            return [
+                {"node_id": n.node_id, "alive": n.alive,
+                 "resources": dict(n.resources),
+                 "available": dict(n.available),
+                 "is_head": n.is_head}
+                for n in self.gcs.list_nodes()]
         raise ValueError(f"unknown rpc method {method!r}")
+
+    # ------------------------------------------------------------------
+    # Cluster: object transfer (reference: object_manager/ push/pull —
+    # chunked transfer, pull retry over locations)
+    # ------------------------------------------------------------------
+    def note_sealed(self, oid: bytes):
+        """Record that this node's store holds a sealed copy of oid."""
+        try:
+            self.gcs.add_object_location(oid, self.node_id)
+        except Exception:
+            pass
+
+    def trigger_pull(self, oid: bytes) -> bool:
+        """Start (or join) an async pull of oid into the local store."""
+        with self._pull_lock:
+            if oid in self._pulls:
+                return False
+            self._pulls.add(oid)
+        threading.Thread(target=self._pull_object, args=(oid,),
+                         daemon=True).start()
+        return True
+
+    def _pull_object(self, oid: bytes):
+        """One pull attempt: if any remote node holds the object, fetch it.
+
+        Exits immediately when no remote copy exists yet (the object is
+        still being computed) — the waiting getter re-requests the pull
+        periodically, so there is no long-lived polling thread per object
+        and no deadline after which a slow producer's result becomes
+        unfetchable."""
+        try:
+            for _ in range(3):  # a few attempts over the location set
+                if self._shutdown:
+                    return
+                try:
+                    if self._store.contains(oid):
+                        return
+                    locs = self.gcs.get_object_locations(oid)
+                except Exception:
+                    return
+                remote = [n for n in locs if n != self.node_id]
+                if not remote:
+                    return  # not sealed anywhere else yet
+                for nid in remote:
+                    node = self._cluster_nodes.get(nid) or self.gcs.get_node(nid)
+                    if node is None or not node.alive or not node.sched_socket:
+                        continue
+                    if self._fetch_from(node.sched_socket, oid):
+                        self.note_sealed(oid)
+                        return
+                time.sleep(0.1)
+        finally:
+            with self._pull_lock:
+                self._pulls.discard(oid)
+
+    def _fetch_from(self, sched_socket: str, oid: bytes) -> bool:
+        """Chunked fetch over a dedicated connection (big transfers must not
+        head-of-line-block control messages)."""
+        try:
+            conn = protocol.connect(sched_socket)
+        except OSError:
+            return False
+        try:
+            data = bytearray()
+            size = None
+            while size is None or len(data) < size:
+                conn.send({"t": "rpc", "method": "fetch_object",
+                           "params": {"oid": oid, "offset": len(data),
+                                      "chunk": FETCH_CHUNK}})
+                resp = conn.recv()
+                if (resp is None or not resp.get("ok")
+                        or not resp["result"]["found"]):
+                    return False
+                r = resp["result"]
+                size = r["size"]
+                data += r["data"]
+                if size == 0:
+                    break
+            try:
+                buf = self._store.create(oid, len(data))
+                buf[:len(data)] = bytes(data)
+                self._store.seal(oid)
+            except FileExistsError:
+                pass  # concurrent pull/local compute won the race
+            return True
+        except OSError:
+            return False
+        finally:
+            conn.close()
+
+    def _serve_fetch(self, oid: bytes, offset: int, chunk: int) -> dict:
+        view = self._store.get(oid, 0)
+        if view is None:
+            return {"found": False}
+        try:
+            size = len(view)
+            return {"found": True, "size": size,
+                    "data": bytes(view[offset:offset + chunk])}
+        finally:
+            self._store.release(oid)
+
+    # ------------------------------------------------------------------
+    # Cluster: peer forwarding + liveness (reference: ray_syncer resource
+    # broadcast ray_syncer.h:83 + gcs_health_check_manager.cc, collapsed
+    # into one heartbeat/reconcile loop per scheduler)
+    # ------------------------------------------------------------------
+    def _heartbeat_loop(self):
+        while not self._shutdown:
+            try:
+                with self._lock:
+                    available = dict(self.available)
+                    queued = len(self._pending)
+                self.gcs.heartbeat(self.node_id, available, queued)
+                if self.is_head:
+                    self.gcs.check_node_health()
+                nodes = {n.node_id: n for n in self.gcs.list_nodes()}
+                self._cluster_nodes = nodes
+                alive = {i for i, n in nodes.items() if n.alive}
+                newly_dead = self._known_alive - alive
+                self._known_alive = alive
+                for nid in newly_dead:
+                    if nid != self.node_id:
+                        self._on_node_dead(nid)
+                if alive - {self.node_id}:
+                    # remote work may now be schedulable (or newly arrived
+                    # capacity may unblock the queue)
+                    with self._lock:
+                        self._wake.notify_all()
+            except Exception:
+                if not self._shutdown:
+                    traceback.print_exc()
+            time.sleep(0.25 if len(self._known_alive) > 1 else 0.5)
+
+    def _peer_send(self, node_id: bytes, msg: dict) -> bool:
+        """Send a one-way control message to another node's scheduler."""
+        with self._peer_lock:
+            conn = self._peers.get(node_id)
+            if conn is None:
+                node = self._cluster_nodes.get(node_id)
+                if node is None:
+                    try:
+                        node = self.gcs.get_node(node_id)
+                    except Exception:
+                        node = None
+                if node is None or not node.alive or not node.sched_socket:
+                    return False
+                try:
+                    conn = protocol.connect(node.sched_socket)
+                except OSError:
+                    return False
+                self._peers[node_id] = conn
+        try:
+            conn.send(msg)
+            return True
+        except OSError:
+            with self._peer_lock:
+                self._peers.pop(node_id, None)
+            return False
+
+    def _forward(self, spec: TaskSpec, node_id: bytes) -> bool:
+        """Hand a pending spec to another node (caller holds the lock).
+
+        The ORIGIN (first forwarder) owns recovery for the spec: it keeps
+        the _forwarded record, receives spilled_done on completion, and
+        requeues on target-node death.  A relay hop (re-spill of a spec
+        that already has an origin) records nothing and instead tells the
+        origin where the spec moved, so the origin's record tracks the
+        node actually executing it.  (In the narrow race where a relay
+        dies after sending the spec onward but before the origin processes
+        spill_moved, the origin may requeue a task that also runs at the
+        new target — same at-least-once window the reference accepts for
+        retryable tasks.)
+        """
+        relay = spec.origin_node is not None and spec.origin_node != self.node_id
+        if not relay:
+            spec.origin_node = self.node_id
+        if not self._peer_send(node_id, {"t": "submit_spilled", "spec": spec}):
+            if not relay:
+                spec.origin_node = None
+            return False
+        self._task_index.pop(spec.task_id, None)
+        if relay:
+            self._peer_send(spec.origin_node, {
+                "t": "spill_moved", "task_id": spec.task_id,
+                "node": node_id})
+        else:
+            self._forwarded[spec.task_id] = (node_id, spec)
+        if _DEBUG_SCHED:
+            _dbg(f"forward {spec.kind} {spec.name} -> {node_id.hex()[:8]}"
+                 f"{' (relay)' if relay else ''}")
+        return True
+
+    def _notify_origin(self, spec: TaskSpec):
+        if spec.origin_node and spec.origin_node != self.node_id:
+            self._peer_send(spec.origin_node,
+                            {"t": "spilled_done", "task_id": spec.task_id})
+
+    def _on_node_dead(self, node_id: bytes):
+        """Reconcile after a peer died: recover forwarded specs; on the
+        head, restart (or fail) actors that lived there (reference:
+        gcs_actor_manager.cc:1319 OnActorDead/RestartActor)."""
+        with self._peer_lock:
+            self._peers.pop(node_id, None)
+        with self._lock:
+            orphaned = [(tid, spec) for tid, (nid, spec)
+                        in self._forwarded.items() if nid == node_id]
+            for tid, spec in orphaned:
+                del self._forwarded[tid]
+                spec.origin_node = None
+                spec.spill_count = 0
+                if spec.kind == ACTOR_METHOD:
+                    # requeue: routes to the restarted actor, or fails via
+                    # the DEAD-actor check in the scheduling loop
+                    self._pending.appendleft(spec)
+                    self._task_index[spec.task_id] = spec
+                elif spec.retries_left > 0:
+                    spec.retries_left -= 1
+                    self._pending.appendleft(spec)
+                    self._task_index[spec.task_id] = spec
+                else:
+                    self._fail_task(spec, WorkerCrashedError(
+                        f"node {node_id.hex()[:8]} died executing "
+                        f"{spec.name}"))
+            self._wake.notify_all()
+        if not self.is_head:
+            return
+        # head: restart actors that lived on the dead node
+        try:
+            actors = self.gcs.list_actors()
+        except Exception:
+            return
+        for info in actors:
+            if info.node_id != node_id or info.state == gcs_mod.DEAD:
+                continue
+            restarts_ok = (info.max_restarts == -1
+                           or info.num_restarts < info.max_restarts)
+            if restarts_ok:
+                self.gcs.update_actor(info.actor_id,
+                                      state=gcs_mod.RESTARTING,
+                                      num_restarts=info.num_restarts + 1,
+                                      worker_id=None, node_id=None)
+                creation = self._creation_spec_for(info.actor_id)
+                if creation is not None:
+                    self.submit_spilled(creation)
+            else:
+                self.gcs.update_actor(
+                    info.actor_id, state=gcs_mod.DEAD,
+                    death_cause=f"node {node_id.hex()[:8]} died")
 
     def _on_worker_blocked(self, worker: WorkerState):
         with self._lock:
@@ -458,7 +819,8 @@ class Scheduler:
                          f"ok={msg['ok']} err={msg.get('error')}")
                 if msg["ok"]:
                     self.gcs.update_actor(spec.actor_id, state=gcs_mod.ALIVE,
-                                          worker_id=worker.worker_id)
+                                          worker_id=worker.worker_id,
+                                          node_id=self.node_id)
                 else:
                     self.gcs.update_actor(spec.actor_id, state=gcs_mod.DEAD,
                                           death_cause=msg.get("error"))
@@ -471,10 +833,16 @@ class Scheduler:
                 worker.idle = True
             # ACTOR_METHOD: worker stays bound to the actor; nothing to release.
             self._wake.notify_all()
+        self._notify_origin(spec)
 
     def _on_worker_death(self, worker: WorkerState):
         with self._lock:
             if not worker.alive:
+                return
+            if self._shutdown:
+                # node-level teardown: do NOT consume actor restart budget
+                # or retry tasks here — the head's node-death reconcile owns
+                # recovery for this node's actors and forwarded work
                 return
             worker.alive = False
             worker.idle = False
@@ -570,10 +938,13 @@ class Scheduler:
 
     def _fail_task(self, spec: TaskSpec, exc: Exception):
         for oid in spec.return_ids:
-            if not store_error_best_effort(self._store, oid, exc, ""):
+            if store_error_best_effort(self._store, oid, exc, ""):
+                self.note_sealed(oid)  # callers on other nodes pull errors
+            else:
                 traceback.print_exc()
                 print(f"FATAL: could not record error for {oid.hex()[:12]}; "
                       f"gets on it will hang", flush=True)
+        self._notify_origin(spec)
 
     # ------------------------------------------------------------------
     # Scheduling loop
@@ -594,6 +965,30 @@ class Scheduler:
                 traceback.print_exc()
                 time.sleep(0.05)
 
+    def _actor_info_cached(self, actor_id: bytes):
+        """Actor placement with a short TTL cache: on non-head nodes a GCS
+        lookup is a socket round trip, and this runs per pending method per
+        pass while holding the scheduler lock.  The TTL only delays when a
+        method stream NOTICES a placement change (routing corrects itself
+        next refresh); locally-hosted actors short-circuit via
+        _actor_workers before this is consulted."""
+        now = time.monotonic()
+        cached = self._actor_info_cache.get(actor_id)
+        if cached is not None and now - cached[0] < 0.25:
+            return cached[1]
+        try:
+            info = self.gcs.get_actor(actor_id)
+        except Exception:
+            return cached[1] if cached is not None else None
+        self._actor_info_cache[actor_id] = (now, info)
+        if info is not None and info.state == gcs_mod.DEAD:
+            # terminal: keep one tombstone entry, drop stale neighbors
+            if len(self._actor_info_cache) > 4096:
+                self._actor_info_cache = {
+                    a: v for a, v in self._actor_info_cache.items()
+                    if now - v[0] < 1.0}
+        return info
+
     def _try_schedule_locked(self) -> bool:
         """Dispatch as many pending tasks as possible; True if progress made."""
         progress = False
@@ -602,7 +997,7 @@ class Scheduler:
             spec = self._pending.popleft()
             if spec.kind == ACTOR_METHOD:
                 worker_id = self._actor_workers.get(spec.actor_id)
-                info = self.gcs.get_actor(spec.actor_id)
+                info = self._actor_info_cached(spec.actor_id)
                 if info is None:
                     # Never registered (e.g. creation rejected): fail fast
                     # rather than queueing forever.
@@ -618,6 +1013,14 @@ class Scheduler:
                         f"actor {spec.actor_id.hex()[:8]} is dead: "
                         f"{info.death_cause}"))
                     progress = True
+                    continue
+                if (info.node_id is not None
+                        and info.node_id != self.node_id):
+                    # actor lives on another node: forward the call there
+                    if self._forward(spec, info.node_id):
+                        progress = True
+                    else:
+                        remaining.append(spec)
                     continue
                 if worker_id is None or worker_id not in self._workers:
                     remaining.append(spec)  # actor still being (re)created
@@ -635,9 +1038,41 @@ class Scheduler:
                 progress = True
                 continue
 
+            if (spec.node_affinity is not None
+                    and spec.node_affinity != self.node_id):
+                # NodeAffinitySchedulingStrategy: run on the named node if
+                # it is alive (reference: scheduling_strategies.py:41).
+                # The cached view lags new registrations by a heartbeat
+                # tick, so miss -> authoritative GCS lookup (rare path).
+                target = self._cluster_nodes.get(spec.node_affinity)
+                if target is None:
+                    try:
+                        target = self.gcs.get_node(spec.node_affinity)
+                        if target is not None:
+                            self._cluster_nodes[spec.node_affinity] = target
+                    except Exception:
+                        target = None
+                if target is not None and target.alive:
+                    if self._forward(spec, spec.node_affinity):
+                        progress = True
+                    else:
+                        remaining.append(spec)
+                    continue
+                if not spec.affinity_soft:
+                    self._task_index.pop(spec.task_id, None)
+                    self._fail_task(spec, WorkerCrashedError(
+                        f"node affinity target "
+                        f"{spec.node_affinity.hex()[:8]} is dead"))
+                    progress = True
+                    continue
+                # soft affinity to a dead node: fall through, run anywhere
             granted = self._acquire_resources(spec)
             if granted is None:
-                remaining.append(spec)
+                target = self._spill_target(spec)
+                if target is not None and self._forward(spec, target):
+                    progress = True
+                else:
+                    remaining.append(spec)
                 continue
             w = self._find_idle_worker()
             if w is None:
@@ -662,6 +1097,46 @@ class Scheduler:
             progress = True
         self._pending = remaining
         return progress
+
+    def _spill_target(self, spec: TaskSpec) -> Optional[bytes]:
+        """Pick a peer node for a task this node can't run right now
+        (reference: hybrid policy spillback,
+        policy/hybrid_scheduling_policy.cc — local-first, then best
+        feasible remote by available capacity).  Caller holds the lock."""
+        if spec.pg_id is not None or spec.spill_count >= MAX_SPILLS:
+            return None  # PG bundles are reserved on this node
+        if (spec.node_affinity == self.node_id
+                and not spec.affinity_soft):
+            return None
+        res = spec.resources or {}
+        locally_feasible = all(
+            self.total_resources.get(k, 0) >= v for k, v in res.items())
+        best, best_score = None, -1.0
+        for nid, node in self._cluster_nodes.items():
+            if nid == self.node_id or not node.alive:
+                continue
+            if not all(node.resources.get(k, 0) >= v
+                       for k, v in res.items()):
+                continue  # never feasible there
+            has_now = all(node.available.get(k, 0) >= v
+                          for k, v in res.items())
+            if not has_now and locally_feasible:
+                # feasible here eventually: only spill to nodes with free
+                # capacity right now
+                continue
+            score = (1000.0 if has_now else 0.0) + sum(
+                node.available.get(k, 0) for k in ("CPU", "TPU"))
+            if score > best_score:
+                best, best_score = nid, score
+        if best is not None:
+            spec.spill_count += 1
+            # debit the cached view so the NEXT task in this scheduling
+            # pass picks a different node instead of dogpiling this one;
+            # the target's own heartbeat re-syncs the true value
+            avail = self._cluster_nodes[best].available
+            for k, v in res.items():
+                avail[k] = avail.get(k, 0) - v
+        return best
 
     def _acquire_resources(self, spec: TaskSpec) -> Optional[dict]:
         res = spec.resources or {}

@@ -36,6 +36,7 @@ class WorkerContext:
         worker_id: bytes = b"",
         node=None,
         block_notify_fn: Optional[Callable] = None,
+        seal_notify_fn: Optional[Callable] = None,
     ):
         self.mode = mode
         self.store = store
@@ -43,6 +44,10 @@ class WorkerContext:
         self.rpc = rpc_fn
         self.worker_id = worker_id
         self.node = node
+        # Called with the oid after each local seal so the scheduler can
+        # publish the object's location to the GCS directory (multi-node
+        # pulls); None in single-purpose contexts that never share objects.
+        self._seal_notify = seal_notify_fn
         # Called with True/False around blocking waits so the scheduler can
         # release/re-acquire this worker's resource grant — prevents
         # dependency-chain deadlocks on small nodes.
@@ -89,6 +94,8 @@ class WorkerContext:
             # consumer blocking on this id.
             self.store.abort(oid)
             raise
+        if self._seal_notify is not None:
+            self._seal_notify(oid)
         return ObjectRef(oid)
 
     def get_object(self, ref: ObjectRef, timeout: Optional[float] = None):
@@ -108,11 +115,19 @@ class WorkerContext:
             return deserialize(view, release_cb=lambda o=oid: self.store.release(o))
         deadline = None if timeout is None else time.monotonic() + timeout
         blocked = False
+        next_pull = time.monotonic()
         try:
             while True:
                 if not blocked and self._block_notify is not None:
                     self._block_notify(True)
                     blocked = True
+                if time.monotonic() >= next_pull:
+                    # object may live on another node: ask the local
+                    # scheduler to pull it.  The pull exits immediately if
+                    # the object isn't sealed anywhere yet, so re-request
+                    # periodically for as long as we keep waiting.
+                    next_pull = time.monotonic() + 2.0
+                    self.request_pull(oid)
                 view = self.store.get(oid, _GET_CHUNK_MS)
                 if view is not None:
                     return deserialize(
@@ -126,16 +141,45 @@ class WorkerContext:
             if blocked:
                 self._block_notify(False)
 
+    def request_pull(self, oid: bytes):
+        try:
+            self.rpc("pull", {"oid": oid})
+        except Exception:
+            pass  # pulls are best-effort; the caller keeps polling
+
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
         pending = list(refs)
         ready: list[ObjectRef] = []
         deadline = None if timeout is None else time.monotonic() + timeout
         blocked = False
+        next_pull = time.monotonic()
+        remote_ready: set[bytes] = set()  # fetch_local=False: seen in GCS
         try:
             while True:
+                if time.monotonic() >= next_pull:
+                    if fetch_local:
+                        next_pull = time.monotonic() + 2.0
+                        for ref in pending:
+                            if not self.store.contains(ref.binary()):
+                                self.request_pull(ref.binary())
+                    else:
+                        # ready = sealed ANYWHERE in the cluster (reference
+                        # semantics: fetch_local=False doesn't move data)
+                        next_pull = time.monotonic() + 0.2
+                        for ref in pending:
+                            oid = ref.binary()
+                            if (oid not in remote_ready
+                                    and not self.store.contains(oid)):
+                                try:
+                                    if self.rpc("object_locations",
+                                                {"oid": oid}):
+                                        remote_ready.add(oid)
+                                except Exception:
+                                    pass
                 still = []
                 for ref in pending:
-                    if self.store.contains(ref.binary()):
+                    if (self.store.contains(ref.binary())
+                            or ref.binary() in remote_ready):
                         ready.append(ref)
                     else:
                         still.append(ref)
@@ -165,6 +209,8 @@ class WorkerContext:
         finally:
             buf.release()
         self.store.seal(fn_id)
+        if self._seal_notify is not None:
+            self._seal_notify(fn_id)
         self._fn_cache[id(fn)] = (fn, fn_id)
         return fn_id
 

@@ -47,6 +47,8 @@ class WorkerRuntime:
             worker_id=self.worker_id,
             block_notify_fn=lambda blocked: self.conn.send(
                 {"t": "blocked" if blocked else "unblocked"}),
+            seal_notify_fn=lambda oid: self.conn.send(
+                {"t": "sealed", "oid": oid}),
         )
         set_global_worker(self.ctx)
 
@@ -96,7 +98,12 @@ class WorkerRuntime:
     def _load_function(self, fn_id: bytes):
         fn = self.fn_cache.get(fn_id)
         if fn is None:
-            view = self.store.get(fn_id, 60_000)
+            view = self.store.get(fn_id, 0)
+            if view is None:
+                # blob may live on the submitting node (spilled task):
+                # pull it into the local store, then wait
+                self.ctx.request_pull(fn_id)
+                view = self.store.get(fn_id, 60_000)
             if view is None:
                 raise RuntimeError(f"function blob {fn_id.hex()[:12]} not found")
             try:
@@ -164,8 +171,10 @@ class WorkerRuntime:
                 # raised_by_task distinguishes "this task ran and raised"
                 # (even a propagated ActorDiedError from an upstream get)
                 # from transport-level failures the scheduler records
-                if not store_error_best_effort(self.store, oid, e, tb,
-                                               raised_by_task=True):
+                if store_error_best_effort(self.store, oid, e, tb,
+                                           raised_by_task=True):
+                    self.conn.send({"t": "sealed", "oid": oid})
+                else:
                     print(f"FATAL: could not record error for "
                           f"{oid.hex()[:12]}", file=sys.stderr, flush=True)
         finally:

@@ -32,8 +32,10 @@ def init(
     min_workers: int = 2,
     max_workers: Optional[int] = None,
     ignore_reinit_error: bool = False,
+    _existing_node: Optional["Node"] = None,
 ) -> "Node":
-    """Start (or connect to) a cluster. Only local mode in this round."""
+    """Start a cluster (or attach the driver to an existing head node —
+    used by cluster_utils.Cluster, which owns that node's lifecycle)."""
     global _global_node
     if worker_mod.is_initialized():
         if ignore_reinit_error:
@@ -42,21 +44,28 @@ def init(
                            "ignore_reinit_error=True to ignore")
     if address is not None:
         raise NotImplementedError(
-            "remote cluster addresses are not supported yet; multi-node "
-            "bootstrap lands with the distributed GCS")
+            "remote cluster addresses are not supported yet; attach to an "
+            "in-process cluster with ray_tpu.cluster_utils.Cluster")
     res = dict(resources or {})
     if num_cpus is not None:
         res["CPU"] = float(num_cpus)
     if num_tpus is not None:
         res["TPU"] = float(num_tpus)
-    node = Node(
+    node = _existing_node or Node(
         resources=res or None,
         object_store_memory=object_store_memory,
         min_workers=min_workers,
         max_workers=max_workers,
     )
     _global_node = node
+    _attach_driver(node)
+    if _existing_node is None:
+        atexit.register(shutdown)
+    return node
 
+
+def _attach_driver(node: Node):
+    """Wire the driver-side WorkerContext to a (head) node's services."""
     scheduler = node.scheduler
 
     def driver_rpc(method: str, params: dict):
@@ -68,10 +77,10 @@ def init(
         submit_fn=scheduler.submit,
         rpc_fn=driver_rpc,
         node=node,
+        seal_notify_fn=scheduler.note_sealed,
     )
     worker_mod.set_global_worker(ctx)
-    atexit.register(shutdown)
-    return node
+    return ctx
 
 
 def shutdown():
@@ -163,12 +172,38 @@ def get_actor(name: str) -> ActorHandle:
     return ActorHandle(info["actor_id"], info["class_name"])
 
 
+def nodes() -> list:
+    """Cluster node table (reference: ray.nodes()): one dict per node with
+    NodeID, Alive, Resources, and head flag."""
+    raw = global_worker().rpc("list_nodes", {})
+    return [{"NodeID": n["node_id"].hex(), "Alive": n["alive"],
+             "Resources": n["resources"], "Available": n["available"],
+             "IsHead": n["is_head"]} for n in raw]
+
+
 def cluster_resources() -> dict:
-    return global_worker().rpc("cluster_state", {})["total_resources"]
+    """Total resources summed over all live nodes."""
+    total: dict = {}
+    for n in global_worker().rpc("list_nodes", {}):
+        if n["alive"]:
+            for k, v in n["resources"].items():
+                total[k] = total.get(k, 0) + v
+    return total
 
 
 def available_resources() -> dict:
-    return global_worker().rpc("cluster_state", {})["available_resources"]
+    """Currently-available resources summed over all live nodes.
+
+    The local node's view is authoritative (live counters); peers are as
+    of their last heartbeat."""
+    local = global_worker().rpc("cluster_state", {})
+    avail = dict(local["available_resources"])
+    local_id = local.get("node_id")
+    for n in global_worker().rpc("list_nodes", {}):
+        if n["alive"] and n["node_id"] != local_id:
+            for k, v in n["available"].items():
+                avail[k] = avail.get(k, 0) + v
+    return avail
 
 
 class RuntimeContext:
@@ -186,6 +221,14 @@ class RuntimeContext:
     def get_task_id(self) -> Optional[str]:
         tid = self._worker.current_task_id
         return tid.hex() if tid else None
+
+    def node_id_hex(self) -> str:
+        """Hex id of the node this process runs on."""
+        import os
+
+        if self._worker.node is not None:  # driver
+            return self._worker.node.node_id.hex()
+        return os.environ.get("RAY_TPU_NODE_ID", "")
 
     def get_worker_id(self) -> str:
         return self._worker.worker_id.hex()

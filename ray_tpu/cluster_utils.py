@@ -1,0 +1,79 @@
+"""In-process multi-node cluster for tests and local experimentation.
+
+Counterpart of the reference's test workhorse
+(/root/reference/python/ray/cluster_utils.py:135 ``Cluster``): a head node
+(GCS service + scheduler + store) plus N worker nodes, each with its OWN
+object store (separate shm segment) and worker pool, joined through the
+head's GCS socket.  Node services run as threads in the calling process —
+workers are real subprocesses either way, so scheduling, spillback, object
+transfer, and node-death recovery exercise the same code paths a multi-host
+deployment would.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ray_tpu._private.node import Node
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True,
+                 head_node_args: Optional[dict] = None):
+        self.head_node: Optional[Node] = None
+        self.worker_nodes: list[Node] = []
+        if initialize_head:
+            self.add_node(**(head_node_args or {}))
+
+    @property
+    def gcs_address(self) -> str:
+        return self.head_node.gcs_address
+
+    def add_node(self, **node_args) -> Node:
+        """Start one more node; the first becomes the head."""
+        if self.head_node is None:
+            node = Node(head=True, **node_args)
+            self.head_node = node
+        else:
+            node = Node(head=False, gcs_address=self.gcs_address,
+                        **node_args)
+            self.worker_nodes.append(node)
+        return node
+
+    def remove_node(self, node: Node, allow_graceful: bool = True):
+        """Stop a node and broadcast its death (reference:
+        Cluster.remove_node kills the raylet; GCS health checks notice).
+
+        allow_graceful=False skips the immediate GCS notification so death
+        is discovered by heartbeat timeout — the crash-like path."""
+        if node is self.head_node:
+            raise ValueError("removing the head node tears down the "
+                             "cluster; use shutdown()")
+        if node in self.worker_nodes:
+            self.worker_nodes.remove(node)
+        node.shutdown()
+        if allow_graceful and self.head_node is not None:
+            self.head_node.gcs.mark_node_dead(node.node_id)
+
+    def wait_for_nodes(self, timeout: float = 30.0) -> int:
+        """Block until every added node is alive in the GCS; returns the
+        live count."""
+        want = 1 + len(self.worker_nodes)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            alive = len([n for n in self.head_node.gcs.list_nodes()
+                         if n.alive])
+            if alive >= want:
+                return alive
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"only {alive}/{want} nodes alive after {timeout}s")
+
+    def shutdown(self):
+        for node in self.worker_nodes:
+            node.shutdown()
+        self.worker_nodes = []
+        if self.head_node is not None:
+            self.head_node.shutdown()
+            self.head_node = None

@@ -36,16 +36,25 @@ def resolve_resources(options: dict, default_num_cpus: float = 1) -> dict:
 
 
 def strategy_fields(options: dict) -> dict:
-    """Extract pg routing from a scheduling_strategy option."""
+    """Extract pg routing / node affinity from a scheduling_strategy."""
     strategy = options.get("scheduling_strategy")
     pg = options.get("placement_group")
     bundle = options.get("placement_group_bundle_index")
     if strategy is not None and hasattr(strategy, "placement_group"):
         pg = strategy.placement_group
         bundle = strategy.placement_group_bundle_index
-    if pg is None:
-        return {}
-    return {"pg_id": pg.id, "pg_bundle": 0 if bundle in (None, -1) else bundle}
+    if pg is not None:
+        return {"pg_id": pg.id,
+                "pg_bundle": 0 if bundle in (None, -1) else bundle}
+    if strategy is not None and hasattr(strategy, "node_id"):
+        # NodeAffinitySchedulingStrategy: node_id is hex (as returned by
+        # ray_tpu.nodes()) or raw bytes
+        nid = strategy.node_id
+        if isinstance(nid, str):
+            nid = bytes.fromhex(nid)
+        return {"node_affinity": nid,
+                "affinity_soft": bool(getattr(strategy, "soft", False))}
+    return {}
 
 
 class RemoteFunction:

@@ -244,8 +244,12 @@ class ServeController:
                         changed |= self._probe_and_autoscale(ds)
                         changed |= self._health_check(ds)
                     with self._lock:
+                        # RUNNING requires the FULL target per deployment
+                        # (reference: app is RUNNING when every deployment
+                        # is HEALTHY at target), so serve.run returning
+                        # means the whole replica set serves traffic
                         ready = all(
-                            len(d.replicas) >= min(1, d.target_replicas)
+                            len(d.replicas) >= d.target_replicas
                             for d in app.deployments.values())
                         new_status = "RUNNING" if ready else "DEPLOYING"
                         if new_status != app.status:

@@ -1,0 +1,221 @@
+"""Multi-node cluster tests (reference: python/ray/tests with the
+ray_start_cluster fixture, cluster_utils.py:135 — spillback scheduling,
+cross-node object transfer, node death recovery)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+
+@pytest.fixture
+def cluster():
+    """Fresh 2-node cluster per test (head CPU:2, worker CPU:2)."""
+    # must not collide with the session cluster: drop the global ctx first
+    import ray_tpu.api as api
+    from ray_tpu._private import worker as worker_mod
+
+    prev_ctx = worker_mod._global_worker
+    prev_node = api._global_node
+    worker_mod.set_global_worker(None)
+    api._global_node = None
+
+    c = Cluster(head_node_args={
+        "resources": {"CPU": 2.0}, "min_workers": 1,
+        "object_store_memory": 1 << 27})
+    ray_tpu.init(_existing_node=c.head_node)
+    try:
+        yield c
+    finally:
+        api._global_node = None
+        worker_mod.set_global_worker(None)
+        c.shutdown()
+        worker_mod.set_global_worker(prev_ctx)
+        api._global_node = prev_node
+
+
+def _add_worker(c, cpus=2.0, **kw):
+    node = c.add_node(resources={"CPU": cpus}, min_workers=1,
+                      object_store_memory=1 << 27, **kw)
+    c.wait_for_nodes()
+    return node
+
+
+def test_nodes_api_and_resources(cluster):
+    _add_worker(cluster)
+    nodes = ray_tpu.nodes()
+    assert len(nodes) == 2
+    assert all(n["Alive"] for n in nodes)
+    assert sum(1 for n in nodes if n["IsHead"]) == 1
+    assert ray_tpu.cluster_resources().get("CPU", 0) == 4.0
+
+
+def test_task_spills_to_second_node(cluster):
+    worker_node = _add_worker(cluster)
+
+    @ray_tpu.remote
+    def where():
+        import time
+
+        time.sleep(0.4)  # hold the slot so later tasks must spread
+        import ray_tpu as rt
+
+        return rt.get_runtime_context().node_id_hex()
+
+    # 6 concurrent 1-CPU tasks on a 2+2 CPU cluster: both nodes must serve
+    refs = [where.remote() for _ in range(6)]
+    homes = set(ray_tpu.get(refs, timeout=120))
+    assert worker_node.node_id.hex() in homes
+    assert cluster.head_node.node_id.hex() in homes
+
+
+def test_object_transfer_between_nodes(cluster):
+    worker_node = _add_worker(cluster)
+    target = worker_node.node_id.hex()
+
+    @ray_tpu.remote
+    def produce(n):
+        import numpy as np
+
+        return np.arange(n, dtype=np.int64)
+
+    # force execution on the worker node, then fetch from the driver (head):
+    # the value must cross stores via pull
+    ref = produce.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        target)).remote(300_000)
+    arr = ray_tpu.get(ref, timeout=60)
+    assert arr.shape == (300_000,) and int(arr[-1]) == 299_999
+
+    # and the reverse: a driver-side put consumed on the worker node
+    import numpy as np
+
+    big = ray_tpu.put(np.ones(100_000, np.float64))
+
+    @ray_tpu.remote
+    def consume(x):
+        return float(x.sum())
+
+    total = ray_tpu.get(consume.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(target)).remote(
+        big), timeout=60)
+    assert total == 100_000.0
+
+
+def test_node_affinity_hard_and_soft(cluster):
+    worker_node = _add_worker(cluster)
+    target = worker_node.node_id.hex()
+
+    @ray_tpu.remote
+    def where():
+        import ray_tpu as rt
+
+        return rt.get_runtime_context().node_id_hex()
+
+    assert ray_tpu.get(where.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(target)).remote(),
+        timeout=60) == target
+
+
+def test_actor_on_remote_node_and_cross_node_calls(cluster):
+    worker_node = _add_worker(cluster)
+    target = worker_node.node_id.hex()
+
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self, k=1):
+            self.n += k
+            return self.n
+
+        def home(self):
+            import ray_tpu as rt
+
+            return rt.get_runtime_context().node_id_hex()
+
+    C = ray_tpu.remote(Counter)
+    c = C.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        target)).remote()
+    assert ray_tpu.get(c.home.remote(), timeout=60) == target
+    # ordered method stream across the node boundary
+    vals = ray_tpu.get([c.inc.remote() for _ in range(5)], timeout=60)
+    assert vals == [1, 2, 3, 4, 5]
+    ray_tpu.kill(c)
+    with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+        ray_tpu.get(c.inc.remote(), timeout=30)
+
+
+def test_actor_restarts_on_other_node_after_node_death(cluster):
+    worker_node = _add_worker(cluster)
+    target = worker_node.node_id.hex()
+
+    class Stateful:
+        def __init__(self):
+            self.calls = 0
+
+        def bump(self):
+            self.calls += 1
+            return self.calls
+
+        def home(self):
+            import ray_tpu as rt
+
+            return rt.get_runtime_context().node_id_hex()
+
+    S = ray_tpu.remote(Stateful)
+    a = S.options(max_restarts=1, scheduling_strategy=
+                  NodeAffinitySchedulingStrategy(target, soft=True)).remote()
+    assert ray_tpu.get(a.home.remote(), timeout=60) == target
+    assert ray_tpu.get(a.bump.remote(), timeout=60) == 1
+
+    cluster.remove_node(worker_node)
+    # the head must notice the death, restart the actor locally, and the
+    # next call must land on the fresh instance
+    deadline = time.time() + 60
+    while True:
+        try:
+            home = ray_tpu.get(a.home.remote(), timeout=30)
+            break
+        except ray_tpu.exceptions.RayTpuError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.5)
+    assert home == cluster.head_node.node_id.hex()
+    assert ray_tpu.get(a.bump.remote(), timeout=30) == 1  # fresh state
+
+
+def test_forwarded_task_retries_after_node_death(cluster):
+    worker_node = _add_worker(cluster, cpus=4.0)
+
+    @ray_tpu.remote
+    def slow_identity(x):
+        import time
+
+        time.sleep(1.5)
+        return x
+
+    # saturate the head (CPU:2) so extra tasks spill to the worker node
+    refs = [slow_identity.options(max_retries=2).remote(i)
+            for i in range(6)]
+    time.sleep(0.9)  # let the spill + dispatch happen
+    cluster.remove_node(worker_node)
+    # spilled tasks must be recovered (retried on the head) — every result
+    # arrives despite the dead node
+    assert sorted(ray_tpu.get(refs, timeout=120)) == list(range(6))
+
+
+def test_error_propagates_across_nodes(cluster):
+    worker_node = _add_worker(cluster)
+    target = worker_node.node_id.hex()
+
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("remote-node boom")
+
+    with pytest.raises(ValueError, match="remote-node boom"):
+        ray_tpu.get(boom.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                target)).remote(), timeout=60)
