@@ -169,6 +169,11 @@ class Gcs:
         with self._lock:
             return list(self.object_locations.get(oid, ()))
 
+    def all_object_locations(self) -> dict[bytes, list[bytes]]:
+        with self._lock:
+            return {oid: list(locs)
+                    for oid, locs in self.object_locations.items()}
+
     # -- internal KV (function/class registry, cluster metadata) -----------
     def kv_put(self, namespace: str, key: bytes, value: bytes):
         with self._lock:
@@ -198,7 +203,8 @@ _GCS_METHODS = frozenset({
     "register_actor", "update_actor", "get_actor", "get_actor_by_name",
     "list_actors", "register_node", "list_nodes", "get_node", "heartbeat",
     "mark_node_dead", "add_object_location", "remove_object_location",
-    "get_object_locations", "kv_put", "kv_get", "kv_del", "kv_keys",
+    "get_object_locations", "all_object_locations",
+    "kv_put", "kv_get", "kv_del", "kv_keys",
 })
 
 

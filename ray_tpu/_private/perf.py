@@ -1,0 +1,105 @@
+"""Core microbenchmarks (reference: python/ray/_private/ray_perf.py:95-243
+via `ray microbenchmark`): task/actor-call/put throughput on one node.
+
+Baseline targets from the reference's committed CI numbers
+(release/perf_metrics/microbenchmark.json, BASELINE.md): 1:1 sync actor
+calls 2,020/s; n:n async 27,465/s; multi-client puts 15,797/s.  Run:
+``python -m ray_tpu.scripts.cli microbenchmark``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def timeit(name: str, fn, multiplier: int = 1, warmup: int = 1) -> dict:
+    for _ in range(warmup):
+        fn()
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < 2.0:
+        fn()
+        count += 1
+    dur = time.perf_counter() - start
+    rate = count * multiplier / dur
+    print(f"{name:48s} {rate:12.1f} /s")
+    return {"name": name, "rate_per_s": rate}
+
+
+def main():
+    import ray_tpu
+
+    ray_tpu.init(ignore_reinit_error=True)
+    results = []
+
+    # -- tasks -------------------------------------------------------------
+    @ray_tpu.remote
+    def tiny():
+        return b"ok"
+
+    N = 100
+    results.append(timeit(
+        "single client tasks sync (batch 100)",
+        lambda: ray_tpu.get([tiny.remote() for _ in range(N)]),
+        multiplier=N))
+
+    # -- actor calls -------------------------------------------------------
+    class Sink:
+        def ping(self):
+            return b"ok"
+
+    SinkCls = ray_tpu.remote(Sink)
+    a = SinkCls.remote()
+    ray_tpu.get(a.ping.remote())
+    results.append(timeit("1:1 actor calls sync",
+                          lambda: ray_tpu.get(a.ping.remote())))
+
+    M = 50
+    results.append(timeit(
+        "1:1 actor calls async (batch 50)",
+        lambda: ray_tpu.get([a.ping.remote() for _ in range(M)]),
+        multiplier=M))
+
+    actors = [SinkCls.remote() for _ in range(4)]
+    ray_tpu.get([b.ping.remote() for b in actors])
+    results.append(timeit(
+        "n:n actor calls async (4 actors, batch 200)",
+        lambda: ray_tpu.get([b.ping.remote() for b in actors
+                             for _ in range(50)]),
+        multiplier=200))
+
+    conc = SinkCls.options(max_concurrency=8).remote()
+    ray_tpu.get(conc.ping.remote())
+    results.append(timeit(
+        "1:1 threaded actor calls async (batch 50)",
+        lambda: ray_tpu.get([conc.ping.remote() for _ in range(M)]),
+        multiplier=M))
+
+    # -- object store ------------------------------------------------------
+    small = np.zeros(1024, np.uint8)
+    results.append(timeit("single client put (1KB)",
+                          lambda: ray_tpu.put(small)))
+    big = np.zeros(10 * 1024 * 1024, np.uint8)
+    r = timeit("single client put (10MB)", lambda: ray_tpu.put(big))
+    results.append(r)
+    print(f"{'  -> put bandwidth':48s} {r['rate_per_s'] * 10 / 1024:12.2f} GB/s")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x.nbytes
+
+    ref = ray_tpu.put(big)
+    results.append(timeit("single client get <- plasma (10MB)",
+                          lambda: ray_tpu.get(consume.remote(ref))))
+
+    print(json.dumps({"microbenchmark":
+                      {r["name"]: round(r["rate_per_s"], 1)
+                       for r in results}}))
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -174,6 +174,13 @@ class Scheduler:
         self._forwarded: dict[bytes, tuple[bytes, TaskSpec]] = {}
         # actor_id -> (ts, ActorInfo): TTL cache for method routing
         self._actor_info_cache: dict[bytes, tuple[float, object]] = {}
+        # Task-event log for the state API / chrome timeline (reference:
+        # GcsTaskManager fed by core-worker TaskEventBuffer, SURVEY §5):
+        # task_id -> {name, kind, state, submitted/start/end timestamps,
+        # worker}.  Bounded: oldest finished events are evicted.
+        self._task_events: dict[bytes, dict] = {}
+        self._task_events_cap = int(
+            os.environ.get("RTPU_TASK_EVENTS_CAP", 20000))
         self._pulls: set[bytes] = set()  # oids with an in-flight pull
         self._pull_lock = threading.Lock()
 
@@ -216,6 +223,7 @@ class Scheduler:
             spec.retries_left = spec.max_retries
             self._pending.append(spec)
             self._task_index[spec.task_id] = spec
+            self._record_task_event(spec, "PENDING")
             self._wake.notify_all()
 
     def submit_spilled(self, spec: TaskSpec):
@@ -227,7 +235,50 @@ class Scheduler:
                 return
             self._pending.append(spec)
             self._task_index[spec.task_id] = spec
+            self._record_task_event(spec, "PENDING")
             self._wake.notify_all()
+
+    def _record_task_event(self, spec: TaskSpec, state: str,
+                           worker_id: Optional[bytes] = None,
+                           ok: Optional[bool] = None):
+        with self._lock:  # RLock: cheap re-entry from locked callers, and
+            # some callers (e.g. _fail_task off a reader thread) arrive
+            # without the lock
+            self._record_task_event_locked(spec, state, worker_id, ok)
+
+    def _record_task_event_locked(self, spec: TaskSpec, state: str,
+                                  worker_id: Optional[bytes] = None,
+                                  ok: Optional[bool] = None):
+        ev = self._task_events.get(spec.task_id)
+        now = time.time()
+        if ev is None:
+            if len(self._task_events) >= self._task_events_cap:
+                # evict oldest finished entries (insertion-ordered dict)
+                drop = [tid for tid, e in self._task_events.items()
+                        if e["state"] in ("FINISHED", "FAILED",
+                                          "FORWARDED")][
+                    :max(1, self._task_events_cap // 10)]
+                for tid in drop:
+                    del self._task_events[tid]
+            ev = {"task_id": spec.task_id, "name": spec.name,
+                  "kind": spec.kind, "state": state, "submitted_ts": now,
+                  "start_ts": None, "end_ts": None, "worker_id": None,
+                  "actor_id": spec.actor_id, "ok": None}
+            self._task_events[spec.task_id] = ev
+        ev["state"] = state
+        if worker_id is not None:
+            ev["worker_id"] = worker_id
+        if state == "RUNNING" and ev["start_ts"] is None:
+            ev["start_ts"] = now
+        if state in ("FINISHED", "FAILED"):
+            ev["end_ts"] = now
+            ev["ok"] = ok if ok is not None else (state == "FINISHED")
+        elif state == "FORWARDED":
+            ev["end_ts"] = now
+
+    def list_task_events(self) -> list[dict]:
+        with self._lock:
+            return [dict(e) for e in self._task_events.values()]
 
     def cancel(self, task_id: bytes, force: bool = False) -> bool:
         """Cancel a pending task; with force, kill the running worker too."""
@@ -508,8 +559,25 @@ class Scheduler:
                 {"node_id": n.node_id, "alive": n.alive,
                  "resources": dict(n.resources),
                  "available": dict(n.available),
-                 "is_head": n.is_head}
+                 "is_head": n.is_head,
+                 "sched_socket": n.sched_socket}
                 for n in self.gcs.list_nodes()]
+        if method == "list_actors":
+            return [
+                {"actor_id": a.actor_id, "name": a.name, "state": a.state,
+                 "class_name": a.class_name, "node_id": a.node_id,
+                 "num_restarts": a.num_restarts,
+                 "max_restarts": a.max_restarts,
+                 "death_cause": a.death_cause}
+                for a in self.gcs.list_actors()]
+        if method == "list_task_events":
+            return self.list_task_events()
+        if method == "list_object_locations":
+            # full directory snapshot; on worker nodes this proxies to the
+            # head through the GcsClient like every other GCS method
+            return self.gcs.all_object_locations()
+        if method == "store_stats":
+            return self._store.stats()
         raise ValueError(f"unknown rpc method {method!r}")
 
     # ------------------------------------------------------------------
@@ -692,6 +760,10 @@ class Scheduler:
                 spec.origin_node = None
             return False
         self._task_index.pop(spec.task_id, None)
+        # terminal state HERE (the executing node records the real
+        # lifecycle); FORWARDED entries are evictable and filtered out of
+        # cross-node task aggregation to avoid double counting
+        self._record_task_event_locked(spec, "FORWARDED")
         if relay:
             self._peer_send(spec.origin_node, {
                 "t": "spill_moved", "task_id": spec.task_id,
@@ -812,6 +884,8 @@ class Scheduler:
             self._task_index.pop(task_id, None)
             if spec is None:
                 return
+            self._record_task_event(
+                spec, "FINISHED" if msg["ok"] else "FAILED", ok=msg["ok"])
             if spec.kind == ACTOR_CREATION:
                 if _DEBUG_SCHED:
                     _dbg(f"done CREATE actor={spec.actor_id.hex()[:8]} "
@@ -937,6 +1011,7 @@ class Scheduler:
             worker.held_chips = []
 
     def _fail_task(self, spec: TaskSpec, exc: Exception):
+        self._record_task_event(spec, "FAILED", ok=False)
         for oid in spec.return_ids:
             if store_error_best_effort(self._store, oid, exc, ""):
                 self.note_sealed(oid)  # callers on other nodes pull errors
@@ -1181,6 +1256,7 @@ class Scheduler:
             self._spawn_worker()
 
     def _dispatch(self, w: WorkerState, spec: TaskSpec):
+        self._record_task_event(spec, "RUNNING", worker_id=w.worker_id)
         tpus = spec.resources.get("TPU", 0) if spec.resources else 0
         env: dict[str, str] = {}
         n_chips = int(tpus)

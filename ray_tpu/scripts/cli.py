@@ -1,0 +1,183 @@
+"""ray_tpu CLI: status / memory / stack / timeline / summary / microbench.
+
+Counterpart of the reference CLI command registry
+(/root/reference/python/ray/scripts/scripts.py:2665-2691 — status, memory,
+stack, timeline, microbenchmark, ...).  Attaches to a RUNNING cluster by
+its head scheduler socket: pass --address, or the newest session under
+/tmp/ray_tpu/ is used.
+
+Usage:  python -m ray_tpu.scripts.cli <command> [--address PATH] [...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import signal
+import sys
+import time
+from typing import Optional
+
+from ray_tpu._private import protocol
+
+
+def find_address(address: Optional[str]) -> str:
+    if address:
+        return address
+    socks = sorted(glob.glob("/tmp/ray_tpu/session_*/sched.sock"),
+                   key=os.path.getmtime)
+    live = [s for s in socks if _ping(s)]
+    if not live:
+        sys.exit("no live ray_tpu session found under /tmp/ray_tpu/; "
+                 "pass --address <sched.sock path>")
+    return live[-1]
+
+
+def _ping(sock: str) -> bool:
+    try:
+        _rpc(sock, "cluster_state")
+        return True
+    except Exception:
+        return False
+
+
+def _rpc(sock: str, method: str, params: Optional[dict] = None):
+    conn = protocol.connect(sock)
+    try:
+        conn.send({"t": "rpc", "method": method, "params": params or {}})
+        resp = conn.recv()
+    finally:
+        conn.close()
+    if resp is None or not resp.get("ok"):
+        raise RuntimeError(f"rpc {method} failed: "
+                           f"{resp.get('error') if resp else 'closed'}")
+    return resp["result"]
+
+
+def cmd_status(args):
+    sock = find_address(args.address)
+    nodes = _rpc(sock, "list_nodes")
+    actors = _rpc(sock, "list_actors")
+    print(f"======== Cluster status ({time.strftime('%H:%M:%S')}) ========")
+    print(f"Nodes: {sum(n['alive'] for n in nodes)} alive / {len(nodes)}")
+    for n in nodes:
+        mark = "head" if n["is_head"] else "worker"
+        state = "ALIVE" if n["alive"] else "DEAD"
+        res = " ".join(f"{k}:{n['available'].get(k, 0):g}/{v:g}"
+                       for k, v in sorted(n["resources"].items()))
+        print(f"  {n['node_id'].hex()[:12]}  {mark:6s} {state:5s}  {res}")
+    by_state: dict = {}
+    for a in actors:
+        by_state[a["state"]] = by_state.get(a["state"], 0) + 1
+    print(f"Actors: {len(actors)} "
+          + " ".join(f"{k}={v}" for k, v in sorted(by_state.items())))
+    st = _rpc(sock, "cluster_state")
+    print(f"Pending tasks (head): {st['pending_tasks']}; "
+          f"workers: {st['num_workers']} ({st['num_idle']} idle)")
+
+
+def cmd_memory(args):
+    sock = find_address(args.address)
+    nodes = _rpc(sock, "list_nodes")
+    print("======== Object store memory ========")
+    for n in nodes:
+        if not n["alive"]:
+            continue
+        try:
+            stats = _rpc(n["sched_socket"], "store_stats")
+        except Exception as e:  # noqa: BLE001
+            print(f"  {n['node_id'].hex()[:12]}  unreachable: {e}")
+            continue
+        line = " ".join(f"{k}={v}" for k, v in sorted(stats.items()))
+        print(f"  {n['node_id'].hex()[:12]}  {line}")
+    locs = _rpc(sock, "list_object_locations")
+    print(f"Objects tracked in directory: {len(locs)}")
+
+
+def cmd_stack(args):
+    """SIGUSR1 every local worker_main process: each dumps all thread
+    stacks to its stderr (reference: `ray stack` py-spy dumps)."""
+    import subprocess
+
+    out = subprocess.run(
+        ["pgrep", "-f", "ray_tpu._private.worker_mai[n]"],
+        capture_output=True, text=True)
+    pids = [int(p) for p in out.stdout.split()]
+    if not pids:
+        print("no local ray_tpu workers found")
+        return
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGUSR1)
+            print(f"dumped stacks of worker pid {pid} (see its stderr)")
+        except OSError as e:
+            print(f"pid {pid}: {e}")
+
+
+def _gather_events(sock: str) -> list:
+    """All task events across live nodes (node_id attached)."""
+    events = []
+    for n in _rpc(sock, "list_nodes"):
+        if not n["alive"]:
+            continue
+        try:
+            evs = _rpc(n["sched_socket"], "list_task_events")
+        except Exception:
+            continue
+        for e in evs:
+            e["node_id"] = n["node_id"]
+        events.extend(evs)
+    return events
+
+
+def cmd_timeline(args):
+    from ray_tpu.util.state import events_to_chrome_trace
+
+    sock = find_address(args.address)
+    events = events_to_chrome_trace(_gather_events(sock))
+    out = args.output or f"timeline-{time.strftime('%H%M%S')}.json"
+    with open(out, "w") as f:
+        json.dump(events, f)
+    print(f"wrote {len(events)} events to {out} "
+          f"(open in chrome://tracing or Perfetto)")
+
+
+def cmd_summary(args):
+    from ray_tpu.util.state import summarize_events
+
+    sock = find_address(args.address)
+    summary = summarize_events(_gather_events(sock))
+    print("======== Task summary ========")
+    for name, states in sorted(summary.items()):
+        line = " ".join(f"{k}={v}" for k, v in sorted(states.items()))
+        print(f"  {name:40s} {line}")
+
+
+def cmd_microbenchmark(args):
+    from ray_tpu._private import perf
+
+    perf.main()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="ray_tpu")
+    sub = p.add_subparsers(dest="command", required=True)
+    for name, fn in [("status", cmd_status), ("memory", cmd_memory),
+                     ("stack", cmd_stack), ("summary", cmd_summary)]:
+        sp = sub.add_parser(name)
+        sp.add_argument("--address", default=None)
+        sp.set_defaults(fn=fn)
+    sp = sub.add_parser("timeline")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--output", "-o", default=None)
+    sp.set_defaults(fn=cmd_timeline)
+    sp = sub.add_parser("microbenchmark")
+    sp.set_defaults(fn=cmd_microbenchmark)
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,173 @@
+"""State API: unified cluster introspection.
+
+Counterpart of /root/reference/python/ray/util/state/api.py:110
+(list_actors/list_tasks/list_nodes/list_objects/list_placement_groups,
+summarize_tasks/actors) aggregating GCS tables + per-node scheduler
+task-event logs, the way the reference's state aggregator combines GCS and
+raylet sources (dashboard/state_aggregator.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import protocol
+from ray_tpu._private.worker import global_worker
+
+
+def _rpc(method: str, params: Optional[dict] = None):
+    return global_worker().rpc(method, params or {})
+
+
+def _node_rpc(sched_socket: str, method: str, params: Optional[dict] = None):
+    """One-shot rpc against a specific node's scheduler."""
+    conn = protocol.connect(sched_socket)
+    try:
+        conn.send({"t": "rpc", "method": method, "params": params or {}})
+        resp = conn.recv()
+    finally:
+        conn.close()
+    if resp is None or not resp.get("ok"):
+        raise RuntimeError(f"state rpc {method} failed: "
+                           f"{resp.get('error') if resp else 'closed'}")
+    return resp["result"]
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    return [{"node_id": n["node_id"].hex(), "alive": n["alive"],
+             "is_head": n["is_head"], "resources": n["resources"],
+             "available": n["available"]}
+            for n in _rpc("list_nodes")]
+
+
+def list_actors(detail: bool = False) -> List[Dict[str, Any]]:
+    out = []
+    for a in _rpc("list_actors"):
+        row = {"actor_id": a["actor_id"].hex(), "state": a["state"],
+               "class_name": a["class_name"], "name": a["name"],
+               "node_id": a["node_id"].hex() if a["node_id"] else None}
+        if detail:
+            row.update(num_restarts=a["num_restarts"],
+                       max_restarts=a["max_restarts"],
+                       death_cause=a["death_cause"])
+        out.append(row)
+    return out
+
+
+def _all_task_events() -> List[dict]:
+    events: List[dict] = []
+    for n in _rpc("list_nodes"):
+        if not n["alive"]:
+            continue
+        try:
+            evs = _node_rpc(n["sched_socket"], "list_task_events")
+        except (OSError, RuntimeError):
+            continue
+        for e in evs:
+            e["node_id"] = n["node_id"]
+        events.extend(evs)
+    return events
+
+
+def list_tasks(filters: Optional[list] = None) -> List[Dict[str, Any]]:
+    """One row per task event; filters are (key, '=', value) triples on
+    the rendered rows (reference: list_tasks filter syntax subset).
+    FORWARDED entries (a node handing a spec to a peer) are dropped — the
+    executing node's row is the real lifecycle."""
+    rows = []
+    for e in _all_task_events():
+        if e["state"] == "FORWARDED":
+            continue
+        rows.append({
+            "task_id": e["task_id"].hex(),
+            "name": e["name"],
+            "type": e["kind"].upper(),
+            "state": e["state"],
+            "node_id": e["node_id"].hex(),
+            "worker_id": e["worker_id"].hex() if e["worker_id"] else None,
+            "actor_id": e["actor_id"].hex() if e["actor_id"] else None,
+            "submitted_ts": e["submitted_ts"],
+            "start_ts": e["start_ts"],
+            "end_ts": e["end_ts"],
+        })
+    for key, op, value in (filters or ()):
+        if op != "=":
+            raise ValueError(f"unsupported filter op {op!r}")
+        rows = [r for r in rows if r.get(key) == value]
+    return rows
+
+
+def list_objects() -> List[Dict[str, Any]]:
+    locs = _rpc("list_object_locations")
+    return [{"object_id": oid.hex(),
+             "locations": [n.hex() for n in nodes]}
+            for oid, nodes in locs.items()]
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    table = _rpc("pg_table")
+    return [{"placement_group_id": pg_id.hex(), **info}
+            for pg_id, info in table.items()]
+
+
+def summarize_events(events: List[dict]) -> Dict[str, Dict[str, int]]:
+    """name -> state -> count over raw task events (shared with the CLI)."""
+    summary: Dict[str, Dict[str, int]] = {}
+    for e in events:
+        if e["state"] == "FORWARDED":
+            continue
+        by_state = summary.setdefault(e["name"], {})
+        by_state[e["state"]] = by_state.get(e["state"], 0) + 1
+    return summary
+
+
+def summarize_tasks() -> Dict[str, Any]:
+    summary = summarize_events(_all_task_events())
+    return {"cluster": {"summary": summary,
+                        "total_tasks": sum(sum(v.values())
+                                           for v in summary.values())}}
+
+
+def summarize_actors() -> Dict[str, Any]:
+    summary: Dict[str, Dict[str, int]] = {}
+    for row in list_actors():
+        by_state = summary.setdefault(row["class_name"], {})
+        by_state[row["state"]] = by_state.get(row["state"], 0) + 1
+    return {"cluster": {"summary": summary,
+                        "total_actors": sum(sum(v.values())
+                                            for v in summary.values())}}
+
+
+def events_to_chrome_trace(events: List[dict]) -> List[dict]:
+    """Raw task events -> chrome://tracing 'X' events (shared with CLI)."""
+    import time as time_mod
+
+    trace = []
+    for e in events:
+        if e["start_ts"] is None or e["state"] == "FORWARDED":
+            continue
+        end = e["end_ts"] or time_mod.time()
+        trace.append({
+            "name": e["name"],
+            "cat": e["kind"],
+            "ph": "X",
+            "ts": e["start_ts"] * 1e6,
+            "dur": (end - e["start_ts"]) * 1e6,
+            "pid": e["node_id"].hex()[:8],
+            "tid": e["worker_id"].hex()[:8] if e["worker_id"] else "?",
+            "args": {"state": e["state"]},
+        })
+    return trace
+
+
+def timeline(filename: Optional[str] = None) -> List[dict]:
+    """Chrome-trace events for all finished/running tasks (reference:
+    `ray timeline` via GcsTaskManager, scripts.py:2689).  Load the output
+    in chrome://tracing or Perfetto."""
+    events = events_to_chrome_trace(_all_task_events())
+    if filename:
+        import json
+
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events

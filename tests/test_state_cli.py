@@ -1,0 +1,127 @@
+"""State API + CLI tests (reference: python/ray/tests/test_state_api.py,
+test_cli.py — list_*/summarize_* surfaces and the status/timeline
+commands)."""
+
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster(ray_cluster):
+    yield
+
+
+def test_list_nodes_and_actors():
+    class Pinger:
+        def ping(self):
+            return "pong"
+
+    a = ray_tpu.remote(Pinger).options(name="state-pinger").remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+
+    nodes = state.list_nodes()
+    assert len(nodes) >= 1 and all("node_id" in n for n in nodes)
+    assert any(n["is_head"] for n in nodes)
+
+    # actor state propagates via the scheduler's done-message processing,
+    # which can trail the store-visible method result by a beat
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        actors = state.list_actors(detail=True)
+        mine = [x for x in actors if x["name"] == "state-pinger"]
+        if mine and mine[0]["state"] == "ALIVE":
+            break
+        time.sleep(0.1)
+    assert len(mine) == 1
+    assert mine[0]["state"] == "ALIVE"
+    assert mine[0]["class_name"] == "Pinger"
+    assert mine[0]["node_id"] is not None
+    ray_tpu.kill(a)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        mine = [x for x in state.list_actors()
+                if x["actor_id"] == mine[0]["actor_id"]]
+        if mine and mine[0]["state"] == "DEAD":
+            break
+        time.sleep(0.2)
+    assert mine[0]["state"] == "DEAD"
+
+
+def test_list_tasks_and_summary():
+    @ray_tpu.remote
+    def state_probe_task(x):
+        return x + 1
+
+    ray_tpu.get([state_probe_task.remote(i) for i in range(5)], timeout=60)
+    # done-message processing can trail the store-visible results
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        rows = state.list_tasks(filters=[("name", "=", "state_probe_task")])
+        finished = [r for r in rows if r["state"] == "FINISHED"]
+        if len(finished) >= 5:
+            break
+        time.sleep(0.2)
+    assert len(rows) >= 5
+    assert len(finished) >= 5
+    assert all(r["start_ts"] is not None and r["end_ts"] is not None
+               for r in finished)
+    summary = state.summarize_tasks()
+    assert summary["cluster"]["summary"]["state_probe_task"]["FINISHED"] >= 5
+
+
+def test_timeline_export(tmp_path):
+    @ray_tpu.remote
+    def timed_work():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([timed_work.remote() for _ in range(3)], timeout=60)
+    out = tmp_path / "trace.json"
+    events = state.timeline(str(out))
+    data = json.loads(out.read_text())
+    assert data == events
+    mine = [e for e in data if e["name"] == "timed_work"]
+    assert len(mine) >= 3
+    assert all(e["ph"] == "X" and e["dur"] > 0 for e in mine)
+
+
+def test_list_objects_tracks_locations():
+    ref = ray_tpu.put(b"state-api-payload")
+    objs = state.list_objects()
+    ids = {o["object_id"] for o in objs}
+    assert ref.binary().hex() in ids
+
+
+def test_cli_status_and_summary(capsys):
+    from ray_tpu.scripts import cli
+
+    node = ray_tpu.init(ignore_reinit_error=True)
+    sock = node.scheduler.socket_path
+    cli.main(["status", "--address", sock])
+    out = capsys.readouterr().out
+    assert "Cluster status" in out and "head" in out and "ALIVE" in out
+
+    cli.main(["summary", "--address", sock])
+    out = capsys.readouterr().out
+    assert "Task summary" in out
+
+    cli.main(["memory", "--address", sock])
+    out = capsys.readouterr().out
+    assert "Object store memory" in out
+
+
+def test_cli_timeline(tmp_path, capsys):
+    from ray_tpu.scripts import cli
+
+    node = ray_tpu.init(ignore_reinit_error=True)
+    out_file = tmp_path / "t.json"
+    cli.main(["timeline", "--address", node.scheduler.socket_path,
+              "-o", str(out_file)])
+    assert "wrote" in capsys.readouterr().out
+    assert out_file.exists()
+    json.loads(out_file.read_text())
