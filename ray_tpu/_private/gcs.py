@@ -74,6 +74,8 @@ class Gcs:
         self.job_config: dict = {}
         # object_id -> set of node_ids holding a sealed copy
         self.object_locations: dict[bytes, set[bytes]] = {}
+        # pg_id -> {bundles, strategy, assignment: [node_id per bundle]}
+        self.placement_groups: dict[bytes, dict] = {}
 
     # -- actors ------------------------------------------------------------
     def register_actor(self, info: ActorInfo):
@@ -174,6 +176,30 @@ class Gcs:
             return {oid: list(locs)
                     for oid, locs in self.object_locations.items()}
 
+    # -- placement groups ---------------------------------------------------
+    # (reference: gcs_placement_group_mgr.cc owns the PG table; the 2PC
+    # reserve/commit against raylets lives in the scheduler layer here)
+    def register_pg(self, pg_id: bytes, bundles: list, strategy: str,
+                    assignment: list):
+        with self._lock:
+            self.placement_groups[pg_id] = {
+                "bundles": bundles, "strategy": strategy,
+                "assignment": assignment}
+
+    def get_pg(self, pg_id: bytes) -> Optional[dict]:
+        with self._lock:
+            info = self.placement_groups.get(pg_id)
+            return dict(info) if info else None
+
+    def remove_pg(self, pg_id: bytes):
+        with self._lock:
+            self.placement_groups.pop(pg_id, None)
+
+    def list_pgs(self) -> dict:
+        with self._lock:
+            return {pg_id: dict(info)
+                    for pg_id, info in self.placement_groups.items()}
+
     # -- internal KV (function/class registry, cluster metadata) -----------
     def kv_put(self, namespace: str, key: bytes, value: bytes):
         with self._lock:
@@ -204,6 +230,7 @@ _GCS_METHODS = frozenset({
     "list_actors", "register_node", "list_nodes", "get_node", "heartbeat",
     "mark_node_dead", "add_object_location", "remove_object_location",
     "get_object_locations", "all_object_locations",
+    "register_pg", "get_pg", "remove_pg", "list_pgs",
     "kv_put", "kv_get", "kv_del", "kv_keys",
 })
 

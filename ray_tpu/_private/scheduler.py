@@ -115,10 +115,14 @@ class WorkerState:
 
 @dataclass
 class PlacementGroupState:
+    """This node's SUBSET of a placement group's bundles, keyed by GLOBAL
+    bundle index (a PG's bundles can span nodes)."""
+
     pg_id: bytes
-    bundles: list[dict]
+    bundles: dict[int, dict]
     strategy: str
-    available: list[dict] = field(default_factory=list)
+    available: dict[int, dict] = field(default_factory=dict)
+    created_ts: float = field(default_factory=time.monotonic)
 
 
 class Scheduler:
@@ -174,6 +178,8 @@ class Scheduler:
         self._forwarded: dict[bytes, tuple[bytes, TaskSpec]] = {}
         # actor_id -> (ts, ActorInfo): TTL cache for method routing
         self._actor_info_cache: dict[bytes, tuple[float, object]] = {}
+        # pg_id -> (ts, pg info): TTL cache for PG bundle routing
+        self._pg_cache: dict[bytes, tuple[float, Optional[dict]]] = {}
         # Task-event log for the state API / chrome timeline (reference:
         # GcsTaskManager fed by core-worker TaskEventBuffer, SURVEY §5):
         # task_id -> {name, kind, state, submitted/start/end timestamps,
@@ -339,10 +345,128 @@ class Scheduler:
 
     def create_placement_group(self, pg_id: bytes, bundles: list[dict],
                                strategy: str) -> bool:
-        """Atomically reserve all bundles from node-available resources."""
+        """Cluster-wide gang reservation: assign each bundle to a node by
+        strategy, then 2PC-reserve (all nodes or none — rollback on any
+        failure).  Reference: gcs_placement_group_scheduler.cc reserve/
+        commit + bundle_scheduling_policy.cc strategies."""
+        assignment = self._assign_bundles(bundles, strategy)
+        if assignment is None:
+            return False
+        # group bundle indices per node
+        per_node: dict[bytes, dict[int, dict]] = {}
+        for idx, node_id in enumerate(assignment):
+            per_node.setdefault(node_id, {})[idx] = bundles[idx]
+        reserved: list[bytes] = []
+        ok = True
+        for node_id, subset in per_node.items():
+            if node_id == self.node_id:
+                ok = self.pg_reserve(pg_id, subset, strategy)
+            else:
+                node = self._cluster_nodes.get(node_id)
+                try:
+                    ok = self._one_shot_rpc(node.sched_socket, "pg_reserve",
+                                            {"pg_id": pg_id,
+                                             "bundles": subset,
+                                             "strategy": strategy})
+                except Exception:
+                    ok = False
+            if not ok:
+                break
+            reserved.append(node_id)
+        if not ok:
+            for node_id in reserved:  # rollback
+                if node_id == self.node_id:
+                    self.pg_release(pg_id)
+                else:
+                    node = self._cluster_nodes.get(node_id)
+                    try:
+                        self._one_shot_rpc(node.sched_socket, "pg_release",
+                                           {"pg_id": pg_id})
+                    except Exception:
+                        pass
+            return False
+        self.gcs.register_pg(pg_id, [dict(b) for b in bundles], strategy,
+                             assignment)
+        return True
+
+    def _assign_bundles(self, bundles: list[dict],
+                        strategy: str) -> Optional[list[bytes]]:
+        """Pick a node per bundle from the cluster view; None = infeasible.
+
+        Reads the GCS directly (not the heartbeat-cached view): PG creation
+        is rare and must see nodes that joined within the last tick."""
+        with self._lock:
+            avail: dict[bytes, dict] = {self.node_id: dict(self.available)}
+        try:
+            nodes = {n.node_id: n for n in self.gcs.list_nodes()}
+            self._cluster_nodes = nodes
+        except Exception:
+            nodes = self._cluster_nodes
+        for nid, n in nodes.items():
+            if nid != self.node_id and n.alive:
+                avail[nid] = dict(n.available)
+
+        def fits(node_avail: dict, b: dict) -> bool:
+            return all(node_avail.get(k, 0) >= v for k, v in b.items())
+
+        def take(node_avail: dict, b: dict):
+            for k, v in b.items():
+                node_avail[k] = node_avail.get(k, 0) - v
+
+        order = sorted(avail, key=lambda n: -avail[n].get("CPU", 0))
+        assignment: list[Optional[bytes]] = [None] * len(bundles)
+        if strategy in ("STRICT_PACK",):
+            for nid in order:
+                trial = dict(avail[nid])
+                good = True
+                for b in bundles:
+                    if not fits(trial, b):
+                        good = False
+                        break
+                    take(trial, b)
+                if good:
+                    return [nid] * len(bundles)
+            return None
+        if strategy in ("STRICT_SPREAD",):
+            used: set[bytes] = set()
+            for i, b in enumerate(bundles):
+                placed = False
+                for nid in order:
+                    if nid in used or not fits(avail[nid], b):
+                        continue
+                    take(avail[nid], b)
+                    used.add(nid)
+                    assignment[i] = nid
+                    placed = True
+                    break
+                if not placed:
+                    return None
+            return assignment  # type: ignore[return-value]
+        # PACK: prefer fewest nodes (first-fit over pack order);
+        # SPREAD: best-effort round-robin over distinct nodes
+        rr = 0
+        for i, b in enumerate(bundles):
+            placed = False
+            tries = (order if strategy == "PACK"
+                     else order[rr % len(order):] + order[:rr % len(order)])
+            for nid in tries:
+                if fits(avail[nid], b):
+                    take(avail[nid], b)
+                    assignment[i] = nid
+                    placed = True
+                    break
+            if not placed:
+                return None
+            rr += 1
+        return assignment  # type: ignore[return-value]
+
+    def pg_reserve(self, pg_id: bytes, bundles: dict[int, dict],
+                   strategy: str) -> bool:
+        """Reserve a subset of a PG's bundles from this node's resources."""
+        bundles = {int(i): b for i, b in bundles.items()}
         with self._lock:
             need: dict[str, float] = {}
-            for b in bundles:
+            for b in bundles.values():
                 for k, v in b.items():
                     need[k] = need.get(k, 0) + v
             for k, v in need.items():
@@ -350,28 +474,79 @@ class Scheduler:
                     return False
             for k, v in need.items():
                 self.available[k] -= v
-            self._pgs[pg_id] = PlacementGroupState(
-                pg_id, [dict(b) for b in bundles], strategy,
-                available=[dict(b) for b in bundles])
+            pg = self._pgs.get(pg_id)
+            if pg is None:
+                pg = PlacementGroupState(pg_id, {}, strategy)
+                self._pgs[pg_id] = pg
+            for i, b in bundles.items():
+                pg.bundles[i] = dict(b)
+                pg.available[i] = dict(b)
+            self._wake.notify_all()
             return True
 
-    def remove_placement_group(self, pg_id: bytes):
+    def pg_release(self, pg_id: bytes):
         with self._lock:
+            self._pg_cache.pop(pg_id, None)
             pg = self._pgs.pop(pg_id, None)
             if pg is None:
                 return
-            for b in pg.bundles:
+            for b in pg.bundles.values():
                 for k, v in b.items():
                     self.available[k] = self.available.get(k, 0) + v
             self._wake.notify_all()
 
-    def placement_group_table(self) -> dict:
+    def _reconcile_pgs(self):
+        """Release local reservations whose PG is gone from the GCS table.
+
+        The safety net for lost 2PC rollbacks and lost remove broadcasts
+        (both are best-effort peer messages): without this, a swallowed
+        release would debit this node's resources forever.  The grace
+        period covers the creation window, where bundles are reserved
+        before the PG is registered."""
         with self._lock:
-            return {
-                pg_id: {"bundles": pg.bundles, "strategy": pg.strategy,
-                        "available": pg.available}
-                for pg_id, pg in self._pgs.items()
-            }
+            candidates = [pg_id for pg_id, pg in self._pgs.items()
+                          if time.monotonic() - pg.created_ts > 15.0]
+        for pg_id in candidates:
+            try:
+                if self.gcs.get_pg(pg_id) is None:
+                    self.pg_release(pg_id)
+            except Exception:
+                return  # GCS unreachable: try next round
+
+    def remove_placement_group(self, pg_id: bytes):
+        info = self.gcs.get_pg(pg_id)
+        self.gcs.remove_pg(pg_id)
+        nodes = (set(info["assignment"]) if info else set()) | {self.node_id}
+        for node_id in nodes:
+            if node_id == self.node_id:
+                self.pg_release(pg_id)
+            else:
+                node = self._cluster_nodes.get(node_id)
+                if node is None or not node.alive:
+                    continue
+                try:
+                    self._one_shot_rpc(node.sched_socket, "pg_release",
+                                       {"pg_id": pg_id})
+                except Exception:
+                    pass
+
+    def placement_group_table(self) -> dict:
+        return self.gcs.list_pgs()
+
+    def _one_shot_rpc(self, sched_socket: str, method: str, params: dict):
+        """Request/response against a peer scheduler over a fresh
+        connection (the cached peer conns are one-way fire-and-forget)."""
+        conn = protocol.connect(sched_socket)
+        try:
+            conn.send({"t": "rpc", "method": method, "params": params})
+            resp = conn.recv()
+        finally:
+            conn.close()
+        if resp is None or not resp.get("ok"):
+            raise RuntimeError(
+                f"peer rpc {method} failed: "
+                f"{resp.get('error') if resp else 'connection closed'}")
+        return resp["result"]
 
     def state_snapshot(self) -> dict:
         with self._lock:
@@ -534,6 +709,12 @@ class Scheduler:
                 params["pg_id"], params["bundles"], params["strategy"])
         if method == "remove_placement_group":
             self.remove_placement_group(params["pg_id"])
+            return True
+        if method == "pg_reserve":
+            return self.pg_reserve(params["pg_id"], params["bundles"],
+                                   params["strategy"])
+        if method == "pg_release":
+            self.pg_release(params["pg_id"])
             return True
         if method == "cluster_state":
             return self.state_snapshot()
@@ -707,6 +888,10 @@ class Scheduler:
                     # capacity may unblock the queue)
                     with self._lock:
                         self._wake.notify_all()
+                now = time.monotonic()
+                if now - getattr(self, "_last_pg_reconcile", 0.0) > 5.0:
+                    self._last_pg_reconcile = now
+                    self._reconcile_pgs()
             except Exception:
                 if not self._shutdown:
                     traceback.print_exc()
@@ -1040,6 +1225,38 @@ class Scheduler:
                 traceback.print_exc()
                 time.sleep(0.05)
 
+    def _pg_bundle_owner(self, pg_id: bytes,
+                         bundle: int) -> tuple[bool, Optional[bytes]]:
+        """(known, node) for a PG bundle, with a short TTL cache (same
+        rationale as _actor_info_cached: called under the lock).
+
+        known=False means the GCS was unreachable and nothing is cached —
+        callers must requeue, NOT fail (a transient socket error is not
+        "the PG does not exist").  known=True with node=None is the
+        authoritative "no such PG/bundle"."""
+        now = time.monotonic()
+        cached = self._pg_cache.get(pg_id)
+        if cached is None or now - cached[0] >= 0.5:
+            try:
+                info = self.gcs.get_pg(pg_id)
+            except Exception:
+                if cached is None:
+                    return False, None  # transient: leave cache untouched
+                info = cached[1]
+            if len(self._pg_cache) > 4096:
+                self._pg_cache = {
+                    p: v for p, v in self._pg_cache.items()
+                    if now - v[0] < 1.0}
+            self._pg_cache[pg_id] = (now, info)
+            cached = self._pg_cache[pg_id]
+        info = cached[1]
+        if info is None:
+            return True, None
+        assignment = info["assignment"]
+        if bundle < 0 or bundle >= len(assignment):
+            return True, None
+        return True, assignment[bundle]
+
     def _actor_info_cached(self, actor_id: bytes):
         """Actor placement with a short TTL cache: on non-head nodes a GCS
         lookup is a socket round trip, and this runs per pending method per
@@ -1113,6 +1330,44 @@ class Scheduler:
                 progress = True
                 continue
 
+            if spec.pg_id is not None:
+                # PG tasks run on the node holding their bundle; if that
+                # is not us, forward there (bundle->node map in the GCS)
+                pg = self._pgs.get(spec.pg_id)
+                bundle = spec.pg_bundle if spec.pg_bundle is not None else 0
+                if pg is None or bundle not in pg.bundles:
+                    known, owner = self._pg_bundle_owner(spec.pg_id, bundle)
+                    if not known:
+                        remaining.append(spec)  # transient GCS error
+                        continue
+                    if owner is None:
+                        self._task_index.pop(spec.task_id, None)
+                        self._fail_task(spec, WorkerCrashedError(
+                            f"placement group {spec.pg_id.hex()[:8]} does "
+                            f"not exist (removed or never created)"))
+                        progress = True
+                        continue
+                    owner_node = self._cluster_nodes.get(owner)
+                    if owner_node is not None and not owner_node.alive:
+                        # the bundle's node died and its reservation is
+                        # gone; fail with a clear cause (the reference
+                        # reschedules lost bundles — we surface the loss)
+                        self._task_index.pop(spec.task_id, None)
+                        self._fail_task(spec, WorkerCrashedError(
+                            f"placement group {spec.pg_id.hex()[:8]} "
+                            f"bundle {bundle} was lost: its node "
+                            f"{owner.hex()[:8]} died"))
+                        progress = True
+                        continue
+                    if owner != self.node_id:
+                        if self._forward(spec, owner):
+                            progress = True
+                        else:
+                            remaining.append(spec)
+                        continue
+                    # owner is us but reservation not here yet: wait
+                    remaining.append(spec)
+                    continue
             if (spec.node_affinity is not None
                     and spec.node_affinity != self.node_id):
                 # NodeAffinitySchedulingStrategy: run on the named node if
@@ -1220,7 +1475,9 @@ class Scheduler:
             if pg is None:
                 return None
             bundle = spec.pg_bundle if spec.pg_bundle is not None else 0
-            avail = pg.available[bundle]
+            avail = pg.available.get(bundle)
+            if avail is None:  # bundle lives on another node
+                return None
             if any(avail.get(k, 0) < v for k, v in res.items()):
                 return None
             for k, v in res.items():

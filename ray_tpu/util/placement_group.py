@@ -4,8 +4,10 @@ Counterpart of /root/reference/python/ray/util/placement_group.py:42,146 (the
 GCS-side 2PC scheduler lives in gcs_placement_group_scheduler.cc).  On the
 TPU build, bundles are how slices are gang-reserved: a v5e-16 training job
 reserves 4 bundles of {"TPU": 4} (one per host) with STRICT_PACK so the mesh
-lands on one ICI domain.  This round reserves against the single local node;
-the API (including ``ready``/``wait``) is the multi-node one.
+lands on one ICI domain.  Bundles are assigned to cluster nodes by strategy
+(PACK/SPREAD/STRICT_PACK/STRICT_SPREAD) and 2PC-reserved on each; tasks
+using a bundle run on its node (scheduler routes by the GCS bundle map).
+Creation is synchronous — ``ready``/``wait`` resolve immediately.
 """
 
 from __future__ import annotations

@@ -219,3 +219,52 @@ def test_error_propagates_across_nodes(cluster):
         ray_tpu.get(boom.options(
             scheduling_strategy=NodeAffinitySchedulingStrategy(
                 target)).remote(), timeout=60)
+
+
+def test_placement_group_strict_spread_across_nodes(cluster):
+    from ray_tpu.util.placement_group import (
+        placement_group, placement_group_table, remove_placement_group)
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy)
+
+    _add_worker(cluster)
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    table = placement_group_table()
+    assignment = table[pg.id]["assignment"]
+    assert len(set(assignment)) == 2  # one bundle per distinct node
+
+    @ray_tpu.remote
+    def where():
+        import ray_tpu as rt
+
+        return rt.get_runtime_context().node_id_hex()
+
+    homes = ray_tpu.get([
+        where.options(scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg,
+            placement_group_bundle_index=i)).remote()
+        for i in range(2)], timeout=60)
+    # each task ran on its bundle's node
+    assert homes == [a.hex() for a in assignment]
+    remove_placement_group(pg)
+
+
+def test_placement_group_strict_spread_infeasible(cluster):
+    from ray_tpu.exceptions import PlacementGroupUnavailableError
+    from ray_tpu.util.placement_group import placement_group
+
+    # single node: two bundles cannot spread across distinct nodes
+    with pytest.raises(PlacementGroupUnavailableError):
+        placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+
+
+def test_placement_group_strict_pack_lands_on_one_node(cluster):
+    from ray_tpu.util.placement_group import (
+        placement_group, placement_group_table, remove_placement_group)
+
+    _add_worker(cluster, cpus=6.0)
+    # 4 CPU cannot fit the head (CPU:2): STRICT_PACK must pick the worker
+    pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="STRICT_PACK")
+    assignment = placement_group_table()[pg.id]["assignment"]
+    assert len(set(assignment)) == 1
+    remove_placement_group(pg)
