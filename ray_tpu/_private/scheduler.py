@@ -681,7 +681,10 @@ class Scheduler:
                     result = self._handle_rpc(msg["method"], msg.get("params", {}))
                     conn.send({"ok": True, "result": result})
                 except Exception as e:
-                    conn.send({"ok": False, "error": repr(e)})
+                    try:
+                        conn.send({"ok": False, "error": repr(e)})
+                    except OSError:
+                        break  # caller hung up mid-rpc (e.g. process exit)
         if worker is not None:
             self._on_worker_death(worker)
 
@@ -725,6 +728,11 @@ class Scheduler:
         if method == "kv_put":
             self.gcs.kv_put(params["namespace"], params["key"], params["value"])
             return True
+        if method == "kv_del":
+            self.gcs.kv_del(params["namespace"], params["key"])
+            return True
+        if method == "kv_keys":
+            return self.gcs.kv_keys(params["namespace"])
         if method == "pull":
             return self.trigger_pull(params["oid"])
         if method == "object_locations":
@@ -1367,6 +1375,20 @@ class Scheduler:
                         continue
                     # owner is us but reservation not here yet: wait
                     remaining.append(spec)
+                    continue
+                # Bundle is here: a request larger than the bundle's TOTAL
+                # capacity can never be satisfied — fail now instead of
+                # requeueing forever (reference raises at submission).
+                cap = pg.bundles[bundle]
+                infeasible = {
+                    k: v for k, v in (spec.resources or {}).items()
+                    if v > cap.get(k, 0)}
+                if infeasible:
+                    self._task_index.pop(spec.task_id, None)
+                    self._fail_task(spec, ValueError(
+                        f"task {spec.name} requests {infeasible} but "
+                        f"placement group bundle {bundle} only has {cap}"))
+                    progress = True
                     continue
             if (spec.node_affinity is not None
                     and spec.node_affinity != self.node_id):

@@ -157,9 +157,17 @@ class WorkerRuntime:
                 if instance is None:
                     raise RuntimeError(
                         f"actor {spec.actor_id.hex()[:8]} not on this worker")
-                method = getattr(instance, spec.method_name)
                 args, kwargs = self._resolve_args(spec.args_blob)
-                self._store_returns(spec, method(*args, **kwargs))
+                if spec.method_name == "__rtpu_apply__":
+                    # Universal hidden method (counterpart of the reference's
+                    # __ray_call__): run fn(actor_instance, *rest) inside the
+                    # actor's process — substrate for declare_collective_group
+                    # and device-object send/recv.
+                    fn = args[0]
+                    self._store_returns(spec, fn(instance, *args[1:], **kwargs))
+                else:
+                    method = getattr(instance, spec.method_name)
+                    self._store_returns(spec, method(*args, **kwargs))
             else:
                 fn = self._load_function(spec.fn_id)
                 args, kwargs = self._resolve_args(spec.args_blob)

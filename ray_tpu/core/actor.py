@@ -29,6 +29,11 @@ class ActorMethod:
         return self._handle._submit_method(
             self._method_name, args, kwargs, num_returns=1)
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy DAG node (reference: python/ray/dag class_node)."""
+        from ray_tpu.dag.dag_node import ClassMethodNode
+        return ClassMethodNode(self._handle, self._method_name, args, kwargs)
+
     def options(self, num_returns: int = 1, **_ignored):
         handle, name = self._handle, self._method_name
 
@@ -50,7 +55,9 @@ class ActorHandle:
         return self._actor_id
 
     def __getattr__(self, item):
-        if item.startswith("_"):
+        # __rtpu_apply__ is the universal hidden method (reference parity:
+        # __ray_call__) — any other underscore name is a real miss.
+        if item.startswith("_") and item != "__rtpu_apply__":
             raise AttributeError(item)
         return ActorMethod(self, item)
 

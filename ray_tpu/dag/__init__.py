@@ -1,0 +1,26 @@
+"""ray_tpu.dag: lazy DAGs of actor calls + compiled channel execution.
+
+Counterpart of /root/reference/python/ray/dag/ (aDAG / compiled graphs).
+"""
+
+from ray_tpu.dag.channel import Channel, ChannelClosed
+from ray_tpu.dag.compiled import CompiledDAG, CompiledDAGRef
+from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
+    DAGNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+__all__ = [
+    "Channel",
+    "ChannelClosed",
+    "ClassMethodNode",
+    "CompiledDAG",
+    "CompiledDAGRef",
+    "DAGNode",
+    "InputAttributeNode",
+    "InputNode",
+    "MultiOutputNode",
+]

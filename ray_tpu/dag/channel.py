@@ -1,0 +1,121 @@
+"""Shared-memory channels: the compiled-DAG data plane.
+
+Counterpart of the reference's mutable-object channels
+(/root/reference/src/ray/core_worker/experimental_mutable_object_manager.h:44,
+python/ray/experimental/channel/shared_memory_channel.py). The reference
+implements a writer/reader semaphore protocol over one mutable plasma buffer;
+here a channel is a bounded ring of *immutable* store objects — write ``seq``
+seals object ``h(chan_id, seq)``, read ``seq`` gets (and frees) it — which
+keeps the store's single immutability invariant and still moves arrays
+zero-copy through shm. Backpressure: the reader acks its read sequence into
+the GCS KV; the writer blocks once it is ``capacity`` messages ahead (the
+KV round-trip is only paid when the ring is actually full). Cross-node reads
+ride the normal object-transfer pull path, so a channel between actors on
+different hosts needs no extra machinery.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Optional
+
+import numpy as np
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu.core.object_ref import ObjectRef
+
+_KV_NS = "dag_channel"
+_POLL_S = 0.001
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class _Stop:
+    """Sentinel flowing through channels on teardown."""
+
+    def __repr__(self):
+        return "<dag stop>"
+
+
+STOP = _Stop()
+
+
+def _ctx():
+    w = worker_mod.global_worker()
+    if w is None:
+        raise RuntimeError("ray_tpu not initialized in this process")
+    return w
+
+
+class Channel:
+    """One writer, one reader, bounded capacity. Pickles to the same channel
+    (id + capacity travel; seq state is per-process endpoint state)."""
+
+    def __init__(self, chan_id: bytes, capacity: int = 16):
+        self.chan_id = chan_id
+        self.capacity = capacity
+        self._wseq = 0
+        self._rseq = 0
+        self._acked = -1
+
+    def __reduce__(self):
+        return (Channel, (self.chan_id, self.capacity))
+
+    def _oid(self, seq: int) -> bytes:
+        return hashlib.sha1(
+            self.chan_id + seq.to_bytes(8, "little")).digest()[:20]
+
+    def _ack_key(self) -> bytes:
+        return b"ack/" + self.chan_id
+
+    # -- writer end --------------------------------------------------------
+    def write(self, value, timeout: Optional[float] = None) -> None:
+        ctx = _ctx()
+        if self._wseq - self._acked > self.capacity:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while True:
+                raw = ctx.rpc("kv_get", {"namespace": _KV_NS,
+                                         "key": self._ack_key()})
+                if raw is not None:
+                    ack = int.from_bytes(raw, "little", signed=True)
+                    if ack > self._acked:
+                        # reader consumed up to ack: reclaim our local copies
+                        for s in range(max(0, self._acked), ack + 1):
+                            try:
+                                ctx.store.delete(self._oid(s))
+                            except Exception:
+                                pass
+                        self._acked = ack
+                if self._wseq - self._acked <= self.capacity:
+                    break
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"channel {self.chan_id.hex()[:8]} write timed out "
+                        f"(reader {self._wseq - self._acked} behind)")
+                time.sleep(_POLL_S)
+        ctx.put_object(value, oid=self._oid(self._wseq))
+        self._wseq += 1
+
+    # -- reader end --------------------------------------------------------
+    def read(self, timeout: Optional[float] = None):
+        ctx = _ctx()
+        value = ctx.get_object(ObjectRef(self._oid(self._rseq)),
+                               timeout=timeout)
+        if isinstance(value, np.ndarray):
+            # Own the data before the backing shm buffer can be reclaimed by
+            # the writer once we ack.
+            value = np.array(value)
+        try:
+            ctx.store.delete(self._oid(self._rseq))
+        except Exception:
+            pass
+        ctx.rpc("kv_put", {
+            "namespace": _KV_NS, "key": self._ack_key(),
+            "value": self._rseq.to_bytes(8, "little", signed=True)})
+        self._rseq += 1
+        if isinstance(value, _Stop):
+            raise ChannelClosed()
+        return value

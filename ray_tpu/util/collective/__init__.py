@@ -1,0 +1,40 @@
+"""ray_tpu.util.collective: host-plane collectives between actors/tasks.
+
+In-program (ICI) collectives belong to jitted SPMD code via jax.lax — see
+ray_tpu.parallel. This package coordinates across processes, the role the
+reference's NCCL/Gloo groups play (/root/reference/python/ray/util/collective/).
+"""
+
+from ray_tpu.util.collective.collective import (
+    ReduceOp,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    declare_collective_group,
+    destroy_collective_group,
+    get_collective_group_size,
+    get_rank,
+    init_collective_group,
+    is_group_initialized,
+    recv,
+    reducescatter,
+    send,
+)
+
+__all__ = [
+    "ReduceOp",
+    "allgather",
+    "allreduce",
+    "barrier",
+    "broadcast",
+    "declare_collective_group",
+    "destroy_collective_group",
+    "get_collective_group_size",
+    "get_rank",
+    "init_collective_group",
+    "is_group_initialized",
+    "recv",
+    "reducescatter",
+    "send",
+]

@@ -1,0 +1,266 @@
+"""Actor/task-group collectives over the shared-memory object store.
+
+Counterpart of the reference's collective library
+(/root/reference/python/ray/util/collective/collective.py:145 init_collective_group,
+:290 allreduce, plus allgather/reducescatter/broadcast/send/recv) — but where the
+reference wraps NCCL/Gloo communicators, the TPU-native design has two planes:
+
+1. **In-program (ICI) collectives** are *not here*: inside a jitted SPMD
+   program they are ``jax.lax.psum/all_gather/ppermute`` over mesh axes —
+   XLA emits ICI collectives directly (see ray_tpu.parallel.mesh).
+2. **Host-plane collectives** (this module) coordinate *between actors or
+   tasks* — different processes, possibly different hosts — the role NCCL
+   groups play for the reference's `ray.util.collective`.  The data plane is
+   the native shm object store (zero-copy numpy intra-node, chunked pulls
+   across nodes); the rendezvous plane is the GCS KV, so there is no extra
+   coordinator process or actor to place and no communicator state to leak.
+
+Every participant calls ``init_collective_group(world_size, rank, group_name)``
+once, then the verbs.  Each verb bumps a per-group sequence number that all
+ranks advance in lockstep (same total order of collectives per group — the
+same contract NCCL imposes), so keys never collide across rounds.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu.core.object_ref import ObjectRef
+
+_KV_NS = "collective"
+_POLL_S = 0.002
+
+
+class ReduceOp:
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+    MEAN = "mean"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda xs: sum(xs[1:], xs[0]),
+    ReduceOp.PRODUCT: lambda xs: _fold(np.multiply, xs),
+    ReduceOp.MIN: lambda xs: _fold(np.minimum, xs),
+    ReduceOp.MAX: lambda xs: _fold(np.maximum, xs),
+    ReduceOp.MEAN: lambda xs: sum(xs[1:], xs[0]) / len(xs),
+}
+
+
+def _fold(op, xs):
+    acc = xs[0]
+    for x in xs[1:]:
+        acc = op(acc, x)
+    return acc
+
+
+class _GroupState:
+    def __init__(self, world_size: int, rank: int, name: str):
+        if not (0 <= rank < world_size):
+            raise ValueError(f"rank {rank} out of range for world {world_size}")
+        self.world_size = world_size
+        self.rank = rank
+        self.name = name
+        self.seq = 0
+
+
+# group_name -> _GroupState, per process (each actor is its own process).
+_groups: dict[str, _GroupState] = {}
+
+
+def _ctx():
+    w = worker_mod.global_worker()
+    if w is None:
+        raise RuntimeError("ray_tpu is not initialized in this process")
+    return w
+
+
+def _kv_put(key: str, value: bytes):
+    _ctx().rpc("kv_put", {"namespace": _KV_NS, "key": key.encode(),
+                          "value": value})
+
+
+def _kv_get(key: str) -> Optional[bytes]:
+    return _ctx().rpc("kv_get", {"namespace": _KV_NS, "key": key.encode()})
+
+
+def _kv_del(key: str):
+    _ctx().rpc("kv_del", {"namespace": _KV_NS, "key": key.encode()})
+
+
+def _wait_kv(key: str, timeout: float) -> bytes:
+    deadline = time.monotonic() + timeout
+    while True:
+        v = _kv_get(key)
+        if v is not None:
+            return v
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"collective rendezvous timed out on {key!r}")
+        time.sleep(_POLL_S)
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "shm",
+                          group_name: str = "default") -> None:
+    """Join a collective group. Call once in every participating process.
+
+    ``backend`` accepts "shm" (native) — "nccl"/"gloo" names from reference
+    code are mapped to it so ported call-sites run unchanged.
+    """
+    if backend not in ("shm", "nccl", "gloo", "xla"):
+        raise ValueError(f"unknown collective backend {backend!r}")
+    if group_name in _groups:
+        raise RuntimeError(f"collective group {group_name!r} already "
+                           f"initialized in this process")
+    _groups[group_name] = _GroupState(world_size, rank, group_name)
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return group_name in _groups
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _group(group_name).world_size
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    _groups.pop(group_name, None)
+
+
+def _group(group_name: str) -> _GroupState:
+    g = _groups.get(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} is not initialized; call "
+            f"init_collective_group(world_size, rank, group_name=...) first")
+    return g
+
+
+def _to_host(tensor) -> np.ndarray:
+    # jax.Array / torch.Tensor / numpy all round-trip through the host for
+    # the host-plane; in-program collectives never leave HBM (see module doc).
+    if hasattr(tensor, "__array__"):
+        return np.asarray(tensor)
+    return np.asarray(tensor)
+
+
+def _publish(g: _GroupState, tag: str, arr: np.ndarray) -> None:
+    ref = _ctx().put_object(arr)
+    _kv_put(f"{g.name}/{g.seq}/{tag}", ref.binary())
+
+
+def _collect(g: _GroupState, tag_of, timeout: float) -> List[np.ndarray]:
+    from ray_tpu import api
+    out = []
+    for r in range(g.world_size):
+        oid = _wait_kv(f"{g.name}/{g.seq}/{tag_of(r)}", timeout)
+        out.append(api.get(ObjectRef(oid), timeout=timeout))
+    return out
+
+
+def allgather(tensor, group_name: str = "default",
+              timeout: float = 60.0) -> List[np.ndarray]:
+    """Gather every rank's tensor; returns list indexed by rank."""
+    g = _group(group_name)
+    _publish(g, f"ag/{g.rank}", _to_host(tensor))
+    vals = _collect(g, lambda r: f"ag/{r}", timeout)
+    g.seq += 1
+    return vals
+
+
+def allreduce(tensor, op: str = ReduceOp.SUM, group_name: str = "default",
+              timeout: float = 60.0) -> np.ndarray:
+    """Reduce across ranks; every rank returns the full reduced tensor."""
+    if op not in _REDUCERS:
+        raise ValueError(f"unknown reduce op {op!r}")
+    vals = allgather(tensor, group_name=group_name, timeout=timeout)
+    return _REDUCERS[op](vals)
+
+
+def reducescatter(tensor, op: str = ReduceOp.SUM,
+                  group_name: str = "default",
+                  timeout: float = 60.0) -> np.ndarray:
+    """Reduce across ranks, then return this rank's 1/world_size shard
+    (along axis 0, which must divide evenly)."""
+    g = _group(group_name)
+    reduced = allreduce(tensor, op=op, group_name=group_name, timeout=timeout)
+    n = g.world_size
+    if reduced.shape[0] % n:
+        raise ValueError(
+            f"reducescatter dim0 {reduced.shape[0]} not divisible by "
+            f"world_size {n}")
+    shard = reduced.shape[0] // n
+    return reduced[g.rank * shard:(g.rank + 1) * shard]
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default",
+              timeout: float = 60.0) -> np.ndarray:
+    """Every rank returns src_rank's tensor."""
+    from ray_tpu import api
+    g = _group(group_name)
+    if g.rank == src_rank:
+        _publish(g, f"bc/{src_rank}", _to_host(tensor))
+    oid = _wait_kv(f"{g.name}/{g.seq}/bc/{src_rank}", timeout)
+    g.seq += 1
+    return api.get(ObjectRef(oid), timeout=timeout)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    """Point-to-point send (pairs with recv on dst_rank)."""
+    g = _group(group_name)
+    ref = _ctx().put_object(_to_host(tensor))
+    _kv_put(f"{g.name}/p2p/{g.rank}->{dst_rank}/{g.seq}", ref.binary())
+    g.seq += 1
+
+
+def recv(src_rank: int, group_name: str = "default",
+         timeout: float = 60.0) -> np.ndarray:
+    """Point-to-point receive from src_rank.
+
+    Unlike the reference (which writes into a caller tensor), returns the
+    received array — idiomatic for a functional JAX host program.
+    """
+    from ray_tpu import api
+    g = _group(group_name)
+    oid = _wait_kv(f"{g.name}/p2p/{src_rank}->{g.rank}/{g.seq}", timeout)
+    _kv_del(f"{g.name}/p2p/{src_rank}->{g.rank}/{g.seq}")
+    g.seq += 1
+    return api.get(ObjectRef(oid), timeout=timeout)
+
+
+def barrier(group_name: str = "default", timeout: float = 60.0) -> None:
+    """Block until every rank reaches the same barrier."""
+    allgather(np.zeros((), np.int8), group_name=group_name, timeout=timeout)
+
+
+def declare_collective_group(actors: Sequence, world_size: Optional[int] = None,
+                             ranks: Optional[Sequence[int]] = None,
+                             backend: str = "shm",
+                             group_name: str = "default") -> None:
+    """Driver-side convenience: initialize the group inside each actor.
+
+    Uses the hidden ``__rtpu_apply__`` actor method (counterpart of the
+    reference's ``__ray_call__``), so any actor class participates without
+    declaring anything.
+    """
+    n = world_size if world_size is not None else len(actors)
+    rks = list(ranks) if ranks is not None else list(range(len(actors)))
+    from ray_tpu import api
+
+    def _join(_self, world, rank, be, gname):
+        init_collective_group(world, rank, backend=be, group_name=gname)
+
+    refs = [
+        a.__rtpu_apply__.remote(_join, n, r, backend, group_name)
+        for a, r in zip(actors, rks)
+    ]
+    api.get(refs)

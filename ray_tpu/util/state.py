@@ -106,8 +106,16 @@ def list_objects() -> List[Dict[str, Any]]:
 
 def list_placement_groups() -> List[Dict[str, Any]]:
     table = _rpc("pg_table")
-    return [{"placement_group_id": pg_id.hex(), **info}
-            for pg_id, info in table.items()]
+    rows = []
+    for pg_id, info in table.items():
+        row = {"placement_group_id": pg_id.hex(), **info}
+        # node IDs hex like every other row in this module (JSON-safe)
+        if "assignment" in row:
+            row["assignment"] = [
+                n.hex() if isinstance(n, bytes) else n
+                for n in row["assignment"]]
+        rows.append(row)
+    return rows
 
 
 def summarize_events(events: List[dict]) -> Dict[str, Dict[str, int]]:

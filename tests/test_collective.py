@@ -1,0 +1,91 @@
+"""Host-plane collective library (ray_tpu.util.collective).
+
+Mirrors the reference's collective tests
+(/root/reference/python/ray/util/collective/tests/) shape: a group of actors
+init a group, then run allreduce/allgather/broadcast/send-recv.
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def cluster(ray_cluster):
+    return ray_cluster
+
+
+def _make_workers(n):
+    import ray_tpu
+    from ray_tpu.util import collective
+
+    @ray_tpu.remote
+    class Rank:
+        def __init__(self, rank, world):
+            collective.init_collective_group(world, rank, group_name="g")
+            self.rank = rank
+
+        def do_allreduce(self):
+            return collective.allreduce(
+                np.full((4,), float(self.rank + 1)), group_name="g")
+
+        def do_allgather(self):
+            return collective.allgather(
+                np.array([self.rank]), group_name="g")
+
+        def do_broadcast(self):
+            return collective.broadcast(
+                np.arange(3) * (self.rank + 1), src_rank=1, group_name="g")
+
+        def do_reducescatter(self):
+            return collective.reducescatter(
+                np.arange(4, dtype=np.float64), group_name="g")
+
+        def do_sendrecv(self):
+            from ray_tpu.util.collective import recv, send
+            if self.rank == 0:
+                send(np.array([42.0]), dst_rank=1, group_name="g")
+                return None
+            return recv(0, group_name="g")
+
+    return [Rank.remote(i, n) for i in range(n)]
+
+
+def test_allreduce_allgather(cluster):
+    import ray_tpu
+
+    workers = _make_workers(2)
+    out = ray_tpu.get([w.do_allreduce.remote() for w in workers])
+    for o in out:
+        np.testing.assert_allclose(o, np.full((4,), 3.0))
+    gathered = ray_tpu.get([w.do_allgather.remote() for w in workers])
+    for g in gathered:
+        assert [int(x[0]) for x in g] == [0, 1]
+    bcast = ray_tpu.get([w.do_broadcast.remote() for w in workers])
+    for b in bcast:
+        np.testing.assert_allclose(b, np.arange(3) * 2)
+    rs = ray_tpu.get([w.do_reducescatter.remote() for w in workers])
+    np.testing.assert_allclose(rs[0], [0.0, 2.0])
+    np.testing.assert_allclose(rs[1], [4.0, 6.0])
+    sr = ray_tpu.get([w.do_sendrecv.remote() for w in workers])
+    assert sr[0] is None and float(sr[1][0]) == 42.0
+    for w in workers:
+        ray_tpu.kill(w)
+
+
+def test_declare_collective_group(cluster):
+    import ray_tpu
+    from ray_tpu.util import collective
+
+    @ray_tpu.remote
+    class Plain:
+        def reduce_val(self, v):
+            return collective.allreduce(np.array([v]), group_name="g2")
+
+    actors = [Plain.remote() for _ in range(3)]
+    collective.declare_collective_group(actors, group_name="g2")
+    out = ray_tpu.get(
+        [a.reduce_val.remote(float(i)) for i, a in enumerate(actors)])
+    for o in out:
+        np.testing.assert_allclose(o, [3.0])
+    for a in actors:
+        ray_tpu.kill(a)
