@@ -119,6 +119,13 @@ class Node:
             node_id=self.node_id,
             is_head=head,
         )
+        if head:
+            # Job submission lives on the head (reference: JobManager in the
+            # dashboard head process, dashboard/modules/job/job_manager.py).
+            from ray_tpu._private.job_manager import JobManager
+
+            self.scheduler.job_manager = JobManager(
+                self.gcs, self.gcs_address, self.session_dir)
 
     def new_store_client(self) -> StoreClient:
         return StoreClient(
@@ -128,6 +135,16 @@ class Node:
         )
 
     def shutdown(self):
+        jm = getattr(self.scheduler, "job_manager", None)
+        if jm is not None:
+            jm.shutdown()
+        if self.gcs_server is None:
+            # Attached (non-head) node leaving gracefully: tell the GCS now
+            # instead of making peers wait out the heartbeat timeout.
+            try:
+                self.gcs.mark_node_dead(self.node_id)
+            except Exception:
+                pass  # head may already be gone
         self.scheduler.shutdown()
         self.store_server.shutdown()
         if self.gcs_server is not None:

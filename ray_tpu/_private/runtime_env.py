@@ -1,0 +1,211 @@
+"""Runtime environments: per-task/actor env isolation.
+
+Counterpart of /root/reference/python/ray/_private/runtime_env/ — the subset
+that makes sense on an air-gapped TPU pod: ``env_vars`` (applied around
+execution in the pooled worker), ``working_dir`` and ``py_modules``
+(directories zipped into the GCS KV at submission — the reference's
+packaging.py path — then materialized once per worker into a content-hash
+cache and put on sys.path / cwd). Network installers (pip/conda/uv) are
+rejected with a clear error: cluster nodes have no package egress, so an
+env that needs them is a deployment-image concern (image_uri in the
+reference), not a scheduling-time one.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import sys
+import threading
+import zipfile
+from typing import Optional
+
+_KV_NS = "runtime_env_packages"
+_MAX_PACKAGE_BYTES = 256 << 20
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+_SUPPORTED = {"env_vars", "working_dir", "py_modules", "config"}
+_REJECTED = {"pip", "conda", "uv", "container", "image_uri"}
+
+
+def validate(runtime_env: Optional[dict]) -> Optional[dict]:
+    if not runtime_env:
+        return None
+    bad = set(runtime_env) & _REJECTED
+    if bad:
+        raise ValueError(
+            f"runtime_env fields {sorted(bad)} are not supported: cluster "
+            f"nodes have no package-install egress; bake dependencies into "
+            f"the node image instead")
+    unknown = set(runtime_env) - _SUPPORTED
+    if unknown:
+        raise ValueError(f"unknown runtime_env fields {sorted(unknown)}; "
+                         f"supported: {sorted(_SUPPORTED)}")
+    ev = runtime_env.get("env_vars")
+    if ev is not None and not (
+        isinstance(ev, dict)
+        and all(isinstance(k, str) and isinstance(v, str)
+                for k, v in ev.items())
+    ):
+        raise ValueError("runtime_env['env_vars'] must be a dict[str, str]")
+    return runtime_env
+
+
+def _zip_dir(path: str) -> bytes:
+    path = os.path.abspath(os.path.expanduser(path))
+    if not os.path.isdir(path):
+        raise ValueError(f"runtime_env directory {path!r} does not exist")
+    buf = io.BytesIO()
+    total = 0
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs if d not in _EXCLUDE_DIRS]
+            for f in files:
+                full = os.path.join(root, f)
+                total += os.path.getsize(full)
+                if total > _MAX_PACKAGE_BYTES:
+                    raise ValueError(
+                        f"runtime_env package {path!r} exceeds "
+                        f"{_MAX_PACKAGE_BYTES >> 20} MiB")
+                zf.write(full, os.path.relpath(full, path))
+    return buf.getvalue()
+
+
+# Driver-side memo: abspath -> (stat signature, uploaded uri). Re-zipping a
+# working_dir on EVERY .remote() call would collapse submission throughput;
+# a stat-only walk detects edits and invalidates.
+_upload_cache: dict[str, tuple[int, str]] = {}
+
+
+def _dir_signature(path: str) -> int:
+    h = hashlib.sha1()
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
+        for f in sorted(files):
+            full = os.path.join(root, f)
+            try:
+                st = os.stat(full)
+            except OSError:
+                continue
+            h.update(f"{os.path.relpath(full, path)}|{st.st_mtime_ns}|"
+                     f"{st.st_size};".encode())
+    return int.from_bytes(h.digest()[:8], "little")
+
+
+def package(runtime_env: Optional[dict], ctx) -> Optional[dict]:
+    """Driver side: validate + replace local dirs with kvzip:// URIs.
+
+    Content-addressed: the same directory contents upload once per cluster
+    (reference: packaging.py get_uri_for_directory).
+    """
+    runtime_env = validate(runtime_env)
+    if runtime_env is None:
+        return None
+    out = dict(runtime_env)
+
+    def upload(path: str) -> str:
+        if isinstance(path, str) and path.startswith("kvzip://"):
+            return path
+        apath = os.path.abspath(os.path.expanduser(path))
+        sig = _dir_signature(apath)
+        cached = _upload_cache.get(apath)
+        if cached is not None and cached[0] == sig:
+            return cached[1]
+        blob = _zip_dir(apath)
+        digest = hashlib.sha1(blob).hexdigest()
+        key = digest.encode()
+        if ctx.rpc("kv_get", {"namespace": _KV_NS, "key": key}) is None:
+            ctx.rpc("kv_put", {"namespace": _KV_NS, "key": key,
+                               "value": blob})
+        uri = f"kvzip://{digest}"
+        _upload_cache[apath] = (sig, uri)
+        return uri
+
+    if "working_dir" in out and out["working_dir"]:
+        out["working_dir"] = upload(out["working_dir"])
+    if "py_modules" in out and out["py_modules"]:
+        out["py_modules"] = [upload(p) for p in out["py_modules"]]
+    return out
+
+
+_materialize_lock = threading.Lock()
+
+
+def _materialize(uri: str, ctx) -> str:
+    """Worker side: fetch a kvzip:// package into the node-local cache."""
+    digest = uri[len("kvzip://"):]
+    dest = os.path.join("/tmp/ray_tpu/runtime_env_cache", digest)
+    with _materialize_lock:
+        if os.path.isdir(dest):
+            return dest
+        blob = ctx.rpc("kv_get", {"namespace": _KV_NS,
+                                  "key": digest.encode()})
+        if blob is None:
+            raise RuntimeError(f"runtime_env package {uri} not found in GCS")
+        tmp = dest + f".tmp{os.getpid()}"
+        with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+            zf.extractall(tmp)
+        try:
+            os.rename(tmp, dest)
+        except OSError:
+            # Another PROCESS won the race (the threading lock above only
+            # covers this process); its extraction is complete because
+            # rename is the last step. Drop our copy.
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+            if not os.path.isdir(dest):
+                raise
+    return dest
+
+
+class AppliedEnv:
+    """Worker-side applied runtime env; undo() restores the process."""
+
+    def __init__(self):
+        self._env_prev: dict[str, Optional[str]] = {}
+        self._sys_path_added: list[str] = []
+        self._prev_cwd: Optional[str] = None
+
+    def undo(self):
+        for p in self._sys_path_added:
+            try:
+                sys.path.remove(p)
+            except ValueError:
+                pass
+        if self._prev_cwd is not None:
+            try:
+                os.chdir(self._prev_cwd)
+            except OSError:
+                pass
+        for k, prev in self._env_prev.items():
+            if prev is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = prev
+
+
+def apply(runtime_env: Optional[dict], ctx) -> Optional[AppliedEnv]:
+    if not runtime_env:
+        return None
+    applied = AppliedEnv()
+    try:
+        for k, v in (runtime_env.get("env_vars") or {}).items():
+            applied._env_prev[k] = os.environ.get(k)
+            os.environ[k] = v
+        wd = runtime_env.get("working_dir")
+        if wd:
+            path = _materialize(wd, ctx)
+            applied._prev_cwd = os.getcwd()
+            os.chdir(path)
+            sys.path.insert(0, path)
+            applied._sys_path_added.append(path)
+        for uri in runtime_env.get("py_modules") or []:
+            path = _materialize(uri, ctx)
+            sys.path.insert(0, path)
+            applied._sys_path_added.append(path)
+    except BaseException:
+        applied.undo()
+        raise
+    return applied

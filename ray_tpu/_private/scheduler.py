@@ -733,6 +733,39 @@ class Scheduler:
             return True
         if method == "kv_keys":
             return self.gcs.kv_keys(params["namespace"])
+        if method == "shutdown_node":
+            # `rtpu stop`: only standalone `rtpu start` processes opt in
+            # (reference parity: `ray stop` kills only `ray start` nodes,
+            # never interactive drivers that called init() in-process).
+            if not getattr(self, "allow_external_shutdown", False):
+                return False
+            import signal as _signal
+
+            def _term():
+                time.sleep(0.2)
+                os.kill(os.getpid(), _signal.SIGTERM)
+
+            threading.Thread(target=_term, daemon=True).start()
+            return True
+        if method.startswith("job_"):
+            jm = getattr(self, "job_manager", None)
+            if jm is None:
+                raise RuntimeError("job submission is served by the head "
+                                   "node; this is not the head")
+            if method == "job_submit":
+                return jm.submit(
+                    params["entrypoint"],
+                    runtime_env=params.get("runtime_env"),
+                    submission_id=params.get("submission_id"),
+                    metadata=params.get("metadata"))
+            if method == "job_status":
+                return jm.status(params["submission_id"])
+            if method == "job_list":
+                return jm.list_jobs()
+            if method == "job_logs":
+                return jm.logs(params["submission_id"])
+            if method == "job_stop":
+                return jm.stop(params["submission_id"])
         if method == "pull":
             return self.trigger_pull(params["oid"])
         if method == "object_locations":

@@ -21,6 +21,7 @@ from concurrent.futures import ThreadPoolExecutor
 import cloudpickle
 
 from ray_tpu._private import protocol
+from ray_tpu._private import runtime_env as runtime_env_mod
 from ray_tpu._private.scheduler import ACTOR_CREATION, ACTOR_METHOD, TaskSpec
 from ray_tpu._private.serialization import store_error_best_effort
 from ray_tpu._private.worker import WorkerContext, set_global_worker
@@ -142,6 +143,26 @@ class WorkerRuntime:
         self.ctx.current_task_id = spec.task_id
         self.ctx.current_actor_id = spec.actor_id
         ok, error = True, None
+        # Runtime env: normal tasks apply/undo around execution; an actor's
+        # env (applied at creation) persists for its lifetime — the worker
+        # is dedicated to the actor (reference: runtime_env installed by the
+        # agent before the worker starts, _private/runtime_env/).
+        applied_env = None
+        if spec.runtime_env and spec.kind != ACTOR_METHOD:
+            try:
+                applied_env = runtime_env_mod.apply(spec.runtime_env, self.ctx)
+            except BaseException as e:  # noqa: BLE001
+                ok, error = False, repr(e)
+                tb = traceback.format_exc()
+                for oid in spec.return_ids:
+                    if store_error_best_effort(self.store, oid, e, tb,
+                                               raised_by_task=True):
+                        self.conn.send({"t": "sealed", "oid": oid})
+                self.conn.send({"t": "done", "task_id": spec.task_id,
+                                "ok": ok, "error": error})
+                self.ctx.current_task_id = None
+                self.ctx.current_actor_id = None
+                return
         try:
             if spec.kind == ACTOR_CREATION:
                 cls = self._load_function(spec.fn_id)
@@ -186,6 +207,13 @@ class WorkerRuntime:
                     print(f"FATAL: could not record error for "
                           f"{oid.hex()[:12]}", file=sys.stderr, flush=True)
         finally:
+            # Actor envs persist only if creation SUCCEEDED — on failure the
+            # scheduler returns this worker to the shared pool, which must
+            # not inherit the dead actor's cwd/env/sys.path.
+            if applied_env is not None and (
+                spec.kind != ACTOR_CREATION or not ok
+            ):
+                applied_env.undo()
             self.ctx.current_task_id = None
             self.ctx.current_actor_id = None
         self.conn.send({"t": "done", "task_id": spec.task_id, "ok": ok,

@@ -8,7 +8,9 @@ python/ray/__init__.py re-exports).
 from __future__ import annotations
 
 import atexit
+import glob
 import inspect
+import os
 import time
 from typing import Optional, Sequence, Union
 
@@ -29,7 +31,7 @@ def init(
     object_store_memory: Optional[int] = None,
     num_cpus: Optional[float] = None,
     num_tpus: Optional[float] = None,
-    min_workers: int = 2,
+    min_workers: Optional[int] = None,  # default: 2 head / 0 attached
     max_workers: Optional[int] = None,
     ignore_reinit_error: bool = False,
     _existing_node: Optional["Node"] = None,
@@ -42,26 +44,64 @@ def init(
             return _global_node
         raise RuntimeError("ray_tpu.init() called twice; pass "
                            "ignore_reinit_error=True to ignore")
-    if address is not None:
-        raise NotImplementedError(
-            "remote cluster addresses are not supported yet; attach to an "
-            "in-process cluster with ray_tpu.cluster_utils.Cluster")
     res = dict(resources or {})
     if num_cpus is not None:
         res["CPU"] = float(num_cpus)
     if num_tpus is not None:
         res["TPU"] = float(num_tpus)
-    node = _existing_node or Node(
-        resources=res or None,
-        object_store_memory=object_store_memory,
-        min_workers=min_workers,
-        max_workers=max_workers,
-    )
+    address = address or os.environ.get("RAY_TPU_ADDRESS")
+    if address is not None:
+        # Attach this process to an existing cluster as a driver: start a
+        # local (non-head) node joined through the head's gcs.sock
+        # (reference: ray.init(address=...) connecting a driver,
+        # python/ray/_private/worker.py:1330). By default the attached
+        # driver contributes no resources — its tasks spill to the
+        # cluster's nodes — so a transient job driver doesn't distort the
+        # cluster's capacity.
+        if address == "auto":
+            address = _find_gcs_address()
+        node = Node(
+            head=False,
+            gcs_address=address,
+            resources=res or {"CPU": 0.0, "TPU": 0.0},
+            object_store_memory=object_store_memory,
+            min_workers=0 if min_workers is None else min_workers,
+            max_workers=max_workers,
+        )
+    else:
+        node = _existing_node or Node(
+            resources=res or None,
+            object_store_memory=object_store_memory,
+            min_workers=2 if min_workers is None else min_workers,
+            max_workers=max_workers,
+        )
     _global_node = node
     _attach_driver(node)
     if _existing_node is None:
         atexit.register(shutdown)
     return node
+
+
+def _find_gcs_address() -> str:
+    """Newest LIVE session's gcs.sock (address="auto"): crashed clusters
+    leave stale sockets on disk, so probe before choosing."""
+    import socket as socket_mod
+
+    socks = sorted(glob.glob("/tmp/ray_tpu/session_*/gcs.sock"),
+                   key=os.path.getmtime, reverse=True)
+    for path in socks:
+        s = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+        s.settimeout(1.0)
+        try:
+            s.connect(path)
+            return path
+        except OSError:
+            continue
+        finally:
+            s.close()
+    raise ConnectionError(
+        "address='auto' found no live ray_tpu cluster "
+        "(no connectable /tmp/ray_tpu/session_*/gcs.sock)")
 
 
 def _attach_driver(node: Node):

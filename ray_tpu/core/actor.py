@@ -17,6 +17,7 @@ from ray_tpu._private import ids
 from ray_tpu._private.scheduler import ACTOR_CREATION, ACTOR_METHOD, TaskSpec
 from ray_tpu._private.worker import global_worker
 from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu._private.runtime_env import package as package_runtime_env
 from ray_tpu.core.remote_function import resolve_resources, strategy_fields
 
 
@@ -116,7 +117,8 @@ class ActorClass:
             max_restarts=opts.get("max_restarts", 0),
             max_concurrency=opts.get("max_concurrency", 1),
             actor_name=opts.get("name"),
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=package_runtime_env(
+                opts.get("runtime_env"), worker),
             **strategy_fields(opts),
         )
         worker.submit(spec)

@@ -13,6 +13,7 @@ from typing import Any, Optional
 import cloudpickle
 
 from ray_tpu._private import ids
+from ray_tpu._private.runtime_env import package as package_runtime_env
 from ray_tpu._private.scheduler import TASK, TaskSpec
 from ray_tpu._private.worker import global_worker
 from ray_tpu.core.object_ref import ObjectRef
@@ -87,7 +88,8 @@ class RemoteFunction:
             resources=resolve_resources(options),
             name=options.get("name") or self.__name__,
             max_retries=options.get("max_retries", 3),
-            runtime_env=options.get("runtime_env"),
+            runtime_env=package_runtime_env(
+                options.get("runtime_env"), worker),
             **strategy_fields(options),
         )
         worker.submit(spec)

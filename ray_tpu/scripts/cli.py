@@ -161,6 +161,100 @@ def cmd_microbenchmark(args):
     perf.main()
 
 
+def cmd_start(args):
+    """Run a standalone (head or worker) node until signalled.
+
+    Reference: `ray start --head` (scripts.py) — but our nodes are
+    in-process services, so `start` IS the node process (no daemonizing:
+    run it under systemd/tmux/&).
+    """
+    import signal
+
+    import ray_tpu
+
+    if args.head:
+        node = ray_tpu.init(
+            num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+            min_workers=args.min_workers)
+        print(f"head node started\n  gcs address: {node.gcs_address}\n"
+              f"  attach with: ray_tpu.init(address={node.gcs_address!r}) "
+              f"or RAY_TPU_ADDRESS", flush=True)
+    else:
+        from ray_tpu._private.node import Node
+
+        address = args.address or "auto"
+        if address == "auto":
+            from ray_tpu.api import _find_gcs_address
+
+            address = _find_gcs_address()
+        res = {}
+        if args.num_cpus is not None:
+            res["CPU"] = float(args.num_cpus)
+        if args.num_tpus is not None:
+            res["TPU"] = float(args.num_tpus)
+        node = Node(head=False, gcs_address=address,
+                    resources=res or None, min_workers=args.min_workers)
+        print(f"worker node {node.node_id.hex()[:8]} joined {address}",
+              flush=True)
+    node.scheduler.allow_external_shutdown = True  # `rtpu stop` may kill us
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        pass
+    if args.head:
+        ray_tpu.shutdown()
+    else:
+        node.shutdown()
+
+
+def cmd_stop(args):
+    """Terminate every live local session (reference: `ray stop`)."""
+    import glob as _glob
+
+    stopped = 0
+    for sock in _glob.glob("/tmp/ray_tpu/session_*/sched.sock"):
+        try:
+            if _rpc(sock, "shutdown_node"):  # False = in-process driver node
+                stopped += 1
+        except Exception:
+            continue
+    print(f"signalled {stopped} node(s)")
+
+
+def cmd_job(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(args.address)
+    if args.job_command == "submit":
+        if args.entrypoint and args.entrypoint[0] == "--":
+            args.entrypoint = args.entrypoint[1:]  # REMAINDER keeps the --
+        runtime_env = {}
+        if args.working_dir:
+            runtime_env["working_dir"] = args.working_dir
+        sub_id = client.submit_job(
+            entrypoint=" ".join(args.entrypoint),
+            runtime_env=runtime_env or None)
+        print(sub_id)
+        if args.wait:
+            status = client.wait_until_finished(sub_id)
+            print(client.get_job_logs(sub_id), end="")
+            print(f"status: {status}")
+    elif args.job_command == "status":
+        print(client.get_job_status(args.submission_id))
+    elif args.job_command == "logs":
+        print(client.get_job_logs(args.submission_id), end="")
+    elif args.job_command == "stop":
+        print("stopped" if client.stop_job(args.submission_id)
+              else "not running")
+    elif args.job_command == "list":
+        for info in client.list_jobs():
+            print(f"{info.submission_id:28s} {info.status:10s} "
+                  f"{info.entrypoint}")
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="ray_tpu")
     sub = p.add_subparsers(dest="command", required=True)
@@ -175,6 +269,27 @@ def main(argv=None):
     sp.set_defaults(fn=cmd_timeline)
     sp = sub.add_parser("microbenchmark")
     sp.set_defaults(fn=cmd_microbenchmark)
+    sp = sub.add_parser("start")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--num-cpus", type=float, default=None)
+    sp.add_argument("--num-tpus", type=float, default=None)
+    sp.add_argument("--min-workers", type=int, default=2)
+    sp.set_defaults(fn=cmd_start)
+    sp = sub.add_parser("stop")
+    sp.set_defaults(fn=cmd_stop)
+    sp = sub.add_parser("job")
+    sp.add_argument("--address", default=None)
+    jsub = sp.add_subparsers(dest="job_command", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("--working-dir", default=None)
+    js.add_argument("--wait", action="store_true")
+    js.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    for name in ("status", "logs", "stop"):
+        jp = jsub.add_parser(name)
+        jp.add_argument("submission_id")
+    jsub.add_parser("list")
+    sp.set_defaults(fn=cmd_job)
     args = p.parse_args(argv)
     args.fn(args)
 

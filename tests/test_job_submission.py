@@ -1,0 +1,57 @@
+"""Job submission: submit/status/logs/stop through the head JobManager.
+
+Mirrors /root/reference/python/ray/dashboard/modules/job/tests in shape.
+"""
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def client(ray_cluster):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    return JobSubmissionClient(ray_cluster.scheduler.socket_path)
+
+
+def test_job_lifecycle(client, tmp_path):
+    from ray_tpu.job_submission import JobStatus
+
+    script = tmp_path / "driver.py"
+    script.write_text(
+        "import ray_tpu\n"
+        "ray_tpu.init()\n"  # attaches via RAY_TPU_ADDRESS from the manager
+        "@ray_tpu.remote\n"
+        "def sq(x):\n"
+        "    return x * x\n"
+        "print('total:', sum(ray_tpu.get([sq.remote(i) for i in range(5)])))\n"
+        "ray_tpu.shutdown()\n")
+
+    sub_id = client.submit_job(
+        entrypoint="python driver.py",
+        runtime_env={"working_dir": str(tmp_path)})
+    status = client.wait_until_finished(sub_id, timeout=180)
+    logs = client.get_job_logs(sub_id)
+    assert status == JobStatus.SUCCEEDED, logs
+    assert "total: 30" in logs
+    assert any(j.submission_id == sub_id for j in client.list_jobs())
+
+
+def test_job_failure_reported(client):
+    from ray_tpu.job_submission import JobStatus
+
+    sub_id = client.submit_job(entrypoint="python -c 'raise SystemExit(3)'")
+    assert client.wait_until_finished(sub_id, timeout=60) == JobStatus.FAILED
+    assert "exit code 3" in client.get_job_info(sub_id).message
+
+
+def test_job_stop(client):
+    from ray_tpu.job_submission import JobStatus
+
+    sub_id = client.submit_job(entrypoint="sleep 120")
+    import time
+    deadline = time.monotonic() + 30
+    while (client.get_job_status(sub_id) == JobStatus.PENDING
+           and time.monotonic() < deadline):
+        time.sleep(0.1)
+    assert client.stop_job(sub_id)
+    assert client.wait_until_finished(sub_id, timeout=30) == JobStatus.STOPPED

@@ -1,0 +1,80 @@
+"""Runtime environments: env_vars, working_dir, py_modules, rejection of
+network installers. Mirrors /root/reference/python/ray/tests/test_runtime_env*.
+"""
+
+import os
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def cluster(ray_cluster):
+    return ray_cluster
+
+
+def test_env_vars_applied_and_cleared(cluster):
+    import ray_tpu
+
+    @ray_tpu.remote
+    def read_env(k):
+        return os.environ.get(k)
+
+    val = ray_tpu.get(read_env.options(
+        runtime_env={"env_vars": {"RTPU_TEST_VAR": "hello"}}
+    ).remote("RTPU_TEST_VAR"))
+    assert val == "hello"
+    # A later plain task on the pool must not see the leaked var.
+    assert ray_tpu.get(read_env.remote("RTPU_TEST_VAR")) is None
+
+
+def test_actor_env_persists(cluster):
+    import ray_tpu
+
+    @ray_tpu.remote
+    class EnvActor:
+        def read(self, k):
+            return os.environ.get(k)
+
+    a = EnvActor.options(
+        runtime_env={"env_vars": {"RTPU_ACTOR_VAR": "stays"}}).remote()
+    assert ray_tpu.get(a.read.remote("RTPU_ACTOR_VAR")) == "stays"
+    assert ray_tpu.get(a.read.remote("RTPU_ACTOR_VAR")) == "stays"
+    ray_tpu.kill(a)
+
+
+def test_working_dir_and_py_modules(cluster, tmp_path):
+    import ray_tpu
+
+    pkg = tmp_path / "mypkg"
+    pkg.mkdir()
+    (pkg / "mymod.py").write_text("MAGIC = 1234\n")
+    (pkg / "data.txt").write_text("payload\n")
+
+    @ray_tpu.remote
+    def use_working_dir():
+        import mymod
+        with open("data.txt") as f:
+            return mymod.MAGIC, f.read().strip()
+
+    magic, data = ray_tpu.get(use_working_dir.options(
+        runtime_env={"working_dir": str(pkg)}).remote())
+    assert magic == 1234 and data == "payload"
+
+    @ray_tpu.remote
+    def use_py_module():
+        import mymod
+        return mymod.MAGIC
+
+    assert ray_tpu.get(use_py_module.options(
+        runtime_env={"py_modules": [str(pkg)]}).remote()) == 1234
+
+
+def test_pip_rejected(cluster):
+    import ray_tpu
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="egress"):
+        f.options(runtime_env={"pip": ["requests"]}).remote()
