@@ -69,8 +69,11 @@ class Node:
         session_dir: Optional[str] = None,
         head: bool = True,
         gcs_address: Optional[str] = None,
+        include_dashboard: bool = True,
+        node_id: Optional[bytes] = None,
+        merge_default_resources: bool = True,
     ):
-        self.node_id = os.urandom(16)
+        self.node_id = node_id or os.urandom(16)
         self.is_head = head
         ts = time.strftime("%Y-%m-%d_%H-%M-%S")
         self.session_dir = session_dir or (
@@ -78,9 +81,15 @@ class Node:
         )
         os.makedirs(self.session_dir, exist_ok=True)
 
-        merged = default_resources()
-        if resources:
-            merged.update(resources)
+        if merge_default_resources:
+            merged = default_resources()
+            if resources:
+                merged.update(resources)
+        else:
+            # Exact mode (autoscaler-launched nodes): advertise PRECISELY
+            # the declared node-type shape so the scale-up planner's
+            # bin-packing matches what actually joins.
+            merged = dict(resources or {})
         self.resources = merged
 
         capacity = object_store_memory or _default_store_capacity()
@@ -126,6 +135,20 @@ class Node:
 
             self.scheduler.job_manager = JobManager(
                 self.gcs, self.gcs_address, self.session_dir)
+        self.dashboard = None
+        self.dashboard_url = None
+        if head and include_dashboard and not os.environ.get(
+                "RTPU_DISABLE_DASHBOARD"):
+            try:
+                from ray_tpu.dashboard import DashboardHead
+
+                self.dashboard = DashboardHead(self.gcs, sched_socket)
+                self.dashboard_url = self.dashboard.url
+                if self.dashboard_url:
+                    self.gcs.kv_put("dashboard", b"url",
+                                    self.dashboard_url.encode())
+            except Exception:
+                self.dashboard = None  # aiohttp missing / port exhaustion
 
     def new_store_client(self) -> StoreClient:
         return StoreClient(
@@ -138,6 +161,8 @@ class Node:
         jm = getattr(self.scheduler, "job_manager", None)
         if jm is not None:
             jm.shutdown()
+        if self.dashboard is not None:
+            self.dashboard.shutdown()
         if self.gcs_server is None:
             # Attached (non-head) node leaving gracefully: tell the GCS now
             # instead of making peers wait out the heartbeat timeout.

@@ -556,6 +556,11 @@ class Scheduler:
                 "num_idle": len([w for w in self._workers.values()
                                  if w.alive and w.idle]),
                 "pending_tasks": len(self._pending),
+                # per-pending-task resource asks (autoscaler demand signal;
+                # capped so a 1M-task backlog doesn't bloat the snapshot)
+                "pending_demand": [
+                    dict(s.resources or {}) for s in list(self._pending)[:512]
+                ],
                 "available_resources": dict(self.available),
                 "total_resources": dict(self.total_resources),
             }
@@ -733,6 +738,30 @@ class Scheduler:
             return True
         if method == "kv_keys":
             return self.gcs.kv_keys(params["namespace"])
+        if method == "metrics_push":
+            # Best-effort per-process app metrics (util/metrics.py flusher).
+            if not hasattr(self, "_app_metrics"):
+                self._app_metrics = {}
+            self._app_metrics[bytes(params["source"])] = params["metrics"]
+            return True
+        if method == "metrics_snapshot":
+            sources = dict(getattr(self, "_app_metrics", {}))
+            try:
+                store = self._store.stats()
+            except Exception:
+                store = {}
+            runtime = {
+                "node_id": self.node_id,
+                "tasks_pending": len(self._pending),
+                "workers": len([w for w in self._workers.values()
+                                if w.alive]),
+                "store_used_bytes": store.get("used_bytes", 0),
+                "store_num_objects": store.get("num_objects", 0),
+                "available": dict(self.available),
+                "resources": dict(self.total_resources),
+            }
+            return {"runtime": runtime,
+                    "app": list(sources.values())}
         if method == "shutdown_node":
             # `rtpu stop`: only standalone `rtpu start` processes opt in
             # (reference parity: `ray stop` kills only `ray start` nodes,
@@ -1146,6 +1175,11 @@ class Scheduler:
                 return
             worker.alive = False
             worker.idle = False
+            # Drop the process's last app-metrics snapshot: a dead source
+            # must not be scraped as live data (and the dict must not grow
+            # under worker churn).
+            if hasattr(self, "_app_metrics"):
+                self._app_metrics.pop(worker.worker_id, None)
             if _DEBUG_SCHED:
                 _dbg(f"worker DEATH {worker.worker_id.hex()[:8]} "
                      f"actor={worker.actor_id.hex()[:8] if worker.actor_id else None} "

@@ -1,0 +1,21 @@
+"""ray_tpu.autoscaler: demand-driven cluster scaling.
+
+Counterpart of /root/reference/python/ray/autoscaler/ (v2-shaped: a
+reconciler over a NodeProvider; the fake provider launches real local node
+processes for tests, reference fake_multi_node).
+"""
+
+from ray_tpu.autoscaler.autoscaler import (
+    AutoscalerConfig,
+    NodeTypeConfig,
+    StandardAutoscaler,
+)
+from ray_tpu.autoscaler.node_provider import FakeNodeProvider, NodeProvider
+
+__all__ = [
+    "AutoscalerConfig",
+    "FakeNodeProvider",
+    "NodeProvider",
+    "NodeTypeConfig",
+    "StandardAutoscaler",
+]

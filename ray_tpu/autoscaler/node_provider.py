@@ -1,0 +1,94 @@
+"""Node providers: pluggable machine lifecycle for the autoscaler.
+
+Counterpart of /root/reference/python/ray/autoscaler/node_provider.py (the
+NodeProvider plugin interface implemented by aws/gcp/azure/... providers)
+and the fake multi-node provider the reference uses to test autoscaling
+without a cloud (_private/fake_multi_node/node_provider.py). The TPU-native
+deployment target is a GKE/GCE provider requesting whole TPU slices; the
+interface keeps that shape: ``create_node(node_type)`` launches one machine
+of a configured type which self-joins the cluster via the head's GCS
+address.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional
+
+
+class NodeProvider:
+    """Implement create/terminate/list for one deployment substrate."""
+
+    def create_node(self, node_type: str, resources: Dict[str, float],
+                    node_id: bytes) -> None:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: bytes) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[bytes]:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class FakeNodeProvider(NodeProvider):
+    """Launches real worker-node PROCESSES on this machine (the reference's
+    fake_multi_node provider does the same with docker/processes): every
+    scaling decision exercises true node bootstrap, GCS join, scheduling
+    spillback, and node-death handling."""
+
+    def __init__(self, gcs_address: str):
+        self._gcs_address = gcs_address
+        self._procs: Dict[bytes, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+
+    def create_node(self, node_type: str, resources: Dict[str, float],
+                    node_id: bytes) -> None:
+        import json
+
+        args = [sys.executable, "-m", "ray_tpu.scripts.cli", "start",
+                "--address", self._gcs_address,
+                "--node-id", node_id.hex(), "--min-workers", "1",
+                "--resources", json.dumps(resources)]
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.Popen(
+            args, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL, start_new_session=True)
+        with self._lock:
+            self._procs[node_id] = proc
+
+    def terminate_node(self, node_id: bytes) -> None:
+        with self._lock:
+            proc = self._procs.pop(node_id, None)
+        if proc is not None and proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+
+    def non_terminated_nodes(self) -> List[bytes]:
+        with self._lock:
+            return [nid for nid, p in self._procs.items()
+                    if p.poll() is None]
+
+    def shutdown(self) -> None:
+        with self._lock:
+            ids = list(self._procs)
+        for nid in ids:
+            self.terminate_node(nid)

@@ -77,3 +77,32 @@ class Cluster:
         if self.head_node is not None:
             self.head_node.shutdown()
             self.head_node = None
+
+
+class AutoscalingCluster:
+    """A head node + a live autoscaler over the fake (local-process) node
+    provider — the reference's AutoscalingCluster
+    (/root/reference/python/ray/cluster_utils.py:26) run against its fake
+    multi-node provider, for autoscaling tests with no cloud."""
+
+    def __init__(self, head_resources: Optional[dict] = None,
+                 autoscaler_config=None, **node_args):
+        from ray_tpu.autoscaler import (
+            AutoscalerConfig,
+            FakeNodeProvider,
+            StandardAutoscaler,
+        )
+
+        self.cluster = Cluster(head_node_args={
+            "resources": head_resources, **node_args})
+        self.provider = FakeNodeProvider(self.cluster.gcs_address)
+        self.autoscaler = StandardAutoscaler(
+            self.cluster.head_node.gcs, self.provider,
+            autoscaler_config or AutoscalerConfig())
+
+    def start(self):
+        self.autoscaler.start()
+
+    def shutdown(self):
+        self.autoscaler.shutdown()
+        self.cluster.shutdown()

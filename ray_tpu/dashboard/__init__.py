@@ -1,0 +1,9 @@
+"""ray_tpu.dashboard: REST + Prometheus observability head.
+
+Counterpart of /root/reference/python/ray/dashboard/ (head process only;
+JSON API instead of the React SPA).
+"""
+
+from ray_tpu.dashboard.head import DashboardHead
+
+__all__ = ["DashboardHead"]

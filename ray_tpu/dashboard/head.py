@@ -1,0 +1,244 @@
+"""Dashboard head: REST + Prometheus over the state API.
+
+Counterpart of /root/reference/python/ray/dashboard/head.py:48 (aiohttp REST
+aggregating GCS + per-node sources) — without the React SPA: endpoints
+return JSON (the reference's own /api payloads are JSON too), plus a tiny
+HTML index for humans and a /metrics Prometheus scrape target that merges
+every node's runtime gauges with app metrics pushed from workers
+(ray_tpu.util.metrics).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Optional
+
+from ray_tpu._private import protocol
+
+_INDEX_HTML = """<!doctype html><title>ray_tpu dashboard</title>
+<h1>ray_tpu dashboard</h1>
+<ul>
+<li><a href="/api/nodes">/api/nodes</a></li>
+<li><a href="/api/actors">/api/actors</a></li>
+<li><a href="/api/placement_groups">/api/placement_groups</a></li>
+<li><a href="/api/jobs">/api/jobs</a></li>
+<li><a href="/api/tasks/summary">/api/tasks/summary</a></li>
+<li><a href="/api/cluster_status">/api/cluster_status</a></li>
+<li><a href="/metrics">/metrics (Prometheus)</a></li>
+</ul>"""
+
+
+def _node_rpc(sock: str, method: str, params: Optional[dict] = None):
+    conn = protocol.connect(sock)
+    try:
+        conn.send({"t": "rpc", "method": method, "params": params or {}})
+        resp = conn.recv()
+    finally:
+        conn.close()
+    if resp is None or not resp.get("ok"):
+        raise RuntimeError(f"dashboard rpc {method} failed")
+    return resp["result"]
+
+
+def _prom_escape(s: str) -> str:
+    return s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _render_prometheus(per_node: list[dict]) -> str:
+    lines: list[str] = []
+    # Node runtime gauges.
+    for snap in per_node:
+        rt = snap["runtime"]
+        node = rt["node_id"].hex()[:12]
+        for key in ("tasks_pending", "workers", "store_used_bytes",
+                    "store_num_objects"):
+            lines.append(
+                f'ray_tpu_node_{key}{{node_id="{node}"}} {rt[key]}')
+        for res, total in rt["resources"].items():
+            avail = rt["available"].get(res, 0)
+            rname = _prom_escape(str(res))
+            lines.append(
+                f'ray_tpu_resource_total{{node_id="{node}",'
+                f'resource="{rname}"}} {total}')
+            lines.append(
+                f'ray_tpu_resource_available{{node_id="{node}",'
+                f'resource="{rname}"}} {avail}')
+        # App metrics pushed by this node's processes.
+        for source in snap["app"]:
+            for m in source:
+                name = "ray_tpu_" + m["name"]
+                if m["kind"] == "histogram":
+                    for tagvals, h in m.get("hist", {}).items():
+                        labels = _labels(m["tag_keys"], tagvals)
+                        cum = 0
+                        for b, c in zip(m["boundaries"], h):
+                            cum += c
+                            lines.append(
+                                f'{name}_bucket{{{labels}le="{b}"}} {cum}')
+                        cum += h[len(m["boundaries"])]
+                        lines.append(
+                            f'{name}_bucket{{{labels}le="+Inf"}} {cum}')
+                        lines.append(f"{name}_count{{{labels[:-1]}}} {cum}"
+                                     if labels else f"{name}_count {cum}")
+                        lines.append(
+                            f"{name}_sum{{{labels[:-1]}}} {h[-1]}"
+                            if labels else f"{name}_sum {h[-1]}")
+                else:
+                    for tagvals, v in m.get("values", {}).items():
+                        labels = _labels(m["tag_keys"], tagvals)
+                        if labels:
+                            lines.append(f"{name}{{{labels[:-1]}}} {v}")
+                        else:
+                            lines.append(f"{name} {v}")
+    return "\n".join(lines) + "\n"
+
+
+def _labels(tag_keys, tagvals) -> str:
+    if not tag_keys:
+        return ""
+    pairs = ",".join(f'{k}="{_prom_escape(v)}"'
+                     for k, v in zip(tag_keys, tagvals))
+    return pairs + ","
+
+
+class DashboardHead:
+    """Serves on 127.0.0.1:<port> from a daemon thread with its own loop."""
+
+    def __init__(self, gcs, head_sched_socket: str, port: int = 0):
+        import aiohttp  # noqa: F401 — fail HERE, in the caller's thread
+
+        self._gcs = gcs
+        self._head_sock = head_sched_socket
+        self._port = port
+        self.url: Optional[str] = None
+        self._started = threading.Event()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread = threading.Thread(
+            target=self._run, name="dashboard-head", daemon=True)
+        self._thread.start()
+        self._started.wait(timeout=10)
+        if self.url is None:
+            raise RuntimeError("dashboard server failed to start")
+
+    # -- data sources ------------------------------------------------------
+    def _sched_socks(self) -> list[str]:
+        return [n.sched_socket for n in self._gcs.list_nodes() if n.alive]
+
+    def _nodes(self):
+        return [{
+            "node_id": n.node_id.hex(), "alive": n.alive,
+            "is_head": n.is_head, "resources": n.resources,
+            "available": getattr(n, "available", {}),
+        } for n in self._gcs.list_nodes()]
+
+    def _actors(self):
+        return [{
+            "actor_id": a.actor_id.hex(), "name": a.name,
+            "class_name": a.class_name, "state": a.state,
+            "node_id": a.node_id.hex() if a.node_id else None,
+            "num_restarts": a.num_restarts,
+        } for a in self._gcs.list_actors()]
+
+    def _pgs(self):
+        out = []
+        for pg_id, info in _node_rpc(self._head_sock, "pg_table").items():
+            row = {"placement_group_id": pg_id.hex(), **info}
+            if "assignment" in row:
+                row["assignment"] = [
+                    n.hex() if isinstance(n, bytes) else n
+                    for n in row["assignment"]]
+            out.append(row)
+        return out
+
+    def _jobs(self):
+        try:
+            return _node_rpc(self._head_sock, "job_list")
+        except Exception:
+            return []
+
+    def _task_summary(self):
+        from ray_tpu.util.state import summarize_events
+
+        events = []
+        for sock in self._sched_socks():
+            try:
+                events.extend(_node_rpc(sock, "list_task_events"))
+            except Exception:
+                continue
+        return summarize_events(events)
+
+    def _cluster_status(self):
+        return _node_rpc(self._head_sock, "cluster_state")
+
+    def _metrics_text(self):
+        snaps = []
+        for sock in self._sched_socks():
+            try:
+                snaps.append(_node_rpc(sock, "metrics_snapshot"))
+            except Exception:
+                continue
+        return _render_prometheus(snaps)
+
+    # -- server ------------------------------------------------------------
+    def _run(self):
+        from aiohttp import web
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+
+        def json_handler(fn):
+            async def handler(request):
+                data = await loop.run_in_executor(None, fn)
+                return web.Response(
+                    text=json.dumps(data, default=str),
+                    content_type="application/json")
+            return handler
+
+        async def index(request):
+            return web.Response(text=_INDEX_HTML, content_type="text/html")
+
+        async def metrics(request):
+            text = await loop.run_in_executor(None, self._metrics_text)
+            return web.Response(text=text, content_type="text/plain")
+
+        app = web.Application()
+        app.router.add_get("/", index)
+        app.router.add_get("/api/nodes", json_handler(self._nodes))
+        app.router.add_get("/api/actors", json_handler(self._actors))
+        app.router.add_get("/api/placement_groups", json_handler(self._pgs))
+        app.router.add_get("/api/jobs", json_handler(self._jobs))
+        app.router.add_get("/api/tasks/summary",
+                           json_handler(self._task_summary))
+        app.router.add_get("/api/cluster_status",
+                           json_handler(self._cluster_status))
+        app.router.add_get("/metrics", metrics)
+
+        async def start():
+            runner = web.AppRunner(app, access_log=None)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", self._port)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            self.url = f"http://127.0.0.1:{port}"
+            self._runner = runner
+            self._started.set()
+
+        try:
+            loop.run_until_complete(start())
+        except BaseException:
+            self._started.set()  # unblock __init__, which raises on url=None
+            loop.close()
+            return
+        try:
+            loop.run_forever()
+        finally:
+            loop.run_until_complete(self._runner.cleanup())
+            loop.close()
+
+    def shutdown(self):
+        if self._loop is not None and self._loop.is_running():
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)

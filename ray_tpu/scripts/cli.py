@@ -173,9 +173,23 @@ def cmd_start(args):
     import ray_tpu
 
     if args.head:
-        node = ray_tpu.init(
-            num_cpus=args.num_cpus, num_tpus=args.num_tpus,
-            min_workers=args.min_workers)
+        res = {}
+        if args.resources:
+            import json as _json
+
+            res.update({k: float(v)
+                        for k, v in _json.loads(args.resources).items()})
+        if args.num_cpus is not None:
+            res["CPU"] = float(args.num_cpus)
+        if args.num_tpus is not None:
+            res["TPU"] = float(args.num_tpus)
+        from ray_tpu._private.node import Node as _Node
+
+        head_node = _Node(
+            head=True, resources=res or None,
+            min_workers=args.min_workers,
+            node_id=(bytes.fromhex(args.node_id) if args.node_id else None))
+        node = ray_tpu.init(_existing_node=head_node)
         print(f"head node started\n  gcs address: {node.gcs_address}\n"
               f"  attach with: ray_tpu.init(address={node.gcs_address!r}) "
               f"or RAY_TPU_ADDRESS", flush=True)
@@ -188,12 +202,22 @@ def cmd_start(args):
 
             address = _find_gcs_address()
         res = {}
+        if args.resources:
+            import json as _json
+
+            res.update({k: float(v)
+                        for k, v in _json.loads(args.resources).items()})
         if args.num_cpus is not None:
             res["CPU"] = float(args.num_cpus)
         if args.num_tpus is not None:
             res["TPU"] = float(args.num_tpus)
         node = Node(head=False, gcs_address=address,
-                    resources=res or None, min_workers=args.min_workers)
+                    resources=res or None, min_workers=args.min_workers,
+                    node_id=(bytes.fromhex(args.node_id)
+                             if args.node_id else None),
+                    # --resources declares the node's EXACT shape (used by
+                    # the autoscaler so planned == actual)
+                    merge_default_resources=not args.resources)
         print(f"worker node {node.node_id.hex()[:8]} joined {address}",
               flush=True)
     node.scheduler.allow_external_shutdown = True  # `rtpu stop` may kill us
@@ -275,6 +299,10 @@ def main(argv=None):
     sp.add_argument("--num-cpus", type=float, default=None)
     sp.add_argument("--num-tpus", type=float, default=None)
     sp.add_argument("--min-workers", type=int, default=2)
+    sp.add_argument("--node-id", default=None,
+                    help="hex node id (autoscaler-assigned identity)")
+    sp.add_argument("--resources", default=None,
+                    help='JSON resource dict, e.g. \'{"AS_RES": 2.0}\'')
     sp.set_defaults(fn=cmd_start)
     sp = sub.add_parser("stop")
     sp.set_defaults(fn=cmd_stop)

@@ -1,0 +1,169 @@
+"""Application metrics: Counter / Gauge / Histogram.
+
+Counterpart of /root/reference/python/ray/util/metrics.py (Cython metric
+bindings over the C++ OpenCensus registry, exported through the node metrics
+agent to Prometheus). Here every process keeps a local registry; a
+background flusher pushes snapshots over the node scheduler's control
+socket ("metrics_push"), the scheduler aggregates per node, and the
+dashboard's /metrics endpoint renders the cluster-wide Prometheus text
+(ray_tpu.dashboard). Tag semantics match the reference: declared tag_keys,
+default tags, per-call overrides.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_FLUSH_INTERVAL_S = 2.0
+
+_registry_lock = threading.Lock()
+_registry: List["Metric"] = []
+_flusher_started = False
+
+
+def _ensure_flusher():
+    global _flusher_started
+    if _flusher_started:
+        return
+    _flusher_started = True
+
+    def flush_loop():
+        from ray_tpu._private import worker as worker_mod
+
+        while True:
+            time.sleep(_FLUSH_INTERVAL_S)
+            try:
+                ctx = worker_mod.global_worker()
+            except Exception:
+                continue  # not initialized (yet/anymore): keep waiting
+            if ctx is None:
+                continue
+            snap = snapshot()
+            if not snap:
+                continue
+            try:
+                ctx.rpc("metrics_push", {
+                    "source": ctx.worker_id or b"driver",
+                    "metrics": snap,
+                })
+            except Exception:
+                pass  # node shutting down; metrics are best-effort
+
+    threading.Thread(target=flush_loop, name="metrics-flush",
+                     daemon=True).start()
+
+
+def snapshot() -> List[dict]:
+    with _registry_lock:
+        metrics = list(_registry)
+    return [m._snapshot() for m in metrics]
+
+
+class Metric:
+    _kind = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name:
+            raise ValueError("metric name is required")
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _registry.append(self)
+        _ensure_flusher()
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        bad = set(tags) - set(self._tag_keys)
+        if bad:
+            raise ValueError(f"tags {sorted(bad)} not in declared tag_keys "
+                             f"{self._tag_keys}")
+        self._default_tags = dict(tags)
+        return self
+
+    def _tag_tuple(self, tags: Optional[Dict[str, str]]) -> Tuple[str, ...]:
+        merged = dict(self._default_tags)
+        if tags:
+            bad = set(tags) - set(self._tag_keys)
+            if bad:
+                raise ValueError(
+                    f"tags {sorted(bad)} not in declared tag_keys "
+                    f"{self._tag_keys}")
+            merged.update(tags)
+        return tuple(merged.get(k, "") for k in self._tag_keys)
+
+    def _snapshot(self) -> dict:
+        with self._lock:
+            values = dict(self._values)
+        return {"name": self._name, "kind": self._kind,
+                "description": self._description,
+                "tag_keys": self._tag_keys, "values": values}
+
+    @property
+    def info(self) -> dict:
+        return {"name": self._name, "description": self._description,
+                "tag_keys": self._tag_keys,
+                "default_tags": dict(self._default_tags)}
+
+
+class Counter(Metric):
+    _kind = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("counters only increase")
+        key = self._tag_tuple(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+
+class Gauge(Metric):
+    _kind = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = self._tag_tuple(tags)
+        with self._lock:
+            self._values[key] = float(value)
+
+
+DEFAULT_BOUNDARIES = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60)
+
+
+class Histogram(Metric):
+    _kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[Sequence[float]] = None,
+                 tag_keys: Optional[Sequence[str]] = None):
+        super().__init__(name, description, tag_keys)
+        self._boundaries = tuple(boundaries or DEFAULT_BOUNDARIES)
+        # per tag tuple: [bucket counts..., +inf count, sum]
+        self._hist: Dict[Tuple[str, ...], list] = {}
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = self._tag_tuple(tags)
+        with self._lock:
+            h = self._hist.get(key)
+            if h is None:
+                h = self._hist[key] = [0] * (len(self._boundaries) + 1) + [0.0]
+            for i, b in enumerate(self._boundaries):
+                if value <= b:
+                    h[i] += 1
+                    break
+            else:
+                h[len(self._boundaries)] += 1
+            h[-1] += value
+
+    def _snapshot(self) -> dict:
+        with self._lock:
+            hist = {k: list(v) for k, v in self._hist.items()}
+        return {"name": self._name, "kind": self._kind,
+                "description": self._description,
+                "tag_keys": self._tag_keys,
+                "boundaries": self._boundaries, "hist": hist}
