@@ -1,0 +1,96 @@
+"""Device objects: ObjectRefs whose payload stays resident on the producer.
+
+Counterpart of the reference's GPU objects / Ray Direct Transport
+(/root/reference/python/ray/_private/gpu_object_manager.py:16, hidden
+``__ray_send__``/``__ray_recv__`` actor methods :82,101): an actor method
+called with ``.options(tensor_transport="device")`` keeps its return value
+in the producing actor's process — for ``jax.Array``s that means the
+buffers never leave HBM — and seals only a small marker into the object
+store. A consumer that ``get``s the ref triggers a pull: a hidden
+``__rtpu_apply__`` task on the producer serializes the value through the
+shm store (host-staging tier), and the consumer's ``jax.device_put`` moves
+it onto its own device. On multi-chip deployments the intended fast path is
+in-program ICI (both actors enter one jitted program via the mesh layer);
+this host relay is the general-topology fallback, exactly the role NIXL
+plays in the reference.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict
+
+# Producer-side residency table, per worker process: oid -> value.
+_resident: Dict[bytes, Any] = {}
+_lock = threading.Lock()
+
+
+class DeviceObjectMarker:
+    """The store payload for a device-resident object."""
+
+    __slots__ = ("actor_id", "oid")
+
+    def __init__(self, actor_id: bytes, oid: bytes):
+        self.actor_id = actor_id
+        self.oid = oid
+
+    def __reduce__(self):
+        return (DeviceObjectMarker, (self.actor_id, self.oid))
+
+    def __repr__(self):
+        return (f"DeviceObjectMarker(actor={self.actor_id.hex()[:8]}, "
+                f"oid={self.oid.hex()[:8]})")
+
+
+def store_resident(oid: bytes, value: Any) -> None:
+    with _lock:
+        _resident[oid] = value
+
+
+def _fetch(_instance, oid: bytes):
+    """Hidden task run ON the producer: hand the value to the store path."""
+    with _lock:
+        try:
+            return _resident[oid]
+        except KeyError:
+            raise RuntimeError(
+                f"device object {oid.hex()[:12]} is no longer resident "
+                f"(freed or actor restarted)") from None
+
+
+def _free(_instance, oid: bytes) -> bool:
+    with _lock:
+        return _resident.pop(oid, None) is not None
+
+
+def free_resident_for_actor() -> None:
+    """Clear the table (actor teardown)."""
+    with _lock:
+        _resident.clear()
+
+
+def resolve_marker(marker: DeviceObjectMarker, timeout=None):
+    """Consumer side: pull the value from the producing actor."""
+    from ray_tpu import api
+    from ray_tpu.core.actor import ActorHandle
+
+    with _lock:
+        if marker.oid in _resident:  # consumer IS the producer: no RPC
+            return _resident[marker.oid]
+    handle = ActorHandle(marker.actor_id, "DeviceObjectOwner")
+    ref = handle.__rtpu_apply__.remote(_fetch, marker.oid)
+    return api.get(ref, timeout=timeout)
+
+
+def free_device_object(ref) -> bool:
+    """Release the producer-resident value for ``ref`` (HBM reclaim)."""
+    from ray_tpu import api
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.core.actor import ActorHandle
+
+    ctx = worker_mod.global_worker()
+    value = ctx.get_object_raw(ref)
+    if not isinstance(value, DeviceObjectMarker):
+        raise TypeError(f"{ref} is not a device object")
+    handle = ActorHandle(value.actor_id, "DeviceObjectOwner")
+    return api.get(handle.__rtpu_apply__.remote(_free, value.oid))

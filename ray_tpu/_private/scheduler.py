@@ -83,6 +83,10 @@ class TaskSpec:
     pg_id: Optional[bytes] = None
     pg_bundle: Optional[int] = None
     runtime_env: Optional[dict] = None
+    # "device": return value stays resident on the producing actor (HBM for
+    # jax.Arrays); the store gets a marker (reference: GPU objects / RDT,
+    # python/ray/_private/gpu_object_manager.py:16)
+    tensor_transport: Optional[str] = None
     # cluster scheduling (reference: hybrid policy spillback,
     # src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.cc, and
     # NodeAffinitySchedulingStrategy, util/scheduling_strategies.py:41)

@@ -99,6 +99,21 @@ class WorkerContext:
         return ObjectRef(oid)
 
     def get_object(self, ref: ObjectRef, timeout: Optional[float] = None):
+        start = time.monotonic()
+        value = self.get_object_raw(ref, timeout)
+        # Device-resident objects resolve transparently: pull from the
+        # producing actor (host-staged; _private/device_objects.py).
+        from ray_tpu._private.device_objects import (
+            DeviceObjectMarker,
+            resolve_marker,
+        )
+        if isinstance(value, DeviceObjectMarker):
+            remaining = (None if timeout is None
+                         else max(0.0, timeout - (time.monotonic() - start)))
+            return resolve_marker(value, timeout=remaining)
+        return value
+
+    def get_object_raw(self, ref: ObjectRef, timeout: Optional[float] = None):
         oid = ref.binary()
         try:
             return self._get_object_inner(ref, oid, timeout)

@@ -128,6 +128,26 @@ class WorkerRuntime:
         n = len(spec.return_ids)
         if n == 0:
             return
+        if spec.tensor_transport == "device" and spec.actor_id:
+            # Keep the value resident in this (producing) process — jax
+            # buffers stay in HBM — and seal only a marker per return.
+            from ray_tpu._private import device_objects
+
+            values = list(result) if n > 1 else [result]
+            if len(values) != n:
+                raise ValueError(
+                    f"task {spec.name} declared num_returns={n} but "
+                    f"returned {len(values)} values")
+            for oid, value in zip(spec.return_ids, values):
+                device_objects.store_resident(oid, value)
+                try:
+                    self.ctx.put_object(
+                        device_objects.DeviceObjectMarker(
+                            spec.actor_id, oid),
+                        oid=oid)
+                except FileExistsError:
+                    pass
+            return
         values = (list(result) if n > 1 else [result])
         if n > 1 and len(values) != n:
             raise ValueError(

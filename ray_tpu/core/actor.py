@@ -35,13 +35,21 @@ class ActorMethod:
         from ray_tpu.dag.dag_node import ClassMethodNode
         return ClassMethodNode(self._handle, self._method_name, args, kwargs)
 
-    def options(self, num_returns: int = 1, **_ignored):
+    def options(self, num_returns: int = 1,
+                tensor_transport: Optional[str] = None, **_ignored):
         handle, name = self._handle, self._method_name
+        if tensor_transport not in (None, "device", "object_store"):
+            raise ValueError(
+                f"tensor_transport must be 'device' or 'object_store', "
+                f"got {tensor_transport!r}")
 
         class _Bound:
             def remote(self, *args, **kwargs):
-                return handle._submit_method(name, args, kwargs,
-                                             num_returns=num_returns)
+                return handle._submit_method(
+                    name, args, kwargs, num_returns=num_returns,
+                    tensor_transport=(tensor_transport
+                                      if tensor_transport != "object_store"
+                                      else None))
 
         return _Bound()
 
@@ -62,7 +70,8 @@ class ActorHandle:
             raise AttributeError(item)
         return ActorMethod(self, item)
 
-    def _submit_method(self, method_name, args, kwargs, num_returns=1):
+    def _submit_method(self, method_name, args, kwargs, num_returns=1,
+                       tensor_transport=None):
         worker = global_worker()
         task_id = ids.new_task_id()
         return_ids = [ids.object_id_for_return(task_id, i)
@@ -76,6 +85,7 @@ class ActorHandle:
             actor_id=self._actor_id,
             method_name=method_name,
             name=f"{self._class_name}.{method_name}",
+            tensor_transport=tensor_transport,
         )
         worker.submit(spec)
         refs = [ObjectRef(oid) for oid in return_ids]

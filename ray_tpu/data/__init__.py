@@ -17,6 +17,16 @@ from ray_tpu.data.block import Block, BlockMetadata
 from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import Dataset, GroupedData, MaterializedDataset
 from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.preprocessor import (
+    Chain,
+    Concatenator,
+    LabelEncoder,
+    MinMaxScaler,
+    OneHotEncoder,
+    Preprocessor,
+    SimpleImputer,
+    StandardScaler,
+)
 
 
 def _read(name: str, tasks) -> Dataset:
@@ -98,11 +108,19 @@ def read_numpy(paths, *, override_num_blocks: Optional[int] = None) -> Dataset:
 __all__ = [
     "Block",
     "BlockMetadata",
+    "Chain",
+    "Concatenator",
     "DataContext",
     "DataIterator",
     "Dataset",
     "GroupedData",
+    "LabelEncoder",
     "MaterializedDataset",
+    "MinMaxScaler",
+    "OneHotEncoder",
+    "Preprocessor",
+    "SimpleImputer",
+    "StandardScaler",
     "from_arrow",
     "from_items",
     "from_numpy",

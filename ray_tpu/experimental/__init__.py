@@ -1,0 +1,12 @@
+"""ray_tpu.experimental: device objects (direct transport).
+
+Counterpart of /root/reference/python/ray/experimental/ (GPU objects /
+RDT surface).
+"""
+
+from ray_tpu._private.device_objects import (
+    DeviceObjectMarker,
+    free_device_object,
+)
+
+__all__ = ["DeviceObjectMarker", "free_device_object"]

@@ -23,7 +23,9 @@ class ByteTokenizer:
         return [self.bos_id] + ids if add_bos else ids
 
     def decode(self, ids: List[int]) -> str:
-        data = bytes(i - 3 for i in ids if i >= 3)
+        # Ids beyond byte range can appear when a model's vocab is padded
+        # past 259 (untrained or bucket-rounded vocab): skip, don't crash.
+        data = bytes(i - 3 for i in ids if 3 <= i < 259)
         return data.decode("utf-8", errors="replace")
 
     def apply_chat_template(self, messages: List[dict]) -> str:

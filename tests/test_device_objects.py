@@ -1,0 +1,92 @@
+"""Device objects: producer-resident values, transparent pull, free.
+
+Mirrors the reference's GPU-object tests
+(/root/reference/python/ray/tests/test_gpu_objects_*.py) in shape, with
+jax.Arrays standing where torch CUDA tensors do there.
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def cluster(ray_cluster):
+    return ray_cluster
+
+
+def _producer_cls():
+    import jax.numpy as jnp
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Producer:
+        def make(self, n):
+            # jax.Array: stays on this actor's device under "device"
+            # transport
+            return jnp.arange(n, dtype=jnp.float32)
+
+        def stats(self):
+            from ray_tpu._private.device_objects import _resident
+            return len(_resident)
+
+    return Producer
+
+
+def test_device_transport_roundtrip(cluster):
+    import ray_tpu
+
+    Producer = _producer_cls()
+    p = Producer.remote()
+    ref = p.make.options(tensor_transport="device").remote(8)
+    # The value was NOT serialized into the store; pulling resolves it.
+    out = ray_tpu.get(ref, timeout=60)
+    np.testing.assert_allclose(np.asarray(out), np.arange(8, dtype=np.float32))
+    # Producer still holds it resident; a second get pulls again.
+    out2 = ray_tpu.get(ref, timeout=60)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(out))
+    assert ray_tpu.get(p.stats.remote()) >= 1
+    ray_tpu.kill(p)
+
+
+def test_device_object_as_actor_arg(cluster):
+    import ray_tpu
+
+    Producer = _producer_cls()
+
+    @ray_tpu.remote
+    class Consumer:
+        def total(self, arr):
+            return float(np.asarray(arr).sum())
+
+    p, c = Producer.remote(), Consumer.remote()
+    ref = p.make.options(tensor_transport="device").remote(5)
+    # Passing the ref to another actor resolves through the pull path.
+    assert ray_tpu.get(c.total.remote(ref), timeout=60) == 10.0
+    ray_tpu.kill(p)
+    ray_tpu.kill(c)
+
+
+def test_free_device_object(cluster):
+    import ray_tpu
+    from ray_tpu.experimental import free_device_object
+
+    Producer = _producer_cls()
+    p = Producer.remote()
+    ref = p.make.options(tensor_transport="device").remote(4)
+    ray_tpu.get(ref, timeout=60)
+    assert free_device_object(ref) is True
+    with pytest.raises(Exception, match="no longer resident"):
+        ray_tpu.get(ref, timeout=60)
+    ray_tpu.kill(p)
+
+
+def test_object_store_transport_unchanged(cluster):
+    import ray_tpu
+
+    Producer = _producer_cls()
+    p = Producer.remote()
+    ref = p.make.options(tensor_transport="object_store").remote(3)
+    np.testing.assert_allclose(np.asarray(ray_tpu.get(ref)),
+                               [0.0, 1.0, 2.0])
+    ray_tpu.kill(p)
