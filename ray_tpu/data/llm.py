@@ -1,119 +1,10 @@
-"""Batch LLM inference as Dataset stages (``ray_tpu.data.llm``).
+"""``ray_tpu.data.llm``: the reference's ``ray.data.llm`` import path.
 
-Counterpart of the reference's Data LLM processor pipeline
-(/root/reference/python/ray/llm/_internal/batch/processor/: tokenize →
-(chat template) → engine stage → detokenize, each a Dataset UDF stage with
-actor pools). The engine stage is a class UDF — one continuous-batching
-``LLMEngine`` per actor, TPU-resident across batches — and rows flow
-through ``map_batches``, so the streaming executor overlaps tokenization,
-generation, and downstream stages.
+The implementation lives in ray_tpu.llm.batch (engine + stages are LLM
+concerns); this alias mirrors the reference's public module layout
+(/root/reference/python/ray/data/llm.py re-exporting _internal/batch).
 """
 
-from __future__ import annotations
+from ray_tpu.llm.batch import ProcessorConfig, build_llm_processor
 
-from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Optional
-
-import numpy as np
-
-from ray_tpu.llm.engine import EngineConfig, SamplingParams
-
-
-@dataclass
-class ProcessorConfig:
-    """Reference: batch/processor/vllm_engine_proc.py config shape."""
-
-    model_loader: Optional[Callable] = None  # () -> (params, LlamaConfig)
-    tokenizer: Optional[str] = None  # None/"byte" or HF name
-    engine_config: EngineConfig = field(default_factory=EngineConfig)
-    # concurrency = engine actors; each holds model weights on its device
-    concurrency: int = 1
-    batch_size: int = 16
-    apply_chat_template: bool = False
-    sampling: Dict[str, Any] = field(default_factory=dict)
-    # device ask per engine actor (1.0 = one TPU chip; 0 for CPU tests)
-    num_tpus: float = 0.0
-
-
-class _EngineStage:
-    """Class UDF: engine lives for the actor's lifetime."""
-
-    def __init__(self, config: ProcessorConfig):
-        from ray_tpu.llm.engine import LLMEngine
-        from ray_tpu.llm.tokenizer import get_tokenizer
-
-        params, model_cfg = config.model_loader()
-        self._tok = get_tokenizer(config.tokenizer)
-        self._engine = LLMEngine(params, model_cfg, config.engine_config)
-        self._engine.start()
-        self._config = config
-
-    def __call__(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
-        cfg = self._config
-        sp = SamplingParams(**cfg.sampling) if cfg.sampling else (
-            SamplingParams(max_tokens=32))
-        eos = getattr(self._tok, "eos_id", None)
-        if eos is not None:
-            sp = SamplingParams(
-                max_tokens=sp.max_tokens, temperature=sp.temperature,
-                top_p=sp.top_p, stop_token_ids=tuple(sp.stop_token_ids)
-                + (eos,), seed=sp.seed)
-        prompts = [str(p) for p in batch["prompt"].tolist()]
-        if cfg.apply_chat_template:
-            prompts = [self._tok.apply_chat_template(
-                [{"role": "user", "content": p}]) for p in prompts]
-        # Submit the whole batch; the engine's continuous batcher packs
-        # them into one decode schedule (no per-row serialization).
-        reqs = [self._engine.submit(self._tok.encode(p), sp)
-                for p in prompts]
-        token_lists = []
-        for req in reqs:
-            toks = []
-            while True:
-                item = req.out_queue.get(timeout=300)
-                if item is None:
-                    break
-                if isinstance(item, Exception):
-                    raise item
-                toks.append(item)
-            token_lists.append(toks)
-        out = dict(batch)
-        out["generated_tokens"] = np.array(
-            [np.array(t, dtype=np.int64) for t in token_lists],
-            dtype=object)
-        out["generated_text"] = np.array(
-            [self._tok.decode(list(t)) for t in token_lists], dtype=object)
-        return out
-
-
-def build_llm_processor(
-    config: ProcessorConfig,
-    preprocess: Optional[Callable] = None,
-    postprocess: Optional[Callable] = None,
-) -> Callable:
-    """Return ``process(ds) -> ds`` appending the LLM stages.
-
-    ``preprocess``/``postprocess`` are row-wise hooks, as in the reference
-    (build_llm_processor in batch/processor/__init__.py): preprocess maps a
-    row to one with a "prompt" column; postprocess consumes
-    "generated_text"/"generated_tokens".
-    """
-    if config.model_loader is None:
-        raise ValueError("ProcessorConfig.model_loader is required")
-
-    def process(ds):
-        if preprocess is not None:
-            ds = ds.map(preprocess)
-        ds = ds.map_batches(
-            _EngineStage,
-            fn_constructor_args=(config,),
-            batch_size=config.batch_size,
-            batch_format="numpy",
-            concurrency=config.concurrency,
-            num_tpus=config.num_tpus or None,
-        )
-        if postprocess is not None:
-            ds = ds.map(postprocess)
-        return ds
-
-    return process
+__all__ = ["ProcessorConfig", "build_llm_processor"]

@@ -11,6 +11,12 @@ served OpenAI-compatibly on ray_tpu.serve (server.py) and over Datasets
 from ray_tpu.llm.batch import ProcessorConfig, build_llm_processor
 from ray_tpu.llm.engine import EngineConfig, LLMEngine, SamplingParams
 from ray_tpu.llm.paged_cache import CacheConfig, PageAllocator
+from ray_tpu.llm.pd_disagg import (
+    DecodeServer,
+    PDRouter,
+    PrefillServer,
+    build_pd_openai_app,
+)
 from ray_tpu.llm.server import LLMConfig, LLMServer, build_openai_app
 from ray_tpu.llm.tokenizer import ByteTokenizer, get_tokenizer
 
@@ -24,7 +30,11 @@ __all__ = [
     "PageAllocator",
     "ProcessorConfig",
     "SamplingParams",
+    "DecodeServer",
+    "PDRouter",
+    "PrefillServer",
     "build_llm_processor",
     "build_openai_app",
+    "build_pd_openai_app",
     "get_tokenizer",
 ]

@@ -26,6 +26,9 @@ class ProcessorConfig:
     batch_size: int = 16
     sampling: Dict[str, Any] = field(default_factory=dict)
     num_tpus: Optional[float] = None
+    # wrap each prompt in the tokenizer's chat template (reference:
+    # batch/stages/chat_template_stage.py)
+    apply_chat_template: bool = False
 
 
 class _EngineUDF:
@@ -38,18 +41,27 @@ class _EngineUDF:
         self._engine = LLMEngine(params, model_cfg, config.engine_config)
         self._engine.start()
         self._sampling = config.sampling
+        self._config = config
 
     def __call__(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        import numpy as np
+
         prompts = [str(p) for p in batch["prompt"]]
+        if self._config.apply_chat_template:
+            prompts = [self._tok.apply_chat_template(
+                [{"role": "user", "content": p}]) for p in prompts]
         reqs = []
         eos = getattr(self._tok, "eos_id", None)
         sp = dict(self._sampling)
         if eos is not None:
-            sp.setdefault("stop_token_ids", (eos,))
+            # ALWAYS stop at eos, including when the user supplied extra
+            # stop ids — matching serve-side behavior (server.py)
+            sp["stop_token_ids"] = tuple(
+                sp.get("stop_token_ids", ())) + (eos,)
         for p in prompts:
             reqs.append(self._engine.submit(
                 self._tok.encode(p), SamplingParams(**sp)))
-        outs = []
+        texts, token_lists = [], []
         for r in reqs:
             toks = []
             while True:
@@ -59,9 +71,12 @@ class _EngineUDF:
                 if isinstance(item, Exception):
                     raise item
                 toks.append(item)
-            outs.append(self._tok.decode(toks))
+            token_lists.append(toks)
+            texts.append(self._tok.decode(toks))
         out_batch = dict(batch)
-        out_batch["generated_text"] = outs
+        out_batch["generated_text"] = texts
+        out_batch["generated_tokens"] = np.array(
+            [np.asarray(t, np.int64) for t in token_lists], dtype=object)
         return out_batch
 
 
@@ -73,7 +88,8 @@ def build_llm_processor(config: ProcessorConfig,
 
     def processor(ds):
         if preprocess is not None:
-            ds = ds.map_batches(preprocess)
+            # row-wise hook, as in the reference's build_llm_processor
+            ds = ds.map(preprocess)
         ds = ds.map_batches(
             _EngineUDF,
             fn_constructor_args=(config,),
@@ -82,7 +98,7 @@ def build_llm_processor(config: ProcessorConfig,
             num_tpus=config.num_tpus,
             batch_format="numpy")
         if postprocess is not None:
-            ds = ds.map_batches(postprocess)
+            ds = ds.map(postprocess)
         return ds
 
     return processor

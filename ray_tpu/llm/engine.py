@@ -60,6 +60,12 @@ class _Request:
     params: SamplingParams
     out_queue: queue_mod.Queue = field(default_factory=queue_mod.Queue)
     submitted_at: float = field(default_factory=time.monotonic)
+    # P/D disaggregation (reference: serve prefill_decode_disagg.py):
+    # "normal" | "prefill_only" (run prefill, ship KV pages + first token)
+    # | "decode_kv" (inject shipped KV, skip prefill compute entirely)
+    kind: str = "normal"
+    first_token: Optional[int] = None  # decode_kv: token prefill sampled
+    kv: Optional[tuple] = None  # decode_kv: (kv_k, kv_v) page arrays
 
 
 @dataclass
@@ -134,6 +140,54 @@ class LLMEngine:
         self._waiting.put(req)
         return req
 
+    def prefill_extract(self, prompt_tokens: List[int],
+                        params: Optional[SamplingParams] = None,
+                        timeout_s: float = 300.0):
+        """P/D disaggregation, prefill side: run ONLY the prefill, sample
+        the first token, and return (first_token, kv_k, kv_v, n_tokens) —
+        the KV page arrays a decode engine injects via submit_with_kv.
+        Pages are freed here immediately; this engine keeps no state."""
+        self.start()
+        params = params or SamplingParams()
+        req = _Request(request_id=uuid.uuid4().hex[:12],
+                       prompt_tokens=list(prompt_tokens), params=params,
+                       kind="prefill_only")
+        n_pages = -(-len(prompt_tokens) // self.cfg.page_size)
+        if n_pages > self.cfg.num_pages - 1:
+            raise ValueError(f"prompt needs {n_pages} KV pages > capacity")
+        self._waiting.put(req)
+        item = req.out_queue.get(timeout=timeout_s)
+        if isinstance(item, Exception):
+            raise item
+        tag, first, kv_k, kv_v = item
+        assert tag == "prefill_done"
+        req.out_queue.get(timeout=timeout_s)  # drain the None terminator
+        return first, kv_k, kv_v, len(prompt_tokens)
+
+    def submit_with_kv(self, prompt_tokens: List[int], first_token: int,
+                       kv_k, kv_v,
+                       params: Optional[SamplingParams] = None) -> _Request:
+        """P/D disaggregation, decode side: admit a sequence whose prompt
+        KV was computed elsewhere. No prefill compute happens here."""
+        self.start()
+        params = params or SamplingParams()
+        total = len(prompt_tokens) + params.max_tokens
+        if total > self.cfg.max_seq_len:
+            raise ValueError(f"prompt+max_tokens {total} > max_seq_len")
+        n_pages = -(-total // self.cfg.page_size)
+        if n_pages > self.cfg.num_pages - 1:
+            # same guard as submit(): an infeasible request would sit at
+            # the queue head forever, wedging the engine
+            raise ValueError(
+                f"request needs {n_pages} KV pages but the cache has only "
+                f"{self.cfg.num_pages - 1} allocatable pages")
+        req = _Request(request_id=uuid.uuid4().hex[:12],
+                       prompt_tokens=list(prompt_tokens), params=params,
+                       kind="decode_kv", first_token=int(first_token),
+                       kv=(kv_k, kv_v))
+        self._waiting.put(req)
+        return req
+
     def generate(self, prompt_tokens: List[int],
                  params: Optional[SamplingParams] = None,
                  timeout_s: float = 300.0) -> List[int]:
@@ -173,14 +227,41 @@ class LLMEngine:
         (vLLM analogue: Scheduler admitting to the running batch)."""
         admitted = False
         while True:
-            free_slot = next((i for i, s in enumerate(self._slots)
-                              if s is None), None)
-            if free_slot is None:
-                return admitted
             try:
                 req = self._waiting.get_nowait()
             except queue_mod.Empty:
                 return admitted
+            # prefill_only completes inline and occupies no decode slot, so
+            # it is admitted even with all slots busy (only pages gate it)
+            if req.kind != "prefill_only":
+                free_slot = next((i for i, s in enumerate(self._slots)
+                                  if s is None), None)
+                if free_slot is None:
+                    self._waiting.queue.appendleft(req)  # type: ignore[attr-defined]
+                    return admitted
+            if req.kind == "prefill_only":
+                # KV only lives for the prefill: compute, extract, free.
+                n_pages = -(-len(req.prompt_tokens) // self.cfg.page_size)
+                if not self.allocator.can_allocate(n_pages):
+                    self._waiting.queue.appendleft(req)  # type: ignore[attr-defined]
+                    return admitted
+                pages = self.allocator.allocate(n_pages)
+                rng = (np.random.default_rng(req.params.seed)
+                       if req.params.temperature > 0 else None)
+                try:
+                    last = self._prefill(req, pages, rng)
+                    idx = np.asarray(pages)
+                    kv_k = np.asarray(self.cache_k[:, idx])
+                    kv_v = np.asarray(self.cache_v[:, idx])
+                    req.out_queue.put(("prefill_done", last, kv_k, kv_v))
+                    req.out_queue.put(None)
+                except Exception as e:  # noqa: BLE001
+                    req.out_queue.put(e)
+                    req.out_queue.put(None)
+                finally:
+                    self.allocator.free(pages)
+                admitted = True
+                continue
             n_pages = -(-(len(req.prompt_tokens) + req.params.max_tokens)
                         // self.cfg.page_size)
             if not self.allocator.can_allocate(n_pages):
@@ -191,7 +272,19 @@ class LLMEngine:
             rng = (np.random.default_rng(req.params.seed)
                    if req.params.temperature > 0 else None)
             try:
-                last = self._prefill(req, pages, rng)
+                if req.kind == "decode_kv":
+                    # Inject the shipped KV pages; skip prefill compute.
+                    kv_k, kv_v = req.kv
+                    req.kv = None  # free the host copy promptly
+                    src = kv_k.shape[1]
+                    idx = jnp.asarray(np.asarray(pages[:src]))
+                    self.cache_k = self.cache_k.at[:, idx].set(
+                        jnp.asarray(kv_k, self.cache_k.dtype))
+                    self.cache_v = self.cache_v.at[:, idx].set(
+                        jnp.asarray(kv_v, self.cache_v.dtype))
+                    last = int(req.first_token)
+                else:
+                    last = self._prefill(req, pages, rng)
             except Exception as e:  # noqa: BLE001 — surface to caller
                 self.allocator.free(pages)
                 req.out_queue.put(e)
@@ -205,7 +298,12 @@ class LLMEngine:
                 self.allocator.free(pages)
             else:
                 slot.generated.append(last)
-                self._emit(slot, last)
+                if req.kind == "decode_kv":
+                    # the prefill engine already delivered this token to
+                    # the caller; count it, don't re-emit
+                    self._stats["tokens_generated"] += 1
+                else:
+                    self._emit(slot, last)
                 if len(slot.generated) >= req.params.max_tokens:
                     req.out_queue.put(None)
                     self.allocator.free(pages)

@@ -1,18 +1,25 @@
 """ray_tpu.rllib: reinforcement learning on the core runtime.
 
 Counterpart of RLlib (/root/reference/rllib/), minimum viable slice per
-SURVEY.md §7 step 9: PPO with env-runner sampling actors and a jitted
-JAX learner (module.py RLModule, env_runner.py, ppo.py).
+SURVEY.md §7 step 9: PPO + DQN with env-runner sampling actors,
+replay buffers, and jitted JAX learners (module.py, env_runner.py, ppo.py,
+dqn.py, replay_buffers.py).
 """
 
+from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.module import MLPConfig, forward, greedy_action, init_mlp
 from ray_tpu.rllib.ppo import PPO, PPOConfig, compute_gae
+from ray_tpu.rllib.replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
 
 __all__ = [
+    "DQN",
+    "DQNConfig",
     "EnvRunner",
     "MLPConfig",
     "PPO",
+    "PrioritizedReplayBuffer",
+    "ReplayBuffer",
     "PPOConfig",
     "compute_gae",
     "forward",

@@ -106,6 +106,49 @@ class EnvRunner:
             "last_obs": last_obs,               # [n, obs_dim]
         }
 
+    def sample_transitions(self, params, num_steps: int,
+                           epsilon: float = 0.0) -> Dict[str, np.ndarray]:
+        """Off-policy collection (DQN): epsilon-greedy over Q = logits head.
+
+        Returns flat transition tuples ({obs, actions, rewards, next_obs,
+        dones}, each [num_steps * n_envs, ...]) ready for a replay buffer.
+        """
+        n = len(self._envs)
+        rng = np.random.default_rng(self._seed * 77003 + self._steps)
+        obs_b, act_b, rew_b, nobs_b, done_b = [], [], [], [], []
+        for _ in range(num_steps):
+            obs = np.stack(self._obs).astype(np.float32)
+            q, _ = module_mod.forward(params, obs)
+            action = np.asarray(np.argmax(np.asarray(q), axis=-1))
+            explore = rng.random(n) < epsilon
+            action = np.where(
+                explore, rng.integers(0, q.shape[-1], size=n), action)
+            for i, env in enumerate(self._envs):
+                nobs, r, term, trunc, _ = env.step(int(action[i]))
+                self._ep_return[i] += float(r)
+                self._ep_len[i] += 1
+                obs_b.append(obs[i])
+                act_b.append(int(action[i]))
+                rew_b.append(float(r))
+                # time-limit truncation is NOT an absorbing state: done=0
+                # so the target bootstraps from next_obs
+                done_b.append(bool(term))
+                nobs_b.append(np.asarray(nobs, np.float32))
+                if term or trunc:
+                    self._completed_returns.append(self._ep_return[i])
+                    self._completed_lens.append(self._ep_len[i])
+                    self._ep_return[i], self._ep_len[i] = 0.0, 0
+                    nobs, _ = env.reset()
+                self._obs[i] = nobs
+            self._steps += 1
+        return {
+            "obs": np.stack(obs_b).astype(np.float32),
+            "actions": np.asarray(act_b, np.int32),
+            "rewards": np.asarray(rew_b, np.float32),
+            "next_obs": np.stack(nobs_b).astype(np.float32),
+            "dones": np.asarray(done_b, np.float32),
+        }
+
     def get_metrics(self) -> Dict[str, Any]:
         out = {"episode_returns": list(self._completed_returns),
                "episode_lens": list(self._completed_lens)}

@@ -18,6 +18,7 @@ from ray_tpu.serve.api import (
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.deployment import Application, Deployment, deployment
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
     "Application",
@@ -29,6 +30,8 @@ __all__ = [
     "delete",
     "deployment",
     "get_app_handle",
+    "get_multiplexed_model_id",
+    "multiplexed",
     "http_port",
     "run",
     "shutdown",

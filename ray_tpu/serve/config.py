@@ -18,6 +18,11 @@ class AutoscalingConfig:
     target_ongoing_requests: float = 2.0
     upscale_delay_s: float = 0.0
     downscale_delay_s: float = 5.0
+    # scaling decisions use the PEAK load over this window, not the
+    # instantaneous sample (reference: autoscaling_policy look_back_period_s,
+    # default 30s): a burst shorter than replica startup must not flap the
+    # target back down before the new replicas ever serve
+    look_back_period_s: float = 30.0
 
 
 @dataclass

@@ -24,6 +24,7 @@ from __future__ import annotations
 import threading
 import time
 import traceback
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -57,6 +58,8 @@ class _DeploymentState:
     under_since: Optional[float] = None
     last_probe: float = 0.0
     last_loads: List[int] = field(default_factory=list)
+    # (ts, total_load) samples for look-back smoothing
+    load_history: Any = field(default_factory=deque)
     # scale-from-zero: handles report queued requests when no replicas
     pending_reports: float = 0.0
     pending_ts: float = 0.0
@@ -409,7 +412,7 @@ class ServeController:
         with self._lock:
             replicas = list(ds.replicas)
         if replicas:
-            refs = [r.queue_len.remote() for r in replicas]
+            refs = [r.drain_peak_load.remote() for r in replicas]
             ready, not_ready = ray_tpu.wait(
                 refs, num_returns=len(refs), timeout=2.0)
             loads = []
@@ -427,6 +430,14 @@ class ServeController:
         # scale-from-zero pressure from handles (expires after 5s)
         if ds.pending_reports and now - ds.pending_ts < 5.0:
             total += ds.pending_reports
+        # look-back smoothing: decide on the window PEAK so bursts shorter
+        # than replica startup keep the target up until they're truly over
+        look_back = getattr(ac, "look_back_period_s", 30.0)
+        ds.load_history.append((now, total))
+        while (ds.load_history
+               and now - ds.load_history[0][0] > look_back):
+            ds.load_history.popleft()
+        total = max(t for _, t in ds.load_history)
         desired = max(
             ac.min_replicas,
             min(ac.max_replicas,

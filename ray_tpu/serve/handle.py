@@ -126,9 +126,13 @@ class DeploymentHandle:
 
     # -- routing ----------------------------------------------------------
 
-    def _choose(self):
+    def _choose(self, hint: Optional[str] = None):
         """Power-of-two-choices on this handle's per-replica in-flight count
-        (reference: pow_2_router.py choose_replicas)."""
+        (reference: pow_2_router.py choose_replicas). With a ``hint``
+        (prompt prefix / multiplexed model id), route consistently to the
+        hint's home replica for cache locality — the reference's
+        prefix-aware / multiplex routers (prefix_aware_router.py:255) —
+        escaping to pow-2 only when that replica is clearly overloaded."""
         with self._lock:
             reps = list(self._replicas)
         if not reps:
@@ -136,18 +140,31 @@ class DeploymentHandle:
                 f"deployment {self.deployment_name} has no running replicas")
         if len(reps) == 1:
             return reps[0]
+        if hint is not None:
+            import zlib
+
+            ordered = sorted(reps, key=lambda r: r.actor_id)
+            # crc32, not hash(): built-in str hashing is salted per process,
+            # which would give each router its own home mapping
+            home = ordered[zlib.crc32(hint.encode()) % len(ordered)]
+            with self._lock:
+                loads = [self._inflight[r.actor_id] for r in reps]
+                # stay home unless clearly hotter than the coolest replica
+                if self._inflight[home.actor_id] <= min(loads) + 4:
+                    return home
         a, b = random.sample(reps, 2)
         with self._lock:
             return a if (self._inflight[a.actor_id]
                          <= self._inflight[b.actor_id]) else b
 
-    def _call(self, method: str, args, kwargs) -> DeploymentResponse:
+    def _call(self, method: str, args, kwargs,
+              hint: Optional[str] = None) -> DeploymentResponse:
         deadline = time.monotonic() + 30.0
         reported = False
         while True:
             self._refresh()
             try:
-                replica = self._choose()
+                replica = self._choose(hint)
                 break
             except RuntimeError:
                 if time.monotonic() > deadline:
@@ -212,7 +229,11 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         return self._call("__call__", args, kwargs)
 
-    def options(self, **_ignored) -> "DeploymentHandle":
+    def options(self, *, routing_hint: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None,
+                **_ignored) -> "DeploymentHandle":
+        if routing_hint is not None or multiplexed_model_id is not None:
+            return _HintedHandle(self, routing_hint, multiplexed_model_id)
         return self
 
     def __getattr__(self, name: str):
@@ -226,3 +247,36 @@ class DeploymentHandle:
     def __repr__(self):
         return (f"DeploymentHandle(app={self.app_name!r}, "
                 f"deployment={self.deployment_name!r})")
+
+
+class _HintedHandle:
+    """handle.options(routing_hint=... / multiplexed_model_id=...): same
+    call surface, affinity routing; model id travels to the replica so
+    serve.get_multiplexed_model_id() sees it (reference: multiplexed
+    model routing, serve/_private/replica.py request context)."""
+
+    def __init__(self, base: DeploymentHandle, hint: Optional[str],
+                 model_id: Optional[str]):
+        self._base = base
+        self._hint = hint if hint is not None else model_id
+        self._model_id = model_id
+
+    def _call(self, method: str, args, kwargs) -> DeploymentResponse:
+        if self._model_id is not None:
+            kwargs = dict(kwargs)
+            kwargs["__multiplexed_model_id"] = self._model_id
+        return self._base._call(method, args, kwargs, hint=self._hint)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._call("__call__", args, kwargs)
+
+    def options(self, **kw):
+        merged = {"routing_hint": self._hint,
+                  "multiplexed_model_id": self._model_id}
+        merged.update(kw)
+        return self._base.options(**merged)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _MethodCaller(self, name)

@@ -43,6 +43,7 @@ class ReplicaActor:
         self._ongoing = 0
         self._lock = threading.Lock()
         self._total = 0
+        self._peak = 0
         if user_config is not None:
             self.reconfigure(user_config)
 
@@ -59,6 +60,12 @@ class ReplicaActor:
         with self._lock:
             self._ongoing += 1
             self._total += 1
+            # peak since the last autoscaler probe: bursts shorter than the
+            # probe period must still register as load (reference:
+            # autoscaling averages over look_back_period_s for the same
+            # reason — instantaneous samples miss bursts)
+            self._peak = max(self._peak, self._ongoing)
+        model_id_token = None
         try:
             # Resolve forwarded DeploymentResponse refs (composition
             # chaining): they arrive nested inside the args tuple, below
@@ -67,6 +74,11 @@ class ReplicaActor:
                          for a in args)
             kwargs = {k: ray_tpu.get(v) if isinstance(v, ObjectRef) else v
                       for k, v in kwargs.items()}
+            model_id = kwargs.pop("__multiplexed_model_id", None)
+            if model_id is not None:
+                from ray_tpu.serve import multiplex
+
+                model_id_token = multiplex._current_model_id.set(model_id)
             target = (self._user if method == "__call__"
                       else getattr(self._user, method))
             if method == "__call__" and not callable(self._user):
@@ -80,11 +92,23 @@ class ReplicaActor:
                 out = asyncio.run(out)
             return out
         finally:
+            if model_id_token is not None:
+                from ray_tpu.serve import multiplex
+
+                multiplex._current_model_id.reset(model_id_token)
             with self._lock:
                 self._ongoing -= 1
 
     def queue_len(self) -> int:
         return self._ongoing
+
+    def drain_peak_load(self) -> int:
+        """Autoscaler probe: max ongoing since the last probe (and now),
+        reset on read."""
+        with self._lock:
+            peak = max(self._peak, self._ongoing)
+            self._peak = self._ongoing
+        return peak
 
     def stats(self) -> Dict[str, Any]:
         return {"ongoing": self._ongoing, "total": self._total}

@@ -13,6 +13,7 @@ from ray_tpu.tune.schedulers import (
     PopulationBasedTraining,
     TrialScheduler,
 )
+from ray_tpu.tune.searchers import OptunaSearch, TPESearcher
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
     Searcher,
@@ -26,6 +27,8 @@ from ray_tpu.tune.search import (
 from ray_tpu.tune.tuner import Result, ResultGrid, TuneConfig, Tuner
 
 __all__ = [
+    "OptunaSearch",
+    "TPESearcher",
     "ASHAScheduler",
     "BasicVariantGenerator",
     "FIFOScheduler",

@@ -1,0 +1,206 @@
+"""Adaptive searchers: TPE (native) + gated external backends.
+
+Counterpart of /root/reference/python/ray/tune/search/ (optuna/, hyperopt/,
+bayesopt/, ...). The native default is a Tree-structured Parzen Estimator —
+the algorithm behind Optuna's and HyperOpt's defaults — implemented on
+numpy alone so the air-gapped TPU image needs no extra packages. External
+libraries plug in through the same Searcher ABC (search.py) and are
+import-gated with a clear error, like the reference's
+`pip install optuna` guidance.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.tune.search import (
+    Choice,
+    Domain,
+    GridSearch,
+    LogUniform,
+    QUniform,
+    RandInt,
+    Searcher,
+    Uniform,
+)
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator (Bergstra et al., NeurIPS 2011).
+
+    After ``n_initial_points`` random trials, each numeric dimension is
+    split into "good" (top gamma quantile) and "bad" observations; we draw
+    ``n_candidates`` samples from a KDE over the good set and keep the one
+    maximizing l(x)/g(x). Categorical dims use smoothed category counts.
+    """
+
+    def __init__(self, metric: str, mode: str = "max",
+                 n_initial_points: int = 5, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be 'min' or 'max'")
+        self.metric = metric
+        self.mode = mode
+        self.n_initial = n_initial_points
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self._rng = random.Random(seed)
+        self._np_rng = np.random.default_rng(seed)
+        self._space: Dict[str, Any] = {}
+        self._observed: List[tuple[Dict[str, Any], float]] = []
+        self._inflight: Dict[str, Dict[str, Any]] = {}
+
+    def set_search_space(self, param_space: Dict[str, Any]) -> "TPESearcher":
+        for k, v in param_space.items():
+            if isinstance(v, GridSearch):
+                raise ValueError(
+                    "grid_search dimensions belong to BasicVariantGenerator; "
+                    "use choice() with TPESearcher")
+            self._space[k] = v
+        return self
+
+    # -- Searcher ABC ------------------------------------------------------
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if not self._space:
+            raise RuntimeError("call set_search_space(param_space) first")
+        if len(self._observed) < self.n_initial:
+            cfg = {k: (v.sample(self._rng) if isinstance(v, Domain) else v)
+                   for k, v in self._space.items()}
+        else:
+            cfg = {k: (self._suggest_dim(k, v)
+                       if isinstance(v, Domain) else v)
+                   for k, v in self._space.items()}
+        self._inflight[trial_id] = cfg
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict],
+                          error: bool = False) -> None:
+        cfg = self._inflight.pop(trial_id, None)
+        if cfg is None or error or not result or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "min":
+            score = -score
+        self._observed.append((cfg, score))
+
+    # -- TPE internals -----------------------------------------------------
+    def _split(self):
+        ranked = sorted(self._observed, key=lambda t: -t[1])
+        n_good = max(1, int(math.ceil(self.gamma * len(ranked))))
+        return ranked[:n_good], ranked[n_good:]
+
+    def _suggest_dim(self, name: str, dom: Domain) -> Any:
+        good, bad = self._split()
+        if isinstance(dom, Choice):
+            return self._categorical(name, dom.options, good, bad)
+        if isinstance(dom, (Uniform, LogUniform, QUniform, RandInt)):
+            return self._numeric(name, dom, good, bad)
+        return dom.sample(self._rng)
+
+    def _categorical(self, name, options, good, bad):
+        def weights(obs):
+            counts = np.ones(len(options))  # +1 smoothing
+            index = {o: i for i, o in enumerate(options)}
+            for cfg, _ in obs:
+                i = index.get(cfg.get(name))
+                if i is not None:
+                    counts[i] += 1
+            return counts / counts.sum()
+
+        lw, gw = weights(good), weights(bad)
+        score = lw / gw
+        return options[int(np.argmax(score))]
+
+    def _numeric(self, name, dom, good, bad):
+        log = isinstance(dom, LogUniform)
+        lo, hi = float(dom.low), float(dom.high)
+        if log:
+            lo, hi = math.log(lo), math.log(hi)
+
+        def xs_of(obs):
+            vals = [float(cfg[name]) for cfg, _ in obs if name in cfg]
+            if log:
+                vals = [math.log(max(v, 1e-300)) for v in vals]
+            return np.asarray(vals)
+
+        good_x, bad_x = xs_of(good), xs_of(bad)
+        if good_x.size == 0:
+            return dom.sample(self._rng)
+        # Parzen bandwidth: range-scaled Silverman-ish
+        bw = max((hi - lo) / max(4, good_x.size), 1e-12)
+        cands = self._np_rng.choice(good_x, size=self.n_candidates)
+        cands = cands + self._np_rng.normal(0.0, bw, size=self.n_candidates)
+        cands = np.clip(cands, lo, hi)
+
+        def kde_logpdf(x, data, h):
+            if data.size == 0:
+                return np.full_like(x, -math.log(hi - lo + 1e-12))
+            d = (x[:, None] - data[None, :]) / h
+            return np.log(
+                np.exp(-0.5 * d * d).sum(axis=1) / (data.size * h) + 1e-300)
+
+        score = kde_logpdf(cands, good_x, bw) - kde_logpdf(cands, bad_x, bw)
+        x = float(cands[int(np.argmax(score))])
+        if log:
+            x = math.exp(x)
+        if isinstance(dom, RandInt):
+            return int(np.clip(round(x), dom.low, dom.high - 1))
+        if isinstance(dom, QUniform):
+            return round(x / dom.q) * dom.q
+        return x
+
+
+class OptunaSearch(Searcher):
+    """Optuna-backed searcher (import-gated; reference search/optuna/)."""
+
+    def __init__(self, metric: str, mode: str = "max", **kwargs):
+        try:
+            import optuna  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "OptunaSearch requires the 'optuna' package, which is not "
+                "in the TPU image; use the native TPESearcher (same "
+                "algorithm family) instead") from e
+        import optuna
+
+        self.metric = metric
+        self.mode = mode
+        direction = "maximize" if mode == "max" else "minimize"
+        self._study = optuna.create_study(direction=direction, **kwargs)
+        self._space: Dict[str, Any] = {}
+        self._trials: Dict[str, Any] = {}
+
+    def set_search_space(self, param_space: Dict[str, Any]):
+        self._space = param_space
+        return self
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        trial = self._study.ask()
+        cfg = {}
+        for k, v in self._space.items():
+            if isinstance(v, Uniform):
+                cfg[k] = trial.suggest_float(k, v.low, v.high)
+            elif isinstance(v, LogUniform):
+                cfg[k] = trial.suggest_float(k, v.low, v.high, log=True)
+            elif isinstance(v, RandInt):
+                cfg[k] = trial.suggest_int(k, v.low, v.high - 1)
+            elif isinstance(v, Choice):
+                cfg[k] = trial.suggest_categorical(k, v.options)
+            else:
+                cfg[k] = v
+        self._trials[trial_id] = trial
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict],
+                          error: bool = False) -> None:
+        trial = self._trials.pop(trial_id, None)
+        if trial is None:
+            return
+        if error or not result or self.metric not in result:
+            self._study.tell(trial, state=2)  # PRUNED
+            return
+        self._study.tell(trial, float(result[self.metric]))

@@ -132,22 +132,35 @@ class Tuner:
             self._run.storage_path or "/tmp/ray_tpu_results", exp_name)
         os.makedirs(storage, exist_ok=True)
 
-        # materialize trials from the searcher
+        # Grid/random variants are enumerable up front; ADAPTIVE searchers
+        # (TPE & co) are consulted lazily as slots free, so each suggestion
+        # sees every completed result (reference: SearchGenerator).
         trials: List[Trial] = []
-        i = 0
-        while True:
-            tid = f"trial_{i:05d}"
+        adaptive = not isinstance(searcher, BasicVariantGenerator)
+        next_idx = 0
+
+        def suggest_one() -> Optional[Trial]:
+            nonlocal next_idx
+            tid = f"trial_{next_idx:05d}"
             cfg = searcher.suggest(tid)
             if cfg is None:
-                break
-            trials.append(Trial(trial_id=tid, config=cfg,
-                                trial_dir=os.path.join(storage, tid)))
-            i += 1
-            if (not isinstance(searcher, BasicVariantGenerator)
-                    and len(trials) >= tc.num_samples):
-                break
+                return None
+            trial = Trial(trial_id=tid, config=cfg,
+                          trial_dir=os.path.join(storage, tid))
+            next_idx += 1
+            trials.append(trial)
+            return trial
 
-        max_conc = tc.max_concurrent_trials or len(trials)
+        if not adaptive:
+            while suggest_one() is not None:
+                pass
+
+        # Adaptive searchers need bounded concurrency — drawing all
+        # num_samples up front would mean every suggestion sees zero
+        # completed results (pure random search).
+        max_conc = (tc.max_concurrent_trials
+                    or (min(tc.num_samples, 4) if adaptive
+                        else len(trials)))
         pending = list(trials)
         running: List[Trial] = []
         scores: Dict[str, float] = {}
@@ -189,7 +202,18 @@ class Tuner:
                             trial.best_result[tc.metric])):
                     trial.best_result = metrics
 
-        while pending or running:
+        exhausted = not adaptive
+        while pending or running or not exhausted:
+            if adaptive and not exhausted:
+                while (len(pending) + len(running) < max_conc
+                       and next_idx < tc.num_samples):
+                    t = suggest_one()
+                    if t is None:
+                        exhausted = True  # searcher ran out of suggestions
+                        break
+                    pending.append(t)
+                if next_idx >= tc.num_samples:
+                    exhausted = True
             while pending and len(running) < max_conc:
                 launch(pending.pop(0))
             progressed = False

@@ -1,0 +1,92 @@
+"""DQN + replay buffers. Mirrors reference rllib/algorithms/dqn tests and
+utils/replay_buffers tests in shape: buffer semantics unit-tested, then a
+short CartPole run must beat the random-policy baseline."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("gymnasium")
+
+
+@pytest.fixture(scope="module")
+def cluster(ray_cluster):
+    return ray_cluster
+
+
+def test_replay_buffer_ring():
+    from ray_tpu.rllib import ReplayBuffer
+
+    buf = ReplayBuffer(capacity=8, seed=0)
+    buf.add({"x": np.arange(6)})
+    assert len(buf) == 6
+    buf.add({"x": np.arange(6, 12)})  # wraps: capacity 8
+    assert len(buf) == 8
+    sample = buf.sample(16)
+    # the oldest 4 rows (0-3) were overwritten
+    assert set(sample["x"].tolist()) <= set(range(4, 12))
+
+
+def test_prioritized_buffer_bias_and_weights():
+    from ray_tpu.rllib import PrioritizedReplayBuffer
+
+    buf = PrioritizedReplayBuffer(capacity=16, alpha=1.0, beta=1.0, seed=0)
+    buf.add({"x": np.arange(4)})
+    # give row 3 overwhelming priority
+    buf.update_priorities(np.array([0, 1, 2, 3]),
+                          np.array([0.01, 0.01, 0.01, 10.0]))
+    sample = buf.sample(256)
+    frac3 = float(np.mean(sample["x"] == 3))
+    assert frac3 > 0.9
+    # importance weights correct that bias: rare rows get weight 1 (max)
+    rare = sample["weights"][sample["x"] != 3]
+    if rare.size:
+        assert float(rare.max()) == 1.0
+    assert float(sample["weights"][sample["x"] == 3].mean()) < 0.1
+
+
+def test_dqn_learns_cartpole(cluster):
+    from ray_tpu.rllib import DQNConfig
+
+    algo = DQNConfig(
+        num_env_runners=2, num_envs_per_runner=2,
+        rollout_fragment_length=64, learning_starts=256,
+        train_batch_size=64, num_updates_per_iter=8,
+        target_network_update_freq=300,
+        epsilon_decay_steps=2500, seed=3,
+    ).build()
+    try:
+        result = None
+        best = -np.inf
+        for _ in range(22):
+            result = algo.train()
+            if result["episode_return_mean"]:
+                best = max(best, result["episode_return_mean"])
+        assert result["num_updates"] > 0
+        assert result["loss"] is not None
+        # Random CartPole ~22; learning must push clearly past it.
+        assert best > 60, f"best return {best}"
+    finally:
+        algo.stop()
+
+
+def test_dqn_checkpoint_roundtrip(cluster, tmp_path):
+    from ray_tpu.rllib import DQNConfig
+
+    algo = DQNConfig(num_env_runners=1, num_envs_per_runner=1,
+                     rollout_fragment_length=8, learning_starts=8,
+                     train_batch_size=8, num_updates_per_iter=1,
+                     seed=0).build()
+    try:
+        algo.train()
+        path = str(tmp_path / "ckpt.pkl")
+        algo.save(path)
+        steps = algo._env_steps
+        algo2 = DQNConfig(num_env_runners=1, num_envs_per_runner=1,
+                          seed=1).build()
+        try:
+            algo2.restore(path)
+            assert algo2._env_steps == steps
+        finally:
+            algo2.stop()
+    finally:
+        algo.stop()

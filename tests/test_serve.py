@@ -151,6 +151,9 @@ def test_autoscaling_up_and_down():
         "min_replicas": 1, "max_replicas": 3,
         "target_ongoing_requests": 1.0,
         "upscale_delay_s": 0.0, "downscale_delay_s": 0.5,
+        # must exceed worst-case replica startup (~15s on a loaded 1-CPU
+        # host) or the post-burst downscale kills still-starting replicas
+        "look_back_period_s": 15.0,
     })
     class Slow:
         def __call__(self, x=None):
@@ -174,7 +177,7 @@ def test_autoscaling_up_and_down():
         r.result(timeout_s=60)
     assert scaled_up
     # idle -> scale back down to min
-    deadline = time.monotonic() + 30
+    deadline = time.monotonic() + 60
     scaled_down = False
     while time.monotonic() < deadline:
         st = ray_tpu.get(controller.get_app_status.remote("auto"))
