@@ -70,3 +70,19 @@ def listener(path: str) -> socket.socket:
     s.bind(path)
     s.listen(512)
     return s
+
+
+def connect_tcp(host: str, port: int, timeout: float = 10.0) -> Connection:
+    """TCP variant (remote drivers — the client proxy, util/client)."""
+    s = socket.create_connection((host, port), timeout=timeout)
+    s.settimeout(None)
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return Connection(s)
+
+
+def listener_tcp(host: str = "0.0.0.0", port: int = 0) -> socket.socket:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind((host, port))
+    s.listen(128)
+    return s

@@ -246,5 +246,9 @@ def global_worker() -> WorkerContext:
     return _global_worker
 
 
+def global_worker_or_none() -> Optional[WorkerContext]:
+    return _global_worker
+
+
 def is_initialized() -> bool:
     return _global_worker is not None

@@ -50,6 +50,15 @@ def init(
     if num_tpus is not None:
         res["TPU"] = float(num_tpus)
     address = address or os.environ.get("RAY_TPU_ADDRESS")
+    if address is not None and address.startswith("rtpu://"):
+        # Remote-driver client mode (reference: ray://): no local node at
+        # all — every operation proxies over TCP (util/client).
+        from ray_tpu.util.client import connect_client
+
+        ctx = connect_client(address)
+        worker_mod.set_global_worker(ctx)
+        atexit.register(shutdown)
+        return None
     if address is not None:
         # Attach this process to an existing cluster as a driver: start a
         # local (non-head) node joined through the head's gcs.sock
@@ -129,6 +138,14 @@ def shutdown():
         node, _global_node = _global_node, None
         worker_mod.set_global_worker(None)
         node.shutdown()
+    else:
+        # client mode: just drop the TCP connection
+        ctx = worker_mod.global_worker_or_none()
+        if ctx is not None:
+            worker_mod.set_global_worker(None)
+            close = getattr(ctx, "close", None)
+            if close is not None:
+                close()
 
 
 def is_initialized() -> bool:

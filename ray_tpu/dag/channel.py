@@ -52,17 +52,37 @@ def _ctx():
 
 class Channel:
     """One writer, one reader, bounded capacity. Pickles to the same channel
-    (id + capacity travel; seq state is per-process endpoint state)."""
+    (id + capacity travel; seq state is per-process endpoint state).
 
-    def __init__(self, chan_id: bytes, capacity: int = 16):
+    Two transports, chosen at compile time per edge:
+    - ``native=True`` (both endpoints on one node): the C++ mutable shm
+      ring (native/mutable_channel.cc) — kernel-blocking, one memcpy per
+      side, no store or KV traffic. Messages larger than the ring spill
+      through the object store transparently.
+    - ``native=False`` (cross-node): immutable store objects + KV-acked
+      ring backpressure; reads ride the normal object-transfer pull path.
+    """
+
+    def __init__(self, chan_id: bytes, capacity: int = 16,
+                 native: bool = False):
         self.chan_id = chan_id
         self.capacity = capacity
+        self.native = native
         self._wseq = 0
         self._rseq = 0
         self._acked = -1
+        self._native_chan = None
 
     def __reduce__(self):
-        return (Channel, (self.chan_id, self.capacity))
+        return (Channel, (self.chan_id, self.capacity, self.native))
+
+    def _native(self):
+        if self._native_chan is None:
+            from ray_tpu.dag.native_channel import NativeChannel
+
+            self._native_chan = NativeChannel(
+                f"/rtpu_chan_{self.chan_id.hex()}")
+        return self._native_chan
 
     def _oid(self, seq: int) -> bytes:
         return hashlib.sha1(
@@ -74,6 +94,16 @@ class Channel:
     # -- writer end --------------------------------------------------------
     def write(self, value, timeout: Optional[float] = None) -> None:
         ctx = _ctx()
+        if self.native:
+            try:
+                self._native().write(value, timeout=timeout)
+            except ValueError:
+                # larger than the ring: spill payload through the store,
+                # stream a small marker so ordering is preserved
+                ref = ctx.put_object(value)
+                self._native().write({"__rtpu_spill__": ref.binary()},
+                                     timeout=timeout)
+            return
         if self._wseq - self._acked > self.capacity:
             deadline = None if timeout is None else time.monotonic() + timeout
             while True:
@@ -102,6 +132,18 @@ class Channel:
     # -- reader end --------------------------------------------------------
     def read(self, timeout: Optional[float] = None):
         ctx = _ctx()
+        if self.native:
+            value = self._native().read(timeout=timeout)
+            if isinstance(value, dict) and "__rtpu_spill__" in value:
+                oid = value["__rtpu_spill__"]
+                value = ctx.get_object(ObjectRef(oid), timeout=timeout)
+                try:
+                    ctx.store.delete(oid)
+                except Exception:
+                    pass
+            if isinstance(value, _Stop):
+                raise ChannelClosed()
+            return value
         value = ctx.get_object(ObjectRef(self._oid(self._rseq)),
                                timeout=timeout)
         if isinstance(value, np.ndarray):

@@ -89,6 +89,10 @@ def _dag_actor_loop(instance, method_name: str,
     loops.append(t)
 
 
+def _dag_noop(_instance):
+    return None
+
+
 class CompiledDAGRef:
     """Result handle for one CompiledDAG.execute call."""
 
@@ -112,11 +116,33 @@ class CompiledDAG:
         self._compile()
 
     # -- compilation -------------------------------------------------------
-    def _new_channel(self) -> Channel:
-        return Channel(os.urandom(16), capacity=self._buffer_size)
+    def _new_channel(self, writer_node, reader_node) -> Channel:
+        # same-node edges ride the native mutable shm ring; cross-node (or
+        # unknown, e.g. client-mode driver) edges use the store transport
+        native = (writer_node is not None and writer_node == reader_node)
+        return Channel(os.urandom(16), capacity=self._buffer_size,
+                       native=native)
 
     def _compile(self):
         order = self._root.topo_sort()
+
+        # Resolve actor placement first (channel transport selection):
+        # one no-op round also guarantees every actor finished creation.
+        from ray_tpu import api
+        from ray_tpu._private.worker import global_worker
+
+        actor_handles = {}
+        for n in order:
+            if isinstance(n, ClassMethodNode):
+                actor_handles[n._actor.actor_id] = n._actor
+        actor_node: dict = {}
+        if actor_handles:
+            api.get([a.__rtpu_apply__.remote(_dag_noop)
+                     for a in actor_handles.values()])
+            for row in global_worker().rpc("list_actors", {}):
+                actor_node[row["actor_id"]] = row["node_id"]
+        drv = getattr(global_worker(), "node", None)
+        driver_node = drv.node_id if drv is not None else None
         self._input_node = None
         for n in order:
             if isinstance(n, InputNode):
@@ -144,17 +170,18 @@ class CompiledDAG:
         self._input_feeds: List[Tuple[Channel, Any]] = []
         node_specs: Dict[int, Tuple[ClassMethodNode, list, dict]] = {}
 
-        def spec_for(value) -> Tuple[str, Any]:
+        def spec_for(value, consumer_node) -> Tuple[str, Any]:
             if isinstance(value, InputNode):
-                ch = self._new_channel()
+                ch = self._new_channel(driver_node, consumer_node)
                 self._input_feeds.append((ch, None))
                 return ("chan", ch)
             if isinstance(value, InputAttributeNode):
-                ch = self._new_channel()
+                ch = self._new_channel(driver_node, consumer_node)
                 self._input_feeds.append((ch, value._key))
                 return ("chan", ch)
             if isinstance(value, ClassMethodNode):
-                ch = self._new_channel()
+                ch = self._new_channel(
+                    actor_node.get(value._actor.actor_id), consumer_node)
                 fanout.setdefault(id(value), []).append(ch)
                 return ("chan", ch)
             if isinstance(value, DAGNode):
@@ -164,21 +191,26 @@ class CompiledDAG:
 
         for n in order:
             if isinstance(n, ClassMethodNode):
-                arg_specs = [spec_for(a) for a in n._bound_args]
-                kwarg_specs = {k: spec_for(v)
+                consumer = actor_node.get(n._actor.actor_id)
+                arg_specs = [spec_for(a, consumer) for a in n._bound_args]
+                kwarg_specs = {k: spec_for(v, consumer)
                                for k, v in n._bound_kwargs.items()}
                 node_specs[id(n)] = (n, arg_specs, kwarg_specs)
 
         # Driver-read output channels, one per leaf.
         self._output_channels: List[Channel] = []
         for leaf in leaves:
-            ch = self._new_channel()
+            ch = self._new_channel(
+                actor_node.get(leaf._actor.actor_id), driver_node)
             fanout.setdefault(id(leaf), []).append(ch)
             self._output_channels.append(ch)
 
         # Start the resident loops (one __rtpu_apply__ round, await all).
-        from ray_tpu import api
         self._stop_feeds = [ch for ch, _ in self._input_feeds]
+        self._all_channels = (
+            [ch for ch, _ in self._input_feeds]
+            + self._output_channels
+            + [ch for chans in fanout.values() for ch in chans])
         refs = []
         for _, (node, arg_specs, kwarg_specs) in node_specs.items():
             outs = fanout.get(id(node), [])
@@ -228,3 +260,23 @@ class CompiledDAG:
                 ch.write(STOP, timeout=5.0)
             except Exception:
                 pass
+        # reclaim native shm segments (by name — any process may have
+        # created them) once the stop has flowed through
+        def _unlink_later(channels=list({id(c): c
+                                         for c in self._all_channels
+                                         }.values())):
+            import time as _time
+
+            _time.sleep(0.2)
+            try:
+                from ray_tpu.dag.native_channel import _load
+
+                lib = _load()
+                for ch in channels:
+                    if ch.native:
+                        lib.mc_unlink(
+                            f"/rtpu_chan_{ch.chan_id.hex()}".encode())
+            except Exception:
+                pass
+
+        threading.Thread(target=_unlink_later, daemon=True).start()

@@ -28,6 +28,18 @@ from ray_tpu.llm.paged_cache import CacheConfig, PageAllocator, init_cache
 from ray_tpu.models.llama import LlamaConfig
 
 
+def _inject_kv_pages_impl(cache_k, cache_v, idx, kv_k, kv_v):
+    """Scatter shipped KV pages into the paged cache (P/D decode side).
+
+    Donation makes this an in-place page write — without it every
+    disaggregated admission would copy the whole multi-GiB cache.
+    """
+    return (cache_k.at[:, idx].set(kv_k), cache_v.at[:, idx].set(kv_v))
+
+
+_inject_kv_pages = jax.jit(_inject_kv_pages_impl, donate_argnums=(0, 1))
+
+
 @dataclass
 class EngineConfig:
     max_slots: int = 8  # concurrent sequences in the decode batch
@@ -274,13 +286,23 @@ class LLMEngine:
             try:
                 if req.kind == "decode_kv":
                     # Inject the shipped KV pages; skip prefill compute.
+                    # Donated jitted scatter: in-place page update, not a
+                    # whole-cache copy per admission. Shapes are padded to
+                    # max_pages_per_seq so ONE compilation serves every
+                    # request (page 0 is the scratch/null page; writing it
+                    # matches prefill's existing padded-position behavior).
                     kv_k, kv_v = req.kv
                     req.kv = None  # free the host copy promptly
                     src = kv_k.shape[1]
-                    idx = jnp.asarray(np.asarray(pages[:src]))
-                    self.cache_k = self.cache_k.at[:, idx].set(
-                        jnp.asarray(kv_k, self.cache_k.dtype))
-                    self.cache_v = self.cache_v.at[:, idx].set(
+                    P = self.max_pages_per_seq
+                    idx = np.zeros(P, np.int32)
+                    idx[:src] = pages[:src]
+                    pad = ((0, 0), (0, P - src), (0, 0), (0, 0), (0, 0))
+                    kv_k = np.pad(kv_k, pad) if src < P else kv_k
+                    kv_v = np.pad(kv_v, pad) if src < P else kv_v
+                    self.cache_k, self.cache_v = _inject_kv_pages(
+                        self.cache_k, self.cache_v, jnp.asarray(idx),
+                        jnp.asarray(kv_k, self.cache_k.dtype),
                         jnp.asarray(kv_v, self.cache_v.dtype))
                     last = int(req.first_token)
                 else:

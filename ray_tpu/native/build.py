@@ -22,6 +22,12 @@ _BINARIES = {
         "flags": ["-O2", "-std=c++17", "-pthread"],
         "libs": ["-lrt"],
     },
+    "libmutable_channel": {
+        "sources": ["mutable_channel.cc"],
+        "flags": ["-O2", "-std=c++17", "-pthread", "-shared", "-fPIC"],
+        "libs": ["-lrt"],
+        "suffix": ".so",
+    },
 }
 
 
@@ -37,7 +43,8 @@ def binary_path(name: str) -> str:
     """Return the path to a built native binary, compiling it if needed."""
     spec = _BINARIES[name]
     tag = _source_hash(spec["sources"])
-    out = os.path.join(_BUILD_DIR, f"{name}-{tag}")
+    out = os.path.join(_BUILD_DIR,
+                       f"{name}-{tag}{spec.get('suffix', '')}")
     if os.path.exists(out):
         return out
     os.makedirs(_BUILD_DIR, exist_ok=True)

@@ -193,6 +193,11 @@ def cmd_start(args):
         print(f"head node started\n  gcs address: {node.gcs_address}\n"
               f"  attach with: ray_tpu.init(address={node.gcs_address!r}) "
               f"or RAY_TPU_ADDRESS", flush=True)
+        if args.client_server_port is not None:
+            from ray_tpu.util.client import ClientServer
+
+            cs = ClientServer(port=args.client_server_port)
+            print(f"  client server: rtpu://0.0.0.0:{cs.port}", flush=True)
     else:
         from ray_tpu._private.node import Node
 
@@ -303,6 +308,9 @@ def main(argv=None):
                     help="hex node id (autoscaler-assigned identity)")
     sp.add_argument("--resources", default=None,
                     help='JSON resource dict, e.g. \'{"AS_RES": 2.0}\'')
+    sp.add_argument("--client-server-port", type=int, default=None,
+                    help="serve remote rtpu:// drivers on this TCP port "
+                         "(0 = ephemeral)")
     sp.set_defaults(fn=cmd_start)
     sp = sub.add_parser("stop")
     sp.set_defaults(fn=cmd_stop)

@@ -124,6 +124,12 @@ class Tuner:
         tc = self._tune
         searcher = tc.search_alg or BasicVariantGenerator(
             self._param_space, tc.num_samples, seed=tc.seed)
+        # Reference parity: Tuner hands param_space to the searcher; a
+        # user-built searcher may also have called set_search_space itself.
+        if (tc.search_alg is not None and self._param_space
+                and hasattr(searcher, "set_search_space")
+                and not getattr(searcher, "_space", None)):
+            searcher.set_search_space(self._param_space)
         scheduler = tc.scheduler or FIFOScheduler()
         if tc.metric:
             scheduler.set_metric(tc.metric, tc.mode)

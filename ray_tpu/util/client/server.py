@@ -1,0 +1,101 @@
+"""ClientServer: the cluster-side proxy for remote drivers.
+
+Counterpart of /root/reference/python/ray/util/client/server/server.py —
+scope note: all clients share this server's single attached-driver context
+(the reference proxies a worker PER client, util/client/server/proxier.py;
+one shared driver is the deliberate first cut here and is safe because the
+runtime's submission paths are thread-safe).
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Optional
+
+import cloudpickle
+
+from ray_tpu._private import protocol
+from ray_tpu._private import worker as worker_mod
+from ray_tpu.core.object_ref import ObjectRef
+
+
+class ClientServer:
+    """Serve remote drivers on TCP. Must run in a process already attached
+    to the cluster (ray_tpu.init done)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        if worker_mod.global_worker() is None:
+            raise RuntimeError("ClientServer requires ray_tpu.init() first")
+        self._listener = protocol.listener_tcp(host, port)
+        self.port = self._listener.getsockname()[1]
+        self._shutdown = False
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="client-server", daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._shutdown:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            conn = protocol.Connection(sock)
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: protocol.Connection):
+        ctx = worker_mod.global_worker()
+        while True:
+            msg = conn.recv()
+            if msg is None:
+                return
+            try:
+                result = self._handle(ctx, msg)
+                conn.send({"ok": True, "result": result})
+            except BaseException as e:  # noqa: BLE001 — ship to client
+                try:
+                    payload = cloudpickle.dumps(e)
+                except Exception:
+                    payload = cloudpickle.dumps(
+                        RuntimeError(f"{type(e).__name__}: {e}"))
+                try:
+                    conn.send({"ok": False, "error": payload,
+                               "traceback": traceback.format_exc()})
+                except OSError:
+                    return
+
+    def _handle(self, ctx, msg: dict):
+        op = msg["op"]
+        if op == "put":
+            value = cloudpickle.loads(msg["blob"])
+            return ctx.put_object(value, oid=msg.get("oid")).binary()
+        if op == "get":
+            value = ctx.get_object(ObjectRef(msg["oid"]),
+                                   timeout=msg.get("timeout"))
+            return cloudpickle.dumps(value)
+        if op == "register_function":
+            fn = cloudpickle.loads(msg["blob"])
+            return ctx.register_function(fn)
+        if op == "submit":
+            ctx.submit(msg["spec"])
+            return True
+        if op == "rpc":
+            return ctx.rpc(msg["method"], msg["params"])
+        if op == "wait":
+            ready, pending = ctx.wait(
+                [ObjectRef(o) for o in msg["oids"]],
+                msg["num_returns"], msg.get("timeout"),
+                msg.get("fetch_local", True))
+            return ([r.binary() for r in ready],
+                    [p.binary() for p in pending])
+        if op == "ping":
+            return "pong"
+        raise ValueError(f"unknown client op {op!r}")
+
+    def shutdown(self):
+        self._shutdown = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass

@@ -1,0 +1,24 @@
+"""Joblib backend: scikit-learn's n_jobs parallelism on the cluster.
+
+Counterpart of /root/reference/python/ray/util/joblib/ (register_ray +
+ray_backend.py): ``register_ray()`` then
+``with joblib.parallel_backend("ray_tpu"): ...`` runs every joblib batch as
+a cluster task.
+"""
+
+from __future__ import annotations
+
+__all__ = ["register_ray"]
+
+
+def register_ray() -> None:
+    try:
+        from joblib.parallel import register_parallel_backend
+    except ImportError as e:
+        raise ImportError("joblib is required for the ray_tpu joblib "
+                          "backend") from e
+    from ray_tpu.util.joblib.ray_backend import RayTpuBackend
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)
+    # the reference registers under "ray"; accept that spelling too
+    register_parallel_backend("ray", RayTpuBackend)

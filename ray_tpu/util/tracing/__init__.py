@@ -1,0 +1,94 @@
+"""Task/actor tracing: span propagation + chrome-trace export.
+
+Counterpart of /root/reference/python/ray/util/tracing/tracing_helper.py
+(OpenTelemetry monkey-patching of submission/execution) — redesigned on
+the runtime's own task-event timeline: every task already records
+submitted/running/finished timestamps in the per-node scheduler
+(ray timeline parity lives in scripts/cli.py `timeline`). This module adds
+app-level spans: ``with trace_span("name"):`` records into the same
+chrome-trace stream, and an OpenTelemetry exporter hook is import-gated.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_spans: List[Dict[str, Any]] = []
+_lock = threading.Lock()
+_enabled = False
+
+
+def enable_tracing() -> None:
+    """Turn on app-span collection in this process."""
+    global _enabled
+    _enabled = True
+
+
+def is_tracing_enabled() -> bool:
+    return _enabled
+
+
+@contextlib.contextmanager
+def trace_span(name: str, **attributes):
+    """Record one span (chrome-trace "X" event) if tracing is enabled."""
+    if not _enabled:
+        yield
+        return
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        with _lock:
+            _spans.append({
+                "name": name, "ph": "X", "pid": os.getpid(),
+                "tid": threading.get_ident() % 1_000_000,
+                "ts": t0 * 1e6, "dur": (time.time() - t0) * 1e6,
+                "args": attributes,
+            })
+
+
+def collected_spans() -> List[Dict[str, Any]]:
+    with _lock:
+        return list(_spans)
+
+
+def export_chrome_trace(path: str, include_task_events: bool = True) -> int:
+    """Write collected spans (+ the cluster task timeline) as a chrome
+    trace; returns the event count. Open in chrome://tracing or Perfetto."""
+    events = collected_spans()
+    if include_task_events:
+        try:
+            from ray_tpu._private.worker import global_worker
+
+            for e in global_worker().rpc("list_task_events", {}):
+                if e.get("start_ts") and e.get("end_ts"):
+                    events.append({
+                        "name": e["name"], "ph": "X", "pid": 1,
+                        "tid": int.from_bytes(
+                            e["task_id"][:4], "little") % 1_000_000,
+                        "ts": e["start_ts"] * 1e6,
+                        "dur": (e["end_ts"] - e["start_ts"]) * 1e6,
+                        "args": {"state": e["state"]},
+                    })
+        except Exception:
+            pass
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    return len(events)
+
+
+def setup_otel_exporter(endpoint: Optional[str] = None):
+    """OpenTelemetry bridge (import-gated like the reference's exporters)."""
+    try:
+        import opentelemetry  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "opentelemetry is not in the TPU image; use "
+            "export_chrome_trace() for local trace inspection") from e
+    raise NotImplementedError(
+        "wire collected_spans() into your OTel pipeline here")

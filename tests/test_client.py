@@ -1,0 +1,86 @@
+"""Remote-driver client (rtpu://): the full API over one TCP proxy.
+
+Mirrors /root/reference/python/ray/tests/test_client.py in shape: the
+client runs in a SEPARATE python process with no node of its own.
+"""
+
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def client_server(ray_cluster):
+    from ray_tpu.util.client import ClientServer
+
+    server = ClientServer(host="127.0.0.1", port=0)
+    yield server
+    server.shutdown()
+
+
+def _run_client(port: int, body: str) -> str:
+    script = textwrap.dedent(f"""
+        import ray_tpu
+        ray_tpu.init(address="rtpu://127.0.0.1:{port}")
+    """) + textwrap.dedent(body)
+    proc = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=180)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return proc.stdout
+
+
+def test_client_tasks_and_objects(client_server):
+    out = _run_client(client_server.port, """
+        @ray_tpu.remote
+        def add(a, b):
+            return a + b
+
+        ref = ray_tpu.put(40)
+        print("task:", ray_tpu.get(add.remote(ref, 2)))
+        refs = [add.remote(i, i) for i in range(4)]
+        ready, pending = ray_tpu.wait(refs, num_returns=4, timeout=60)
+        print("wait:", len(ready), len(pending))
+        print("vals:", sorted(ray_tpu.get(refs)))
+    """)
+    assert "task: 42" in out
+    assert "wait: 4 0" in out
+    assert "vals: [0, 2, 4, 6]" in out
+
+
+def test_client_actors_and_state(client_server):
+    out = _run_client(client_server.port, """
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.remote()
+        print("counts:", [ray_tpu.get(c.incr.remote()) for _ in range(3)])
+        print("nodes:", len(ray_tpu.nodes()) >= 1)
+        print("cpus:", ray_tpu.cluster_resources().get("CPU", 0) > 0)
+        ray_tpu.kill(c)
+    """)
+    assert "counts: [1, 2, 3]" in out
+    assert "nodes: True" in out
+    assert "cpus: True" in out
+
+
+def test_client_error_propagation(client_server):
+    out = _run_client(client_server.port, """
+        @ray_tpu.remote
+        def boom():
+            raise ValueError("remote kaboom")
+
+        try:
+            ray_tpu.get(boom.remote())
+            print("NO ERROR")
+        except ValueError as e:
+            print("caught:", "remote kaboom" in str(e))
+    """)
+    assert "caught: True" in out

@@ -1,0 +1,60 @@
+"""Ecosystem extras: joblib backend, tracing spans, usage tags, client CLI.
+Mirrors reference tests test_joblib.py / tracing tests in shape."""
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def cluster(ray_cluster):
+    return ray_cluster
+
+
+def test_joblib_backend(cluster):
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+
+    def square(x):
+        return x * x
+
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        out = joblib.Parallel()(joblib.delayed(square)(i) for i in range(8))
+    assert out == [i * i for i in range(8)]
+
+
+def test_tracing_spans_and_chrome_export(cluster, tmp_path):
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    tracing.enable_tracing()
+
+    @ray_tpu.remote
+    def traced_work(x):
+        return x + 1
+
+    with tracing.trace_span("driver_block", stage="test"):
+        ray_tpu.get([traced_work.remote(i) for i in range(3)])
+
+    spans = tracing.collected_spans()
+    assert any(s["name"] == "driver_block" for s in spans)
+    path = str(tmp_path / "trace.json")
+    n = tracing.export_chrome_trace(path)
+    assert n >= 1
+    import json
+
+    data = json.load(open(path))
+    names = {e["name"] for e in data["traceEvents"]}
+    assert "driver_block" in names
+    # cluster task events flow into the same trace
+    assert any("traced_work" in n for n in names)
+
+
+def test_usage_tags(cluster):
+    from ray_tpu._private import usage_lib
+
+    usage_lib.record_library_usage("data")
+    usage_lib.record_extra_usage_tag("test_tag", "42")
+    tags = usage_lib.get_recorded_tags()
+    assert tags.get("library_data") == "1"
+    assert tags.get("test_tag") == "42"
