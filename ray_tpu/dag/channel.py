@@ -84,6 +84,18 @@ class Channel:
                 f"/rtpu_chan_{self.chan_id.hex()}")
         return self._native_chan
 
+    def unlink_native(self) -> None:
+        """Reclaim this channel's shm segment on THIS host (no-op for
+        store-transport channels or if never created here)."""
+        if not self.native:
+            return
+        try:
+            from ray_tpu.dag.native_channel import _load
+
+            _load().mc_unlink(f"/rtpu_chan_{self.chan_id.hex()}".encode())
+        except Exception:
+            pass
+
     def _oid(self, seq: int) -> bytes:
         return hashlib.sha1(
             self.chan_id + seq.to_bytes(8, "little")).digest()[:20]

@@ -62,6 +62,13 @@ def _dag_actor_loop(instance, method_name: str,
                 except ChannelClosed:
                     for ch in out_channels:
                         ch.write(STOP)
+                    # reader-side shm cleanup: the driver can only unlink
+                    # segments on ITS host, so each loop reclaims its own
+                    # node's in-edges (unlink keeps live mappings valid)
+                    for kind, v in list(arg_specs) + list(
+                            kwarg_specs.values()):
+                        if kind == "chan":
+                            v.unlink_native()
                     return
                 if poisoned is not None:
                     result = poisoned  # propagate, don't execute
@@ -260,23 +267,15 @@ class CompiledDAG:
                 ch.write(STOP, timeout=5.0)
             except Exception:
                 pass
-        # reclaim native shm segments (by name — any process may have
-        # created them) once the stop has flowed through
+        # reclaim driver-host shm segments once the stop has flowed
+        # through; each actor loop unlinks its own node's in-edges on exit
         def _unlink_later(channels=list({id(c): c
                                          for c in self._all_channels
                                          }.values())):
             import time as _time
 
             _time.sleep(0.2)
-            try:
-                from ray_tpu.dag.native_channel import _load
-
-                lib = _load()
-                for ch in channels:
-                    if ch.native:
-                        lib.mc_unlink(
-                            f"/rtpu_chan_{ch.chan_id.hex()}".encode())
-            except Exception:
-                pass
+            for ch in channels:
+                ch.unlink_native()
 
         threading.Thread(target=_unlink_later, daemon=True).start()

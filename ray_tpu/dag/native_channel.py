@@ -69,17 +69,24 @@ class NativeChannel:
         self._handle = handle
         self._buf = ctypes.create_string_buffer(1 << 16)
 
+    _CHUNK_MS = 60_000  # timeout=None waits forever in bounded C-side slices
+
     def write(self, value, timeout: Optional[float] = None) -> None:
         size, token = serialized_size(value)
         payload = bytearray(size)
         write_payload(memoryview(payload), token)
         # zero-copy hand-off: C memcpys straight out of the bytearray
         buf = (ctypes.c_char * size).from_buffer(payload)
-        rc = self._lib.mc_write(
-            self._handle, buf, size,
-            int((timeout if timeout is not None else 3600) * 1000))
+        ms = None if timeout is None else int(timeout * 1000)
+        while True:
+            rc = self._lib.mc_write(
+                self._handle, buf, size,
+                self._CHUNK_MS if ms is None else ms)
+            if rc == -1 and ms is None:
+                continue  # infinite wait: keep blocking in bounded slices
+            break
         if rc == -1:
-            raise TimeoutError(f"native channel write timed out")
+            raise TimeoutError("native channel write timed out")
         if rc == -2:
             raise NativeChannelClosed()
         if rc == -3:
@@ -87,16 +94,19 @@ class NativeChannel:
                              f"capacity")
 
     def read(self, timeout: Optional[float] = None):
-        ms = int((timeout if timeout is not None else 3600) * 1000)
+        ms = None if timeout is None else int(timeout * 1000)
         while True:
-            n = self._lib.mc_read(self._handle, self._buf,
-                                  len(self._buf), ms)
+            n = self._lib.mc_read(
+                self._handle, self._buf, len(self._buf),
+                self._CHUNK_MS if ms is None else ms)
             if n == -4:
                 need = self._lib.mc_next_len(self._handle)
                 if need > 0:
                     self._buf = ctypes.create_string_buffer(int(need))
                     continue
                 continue
+            if n == -1 and ms is None:
+                continue  # infinite wait: keep blocking in bounded slices
             break
         if n == -1:
             raise TimeoutError("native channel read timed out")

@@ -94,6 +94,21 @@ def read_text(paths, *, override_num_blocks: Optional[int] = None) -> Dataset:
     return _read("ReadText", _ds.text_tasks(paths, _par(override_num_blocks)))
 
 
+def read_images(paths, *, size=None, mode: str = "RGB",
+                include_paths: bool = False,
+                override_num_blocks: Optional[int] = None) -> Dataset:
+    return _read("ReadImages", _ds.image_tasks(
+        paths, _par(override_num_blocks), size=size, mode=mode,
+        include_paths=include_paths))
+
+
+def from_huggingface(hf_dataset, *,
+                     override_num_blocks: Optional[int] = None) -> Dataset:
+    """Zero-copy over a `datasets.Dataset`'s arrow shards."""
+    return _read("FromHuggingFace", _ds.huggingface_tasks(
+        hf_dataset, _par(override_num_blocks)))
+
+
 def read_binary_files(paths, *, include_paths: bool = False,
                       override_num_blocks: Optional[int] = None) -> Dataset:
     return _read("ReadBinary",
@@ -122,12 +137,14 @@ __all__ = [
     "SimpleImputer",
     "StandardScaler",
     "from_arrow",
+    "from_huggingface",
     "from_items",
     "from_numpy",
     "from_pandas",
     "range",
     "read_binary_files",
     "read_csv",
+    "read_images",
     "read_json",
     "read_numpy",
     "read_parquet",

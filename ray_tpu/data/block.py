@@ -14,6 +14,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Any, Dict, Iterable, Iterator, List, Optional
 
+import json as json_mod
+
 import numpy as np
 import pyarrow as pa
 
@@ -131,7 +133,18 @@ def to_batch(block: Block, batch_format: str = "numpy") -> Any:
 
 
 def rows_of(block: Block) -> Iterator[Dict[str, Any]]:
+    # Fixed-size-list columns carrying an np_shape annotation (multi-dim
+    # arrays, e.g. images) reshape back per row instead of leaking flat
+    # python lists.
+    shaped = {}
+    for field in block.schema:
+        meta = field.metadata or {}
+        if b"np_shape" in meta:
+            shaped[field.name] = json_mod.loads(meta[b"np_shape"].decode())
     for r in block.to_pylist():
+        for name, shape in shaped.items():
+            if r.get(name) is not None:
+                r[name] = np.asarray(r[name]).reshape(shape)
         yield r
 
 

@@ -152,6 +152,74 @@ def numpy_tasks(paths, parallelism: int) -> List[Callable]:
     return _file_tasks(files, parallelism, read_file)
 
 
+IMAGE_SUFFIXES = [".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp"]
+
+
+def image_tasks(paths, parallelism: int, size=None, mode: str = "RGB",
+                include_paths: bool = False) -> List[Callable]:
+    """Reference: _internal/datasource/image_datasource.py — decode to
+    fixed-shape numpy ("image" column) ready for device batching.
+
+    Without ``size``, all images must share one resolution (static shapes
+    are what the device pipeline consumes); mixed sizes raise a clear
+    error instead of a downstream ArrowInvalid on concat.
+    """
+    files = expand_paths(paths, IMAGE_SUFFIXES)
+
+    def read_group(group: List[str]) -> Iterator[Block]:
+        from PIL import Image
+
+        seen_shape = None
+        for f in group:
+            img = Image.open(f)
+            if mode:
+                img = img.convert(mode)
+            if size is not None:
+                img = img.resize(tuple(size))
+            arr = np.asarray(img)
+            if size is None:
+                if seen_shape is None:
+                    seen_shape = arr.shape
+                elif arr.shape != seen_shape:
+                    raise ValueError(
+                        f"read_images: mixed image shapes {seen_shape} vs "
+                        f"{arr.shape} ({f}); pass size=(w, h) to resize to "
+                        f"a common resolution")
+            batch: Dict[str, Any] = {"image": arr[None]}
+            if include_paths:
+                batch["path"] = np.array([f])
+            yield block_mod.from_batch(batch)
+
+    tasks = []
+    for group in _chunk(files, parallelism):
+        def read(group=group) -> Iterator[Block]:
+            yield from read_group(group)
+
+        tasks.append(read)
+    return tasks
+
+
+def huggingface_tasks(hf_dataset, parallelism: int) -> List[Callable]:
+    """Reference: read_api.py from_huggingface — zero-copy over the HF
+    dataset's arrow shards."""
+    table = hf_dataset.data.table.combine_chunks()
+    n = max(1, table.num_rows)
+    per = -(-n // parallelism)
+    tasks = []
+    for lo in range(0, n, per):
+        hi = min(n, lo + per)
+        # capture the SLICE, not the whole table: each task closure is
+        # pickled and shipped, so capturing `table` would serialize the
+        # full dataset once per task
+        shard = table.slice(lo, hi - lo)
+
+        def read(shard=shard) -> Iterator[Block]:
+            yield shard
+
+        tasks.append(read)
+    return tasks
+
+
 def items_tasks(items: List[Any], parallelism: int) -> List[Callable]:
     tasks = []
     for group in _chunk(list(items), parallelism):

@@ -6,6 +6,7 @@ replay buffers, and jitted JAX learners (module.py, env_runner.py, ppo.py,
 dqn.py, replay_buffers.py).
 """
 
+from ray_tpu.rllib.bc import BC, BCConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.module import MLPConfig, forward, greedy_action, init_mlp
@@ -13,6 +14,8 @@ from ray_tpu.rllib.ppo import PPO, PPOConfig, compute_gae
 from ray_tpu.rllib.replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
 
 __all__ = [
+    "BC",
+    "BCConfig",
     "DQN",
     "DQNConfig",
     "EnvRunner",

@@ -1,0 +1,123 @@
+"""BC: offline behavior cloning from a Dataset of (obs, action) rows.
+
+Counterpart of /root/reference/rllib/algorithms/bc/ (offline RL via the
+offline data pipeline, rllib/offline/): the dataset is a ray_tpu.data
+Dataset (or anything iter_batches-shaped), the learner is one jitted
+cross-entropy update over the policy head — the simplest member of the
+offline family (MARWIL = BC + advantage weighting).
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib import module as module_mod
+
+
+@dataclass
+class BCConfig:
+    """Reference: rllib/algorithms/bc/bc.py BCConfig."""
+
+    obs_dim: int = 4
+    n_actions: int = 2
+    hidden: tuple = (64, 64)
+    lr: float = 1e-3
+    train_batch_size: int = 256
+    grad_clip: float = 10.0
+    seed: int = 0
+    # offline input: a ray_tpu.data Dataset with "obs" and "actions"
+    input_dataset: Any = None
+
+    def build(self) -> "BC":
+        return BC(self)
+
+
+@partial(jax.jit, static_argnames=("lr", "grad_clip"))
+def _bc_update(params, opt_state, obs, actions, *, lr, grad_clip):
+    import optax
+
+    tx = optax.chain(optax.clip_by_global_norm(grad_clip), optax.adam(lr))
+
+    def loss_fn(p):
+        logits, _ = module_mod.forward(p, obs)
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(
+            logp, actions[:, None].astype(jnp.int32), axis=1)[:, 0]
+        return nll.mean()
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss
+
+
+class BC:
+    def __init__(self, config: BCConfig):
+        import optax
+
+        if config.input_dataset is None:
+            raise ValueError("BCConfig.input_dataset is required")
+        self.config = config
+        mcfg = module_mod.MLPConfig(obs_dim=config.obs_dim,
+                                    n_actions=config.n_actions,
+                                    hidden=config.hidden)
+        self.params = module_mod.init_mlp(
+            mcfg, jax.random.PRNGKey(config.seed))
+        tx = optax.chain(optax.clip_by_global_norm(config.grad_clip),
+                         optax.adam(config.lr))
+        self.opt_state = tx.init(self.params)
+        self._iter = 0
+
+    def train(self) -> Dict[str, Any]:
+        c = self.config
+        t0 = time.perf_counter()
+        losses = []
+        n = 0
+        for batch in c.input_dataset.iter_batches(
+                batch_size=c.train_batch_size, batch_format="numpy"):
+            obs_np = np.asarray(batch["obs"])
+            if obs_np.dtype == object:  # arrow list column → ragged rows
+                obs_np = np.stack([np.asarray(o, np.float32)
+                                   for o in obs_np])
+            obs = jnp.asarray(obs_np.astype(np.float32))
+            actions = jnp.asarray(np.asarray(batch["actions"], np.int32))
+            self.params, self.opt_state, loss = _bc_update(
+                self.params, self.opt_state, obs, actions,
+                lr=c.lr, grad_clip=c.grad_clip)
+            losses.append(float(loss))
+            n += len(actions)
+        self._iter += 1
+        return {
+            "training_iteration": self._iter,
+            "loss": float(np.mean(losses)) if losses else None,
+            "num_samples_trained": n,
+            "time_this_iter_s": time.perf_counter() - t0,
+        }
+
+    def compute_single_action(self, obs) -> int:
+        return int(module_mod.greedy_action(
+            self.params, np.asarray(obs, np.float32)[None])[0])
+
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            pickle.dump({"params": self.params,
+                         "opt_state": self.opt_state,
+                         "iter": self._iter}, f)
+
+    def restore(self, path: str) -> None:
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+        self._iter = state["iter"]
+
+    def stop(self) -> None:
+        pass

@@ -1,0 +1,158 @@
+"""TorchTrainer: torch.distributed data-parallel training on the cluster.
+
+Counterpart of the reference's torch backend
+(/root/reference/python/ray/train/torch/config.py:115 — TCP-store
+``dist.init_process_group`` bootstrap — and train_loop_utils.py:153
+``prepare_model``): the worker group is the same actor gang the JaxTrainer
+uses; this backend wraps the user's train fn to rendezvous a gloo (CPU) or
+custom process group before it runs. On TPU clusters torch is the
+*secondary* compute path (reference parity + CPU-side workloads); the
+native path is JAX meshes (trainer.py).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from dataclasses import dataclass
+from functools import wraps
+from typing import Callable, Optional
+
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.trainer import JaxTrainer
+
+
+@dataclass
+class TorchConfig:
+    """Reference: train/torch/config.py TorchConfig."""
+
+    backend: str = "gloo"  # no NCCL on TPU hosts; gloo rides the host NIC
+    master_addr: Optional[str] = None  # default: this host
+    master_port: Optional[int] = None  # default: ephemeral, chosen at fit()
+    timeout_s: float = 1800.0
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _default_master_addr() -> str:
+    """A peer-routable address for this host (loopback only as a last
+    resort — 127.0.0.1 can never rendezvous a multi-node gang)."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))  # no traffic sent; picks the NIC
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        pass
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+def _wrap_with_process_group(train_fn: Callable,
+                             cfg: TorchConfig) -> Callable:
+    # NOTE: the port is reserved on the DRIVER host; rank 0 must run on a
+    # host where it is also free (guaranteed single-host; set
+    # TorchConfig.master_addr/master_port explicitly for multi-host gangs).
+    addr = cfg.master_addr or _default_master_addr()
+    port = cfg.master_port or _free_port()
+    import inspect
+
+    wants_config = bool(inspect.signature(train_fn).parameters)
+
+    @wraps(train_fn)
+    def wrapped(config=None):
+        import datetime
+
+        import torch.distributed as dist
+
+        from ray_tpu.train.context import get_context
+
+        ctx = get_context()
+        rank, world = ctx.get_world_rank(), ctx.get_world_size()
+        os.environ["MASTER_ADDR"] = addr
+        os.environ["MASTER_PORT"] = str(port)
+        os.environ["RANK"] = str(rank)
+        os.environ["WORLD_SIZE"] = str(world)
+        os.environ["LOCAL_RANK"] = str(ctx.get_local_rank())
+        dist.init_process_group(
+            backend=cfg.backend,
+            init_method=f"tcp://{addr}:{port}",
+            rank=rank, world_size=world,
+            timeout=datetime.timedelta(seconds=cfg.timeout_s))
+        try:
+            if wants_config:
+                return train_fn(config if config is not None else {})
+            return train_fn()
+        finally:
+            try:
+                dist.destroy_process_group()
+            except Exception:
+                pass
+
+    return wrapped
+
+
+class TorchTrainer(JaxTrainer):
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[dict] = None,
+        torch_config: Optional[TorchConfig] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[dict] = None,
+        callbacks: Optional[list] = None,
+    ):
+        super().__init__(
+            _wrap_with_process_group(train_loop_per_worker,
+                                     torch_config or TorchConfig()),
+            train_loop_config=train_loop_config,
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+            callbacks=callbacks,
+        )
+
+
+def prepare_model(model, parallel_strategy: str = "ddp"):
+    """Wrap an nn.Module for data-parallel training (reference:
+    train_loop_utils.py:153-178; fsdp delegated to torch's CPU FSDP)."""
+    import torch.distributed as dist
+
+    if not dist.is_initialized() or dist.get_world_size() == 1:
+        return model
+    if parallel_strategy == "ddp":
+        from torch.nn.parallel import DistributedDataParallel
+
+        return DistributedDataParallel(model)
+    if parallel_strategy == "fsdp":
+        from torch.distributed.fsdp import FullyShardedDataParallel
+
+        return FullyShardedDataParallel(model)
+    raise ValueError(f"unknown parallel_strategy {parallel_strategy!r}")
+
+
+def prepare_data_loader(loader):
+    """Shard a DataLoader across ranks with a DistributedSampler."""
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader
+    from torch.utils.data.distributed import DistributedSampler
+
+    if not dist.is_initialized() or dist.get_world_size() == 1:
+        return loader
+    sampler = DistributedSampler(loader.dataset)
+    return DataLoader(
+        loader.dataset, batch_size=loader.batch_size, sampler=sampler,
+        num_workers=0, collate_fn=loader.collate_fn,
+        drop_last=loader.drop_last)

@@ -25,7 +25,7 @@ class ClientServer:
     to the cluster (ray_tpu.init done)."""
 
     def __init__(self, host: str = "0.0.0.0", port: int = 0):
-        if worker_mod.global_worker() is None:
+        if worker_mod.global_worker_or_none() is None:
             raise RuntimeError("ClientServer requires ray_tpu.init() first")
         self._listener = protocol.listener_tcp(host, port)
         self.port = self._listener.getsockname()[1]
