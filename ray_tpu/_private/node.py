@@ -98,6 +98,9 @@ class Node:
             socket_path=os.path.join(self.session_dir, "store.sock"),
             shm_name=shm_name,
             capacity=capacity,
+            # memory pressure spills sealed objects to disk instead of
+            # dropping them (reference: object spilling, SURVEY §2.1)
+            spill_dir=os.path.join(self.session_dir, "spill"),
         )
         sched_socket = os.path.join(self.session_dir, "sched.sock")
         if head:

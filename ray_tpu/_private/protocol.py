@@ -4,16 +4,40 @@ Control-plane counterpart of the reference's gRPC wrappers
 (/root/reference/src/ray/rpc/) scaled to the in-node runtime: messages are
 pickled dicts with a 4-byte length prefix.  The data plane never flows through
 here — objects move via the shared-memory store (store_client.py).
+
+Fault injection (reference: RAY_testing_rpc_failure, src/ray/rpc/
+rpc_chaos.h:23): set ``RTPU_TESTING_RPC_FAILURE="<send%>:<recv%>"`` (e.g.
+"5:5") and that percentage of sends/receives raises ConnectionResetError at
+this layer — exercising every retry/failover path without killing
+processes. Inherited by workers via the environment, so one env var chaoses
+the whole cluster.
 """
 
 from __future__ import annotations
 
+import os
 import pickle
+import random
 import socket
 import struct
 import threading
 
 _LEN = struct.Struct("<I")
+
+
+def _chaos_rates() -> tuple[float, float]:
+    spec = os.environ.get("RTPU_TESTING_RPC_FAILURE", "")
+    if not spec:
+        return (0.0, 0.0)
+    try:
+        send_s, _, recv_s = spec.partition(":")
+        return (float(send_s or 0) / 100.0, float(recv_s or 0) / 100.0)
+    except ValueError:
+        return (0.0, 0.0)
+
+
+_CHAOS_SEND, _CHAOS_RECV = _chaos_rates()
+_chaos_rng = random.Random(os.environ.get("RTPU_TESTING_RPC_SEED"))
 
 
 class Connection:
@@ -24,6 +48,8 @@ class Connection:
         self._send_lock = threading.Lock()
 
     def send(self, msg: dict):
+        if _CHAOS_SEND and _chaos_rng.random() < _CHAOS_SEND:
+            raise ConnectionResetError("rpc chaos: injected send failure")
         data = pickle.dumps(msg, protocol=5)
         frame = _LEN.pack(len(data)) + data
         with self._send_lock:
@@ -31,6 +57,10 @@ class Connection:
 
     def recv(self) -> dict | None:
         """Receive one message; None on clean EOF."""
+        if _CHAOS_RECV and _chaos_rng.random() < _CHAOS_RECV:
+            # raise (not clean-EOF None): dispatch loops must hit their
+            # error/crash-recovery paths, not their graceful-shutdown path
+            raise ConnectionResetError("rpc chaos: injected recv failure")
         header = self._recv_exact(_LEN.size)
         if header is None:
             return None

@@ -53,12 +53,18 @@ class ObjectEvictedError(Exception):
 class StoreServer:
     """Owns the store daemon process for a node."""
 
-    def __init__(self, socket_path: str, shm_name: str, capacity: int):
+    def __init__(self, socket_path: str, shm_name: str, capacity: int,
+                 spill_dir: str = ""):
         self.socket_path = socket_path
         self.shm_name = shm_name
         self.capacity = capacity
+        self.spill_dir = spill_dir
+        args = [binary_path("shm_store"), socket_path, shm_name,
+                str(capacity)]
+        if spill_dir:
+            args.append(spill_dir)
         self._proc = subprocess.Popen(
-            [binary_path("shm_store"), socket_path, shm_name, str(capacity)],
+            args,
             stdout=subprocess.PIPE,
         )
         line = self._proc.stdout.readline()

@@ -61,7 +61,13 @@ def _dag_actor_loop(instance, method_name: str,
                         kwargs[k] = val
                 except ChannelClosed:
                     for ch in out_channels:
-                        ch.write(STOP)
+                        try:
+                            # bounded: a dead downstream with a full ring
+                            # must not wedge this thread forever (cleanup
+                            # below still has to run)
+                            ch.write(STOP, timeout=5.0)
+                        except Exception:
+                            pass
                     # reader-side shm cleanup: the driver can only unlink
                     # segments on ITS host, so each loop reclaims its own
                     # node's in-edges (unlink keeps live mappings valid)

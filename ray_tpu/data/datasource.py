@@ -166,10 +166,21 @@ def image_tasks(paths, parallelism: int, size=None, mode: str = "RGB",
     """
     files = expand_paths(paths, IMAGE_SUFFIXES)
 
+    # The shape check must span ALL files (groups run in different worker
+    # processes): probe the first file's header on the driver and hold
+    # every group to that expectation.
+    expected_shape = None
+    if size is None and files:
+        from PIL import Image
+
+        with Image.open(files[0]) as probe:
+            w, h = probe.size
+        n_ch = len((mode or "RGB"))  # "RGB"->3, "L"->1, "RGBA"->4
+        expected_shape = (h, w, n_ch) if n_ch > 1 else (h, w)
+
     def read_group(group: List[str]) -> Iterator[Block]:
         from PIL import Image
 
-        seen_shape = None
         for f in group:
             img = Image.open(f)
             if mode:
@@ -177,14 +188,11 @@ def image_tasks(paths, parallelism: int, size=None, mode: str = "RGB",
             if size is not None:
                 img = img.resize(tuple(size))
             arr = np.asarray(img)
-            if size is None:
-                if seen_shape is None:
-                    seen_shape = arr.shape
-                elif arr.shape != seen_shape:
-                    raise ValueError(
-                        f"read_images: mixed image shapes {seen_shape} vs "
-                        f"{arr.shape} ({f}); pass size=(w, h) to resize to "
-                        f"a common resolution")
+            if expected_shape is not None and arr.shape != expected_shape:
+                raise ValueError(
+                    f"read_images: mixed image shapes {expected_shape} vs "
+                    f"{arr.shape} ({f}); pass size=(w, h) to resize to "
+                    f"a common resolution")
             batch: Dict[str, Any] = {"image": arr[None]}
             if include_paths:
                 batch["path"] = np.array([f])

@@ -70,6 +70,9 @@ struct IdHash {
   }
 };
 
+bool ReadFull(int fd, void* buf, size_t n);
+bool WriteFull(int fd, const void* buf, size_t n);
+
 struct ObjectEntry {
   uint64_t offset = 0;
   uint64_t size = 0;
@@ -138,12 +141,20 @@ class FreeListAllocator {
 
 class Store {
  public:
-  Store(uint64_t capacity) : alloc_(capacity) {}
+  // base: the daemon's own mapping of the segment (spill IO); spill_dir:
+  // empty string disables spilling (eviction then drops data, pre-spill
+  // behavior). Reference: plasma fallback allocation + the raylet's
+  // LocalObjectManager::SpillObjects (local_object_manager.h:112) — here
+  // spill/restore live inside the store daemon itself, so clients need no
+  // protocol change: a Get on a spilled object transparently restores it.
+  Store(uint64_t capacity, uint8_t* base, std::string spill_dir)
+      : alloc_(capacity), base_(base), spill_dir_(std::move(spill_dir)) {}
 
   uint8_t Create(const ObjectId& id, uint64_t size, uint64_t* offset) {
     std::unique_lock<std::mutex> lk(mu_);
     if (objects_.count(id)) return ST_EXISTS;
     evicted_.erase(id);  // recreation (e.g. task retry) clears the tombstone
+    DropSpilledLocked(id);  // recreation supersedes a spilled copy
     uint64_t off;
     while (!alloc_.Alloc(size, &off)) {
       if (!EvictOneLocked()) return ST_OOM;
@@ -173,6 +184,11 @@ class Store {
     auto deadline = std::chrono::steady_clock::now() +
                     std::chrono::milliseconds(timeout_ms);
     for (;;) {
+      // spilled copy: restore into shm (may spill others to make room)
+      if (objects_.find(id) == objects_.end() && spilled_.count(id)) {
+        uint8_t rc = RestoreLocked(id);
+        if (rc != ST_OK) return rc;
+      }
       if (evicted_.count(id)) return ST_EVICTED;
       auto it = objects_.find(id);
       if (it != objects_.end() && it->second.sealed) {
@@ -202,6 +218,14 @@ class Store {
 
   uint8_t Delete(const ObjectId& id) {
     std::unique_lock<std::mutex> lk(mu_);
+    if (objects_.find(id) == objects_.end() && spilled_.count(id)) {
+      // spilled-only copy: tombstone so waiters fail fast, like the
+      // resident-delete path below
+      DropSpilledLocked(id);
+      RecordEvictedLocked(id);
+      return ST_OK;
+    }
+    DropSpilledLocked(id);
     auto it = objects_.find(id);
     if (it == objects_.end()) return ST_NOT_FOUND;
     if (it->second.in_lru) lru_.erase(it->second.lru_it);
@@ -225,7 +249,15 @@ class Store {
   uint8_t Contains(const ObjectId& id, uint64_t* sealed, uint64_t* size) {
     std::unique_lock<std::mutex> lk(mu_);
     auto it = objects_.find(id);
-    if (it == objects_.end()) return ST_NOT_FOUND;
+    if (it == objects_.end()) {
+      auto sp = spilled_.find(id);
+      if (sp != spilled_.end()) {  // spilled objects are still "present"
+        *sealed = 1;
+        *size = sp->second;
+        return ST_OK;
+      }
+      return ST_NOT_FOUND;
+    }
     *sealed = it->second.sealed ? 1 : 0;
     *size = it->second.size;
     return ST_OK;
@@ -253,11 +285,82 @@ class Store {
     lru_.pop_front();
     auto it = objects_.find(victim);
     if (it != objects_.end()) {
+      it->second.in_lru = false;
+      if (!spill_dir_.empty() && SpillLocked(victim, it->second)) {
+        // data preserved on disk; a later Get restores transparently
+        alloc_.Free(it->second.offset);
+        objects_.erase(it);
+        return true;
+      }
       alloc_.Free(it->second.offset);
       objects_.erase(it);
       RecordEvictedLocked(victim);
     }
     return true;
+  }
+
+  static std::string HexId(const ObjectId& id) {
+    static const char* kHex = "0123456789abcdef";
+    std::string out;
+    out.reserve(kIdLen * 2);
+    for (uint8_t b : id) {
+      out.push_back(kHex[b >> 4]);
+      out.push_back(kHex[b & 0xf]);
+    }
+    return out;
+  }
+
+  std::string SpillPath(const ObjectId& id) const {
+    return spill_dir_ + "/" + HexId(id);
+  }
+
+  // Disk IO under the store mutex: eviction is already the slow path, and
+  // serializing spills keeps restore/create races trivially correct.
+  bool SpillLocked(const ObjectId& id, const ObjectEntry& e) {
+    std::string path = SpillPath(id);
+    int fd = open(path.c_str(), O_CREAT | O_TRUNC | O_WRONLY, 0600);
+    if (fd < 0) return false;
+    bool ok = WriteFull(fd, base_ + e.offset, e.size);
+    close(fd);
+    if (!ok) {
+      unlink(path.c_str());
+      return false;  // disk full: fall through to lossy eviction
+    }
+    spilled_[id] = e.size;
+    return true;
+  }
+
+  uint8_t RestoreLocked(const ObjectId& id) {
+    uint64_t size = spilled_[id];
+    uint64_t off;
+    while (!alloc_.Alloc(size, &off)) {
+      if (!EvictOneLocked()) return ST_OOM;
+    }
+    std::string path = SpillPath(id);
+    int fd = open(path.c_str(), O_RDONLY);
+    bool ok = fd >= 0 && ReadFull(fd, base_ + off, size);
+    if (fd >= 0) close(fd);
+    if (!ok) {
+      alloc_.Free(off);
+      DropSpilledLocked(id);
+      RecordEvictedLocked(id);  // spill file lost: surface as evicted
+      return ST_EVICTED;
+    }
+    ObjectEntry e;
+    e.offset = off;
+    e.size = size;
+    e.sealed = true;
+    e.refcount = 0;  // Get's fast path takes the caller's ref
+    objects_[id] = e;
+    DropSpilledLocked(id);
+    return ST_OK;
+  }
+
+  void DropSpilledLocked(const ObjectId& id) {
+    auto it = spilled_.find(id);
+    if (it == spilled_.end()) return;
+    spilled_.erase(it);
+    unlink(SpillPath(id).c_str());
   }
 
   // Bounded tombstone set so a GET on an evicted object fails fast with
@@ -276,7 +379,10 @@ class Store {
   std::mutex mu_;
   std::condition_variable cv_;
   FreeListAllocator alloc_;
+  uint8_t* base_;            // daemon-side mapping (spill/restore IO)
+  std::string spill_dir_;    // empty = spilling disabled
   std::unordered_map<ObjectId, ObjectEntry, IdHash> objects_;
+  std::unordered_map<ObjectId, uint64_t, IdHash> spilled_;  // id -> size
   std::list<ObjectId> lru_;  // sealed, refcount==0, eviction candidates
   std::unordered_set<ObjectId, IdHash> evicted_;
   std::deque<ObjectId> evicted_order_;
@@ -412,8 +518,10 @@ void ServeClient(Store* store, int fd) {
 }  // namespace
 
 int main(int argc, char** argv) {
-  if (argc != 4) {
-    fprintf(stderr, "usage: %s <socket_path> <shm_name> <capacity_bytes>\n",
+  if (argc != 4 && argc != 5) {
+    fprintf(stderr,
+            "usage: %s <socket_path> <shm_name> <capacity_bytes> "
+            "[spill_dir]\n",
             argv[0]);
     return 2;
   }
@@ -421,6 +529,7 @@ int main(int argc, char** argv) {
   const char* sock_path = argv[1];
   const char* shm_name = argv[2];
   uint64_t capacity = strtoull(argv[3], nullptr, 10);
+  std::string spill_dir = argc == 5 ? argv[4] : "";
 
   // Create + size the shared memory segment.
   shm_unlink(shm_name);
@@ -433,9 +542,20 @@ int main(int argc, char** argv) {
     perror("ftruncate");
     return 1;
   }
-  close(shm_fd);  // clients map by name; server needs no mapping
+  // The daemon maps the segment too: spilling reads object bytes out and
+  // restore writes them back (clients still address by offset).
+  void* base = mmap(nullptr, capacity, PROT_READ | PROT_WRITE, MAP_SHARED,
+                    shm_fd, 0);
+  close(shm_fd);
+  if (base == MAP_FAILED) {
+    perror("mmap");
+    return 1;
+  }
+  if (!spill_dir.empty()) {
+    mkdir(spill_dir.c_str(), 0700);  // EEXIST fine
+  }
 
-  Store store(capacity);
+  Store store(capacity, static_cast<uint8_t*>(base), spill_dir);
 
   unlink(sock_path);
   int srv = socket(AF_UNIX, SOCK_STREAM, 0);

@@ -144,15 +144,21 @@ def prepare_model(model, parallel_strategy: str = "ddp"):
 
 
 def prepare_data_loader(loader):
-    """Shard a DataLoader across ranks with a DistributedSampler."""
+    """Shard a DataLoader across ranks with a DistributedSampler.
+
+    Preserves the loader's shuffle intent, num_workers, pin_memory,
+    collate_fn, and drop_last. For per-epoch reshuffling call
+    ``loader.sampler.set_epoch(epoch)`` each epoch (reference semantics).
+    """
     import torch.distributed as dist
-    from torch.utils.data import DataLoader
+    from torch.utils.data import DataLoader, RandomSampler
     from torch.utils.data.distributed import DistributedSampler
 
     if not dist.is_initialized() or dist.get_world_size() == 1:
         return loader
-    sampler = DistributedSampler(loader.dataset)
+    was_shuffling = isinstance(loader.sampler, RandomSampler)
+    sampler = DistributedSampler(loader.dataset, shuffle=was_shuffling)
     return DataLoader(
         loader.dataset, batch_size=loader.batch_size, sampler=sampler,
-        num_workers=0, collate_fn=loader.collate_fn,
-        drop_last=loader.drop_last)
+        num_workers=loader.num_workers, pin_memory=loader.pin_memory,
+        collate_fn=loader.collate_fn, drop_last=loader.drop_last)

@@ -1,0 +1,44 @@
+"""RPC chaos: injected transport failures must be survivable.
+
+Mirrors the reference's RAY_testing_rpc_failure chaos flag
+(src/ray/rpc/rpc_chaos.h + python/ray/tests/chaos/): a cluster run with a
+failure rate on the framed-protocol layer still completes work through
+retries and worker replacement.
+"""
+
+import subprocess
+import sys
+import textwrap
+
+
+def test_tasks_survive_rpc_chaos():
+    script = textwrap.dedent("""
+        import collections
+        import ray_tpu
+
+        ray_tpu.init(min_workers=2, max_workers=6,
+                     resources={"CPU": 8.0}, object_store_memory=1 << 27)
+
+        @ray_tpu.remote
+        def work(x):
+            return x * 2
+
+        refs = [work.options(max_retries=20).remote(i) for i in range(40)]
+        got = ray_tpu.get(refs, timeout=240)
+        assert got == [i * 2 for i in range(40)], got
+        print("CHAOS SURVIVED")
+        ray_tpu.shutdown()
+    """)
+    env = {
+        "RTPU_TESTING_RPC_FAILURE": "2:0",  # 2% of sends fail
+        "RTPU_TESTING_RPC_SEED": "7",
+        "JAX_PLATFORMS": "cpu",
+        "PATH": "/usr/bin:/bin:/usr/local/bin",
+        "PYTHONPATH": ".",
+        "HOME": "/root",
+    }
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=400,
+                          env=env, cwd="/root/repo")
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "CHAOS SURVIVED" in proc.stdout
