@@ -332,6 +332,12 @@ class Dataset:
     def iter_torch_batches(self, **kw) -> Iterator[Any]:
         return self.iterator().iter_torch_batches(**kw)
 
+    def iter_tf_batches(self, **kw) -> Iterator[Any]:
+        return self.iterator().iter_tf_batches(**kw)
+
+    def to_tf(self, feature_columns, label_columns, **kw):
+        return self.iterator().to_tf(feature_columns, label_columns, **kw)
+
     def streaming_split(self, n: int, *, equal: bool = False,
                         locality_hints=None) -> List[DataIterator]:
         """Reference: dataset.py:1731 — a coordinator actor executes the plan

@@ -174,9 +174,9 @@ def image_tasks(paths, parallelism: int, size=None, mode: str = "RGB",
         from PIL import Image
 
         with Image.open(files[0]) as probe:
-            w, h = probe.size
-        n_ch = len((mode or "RGB"))  # "RGB"->3, "L"->1, "RGBA"->4
-        expected_shape = (h, w, n_ch) if n_ch > 1 else (h, w)
+            if mode:
+                probe = probe.convert(mode)
+            expected_shape = np.asarray(probe).shape
 
     def read_group(group: List[str]) -> Iterator[Block]:
         from PIL import Image

@@ -225,7 +225,7 @@ class Store {
       RecordEvictedLocked(id);
       return ST_OK;
     }
-    DropSpilledLocked(id);
+    // (no DropSpilled here: an id is never resident AND spilled at once)
     auto it = objects_.find(id);
     if (it == objects_.end()) return ST_NOT_FOUND;
     if (it->second.in_lru) lru_.erase(it->second.lru_it);

@@ -9,6 +9,7 @@ autoscaling follows replica queue lengths.
 from ray_tpu.serve.api import (
     delete,
     get_app_handle,
+    grpc_port,
     http_port,
     run,
     shutdown,
@@ -32,6 +33,7 @@ __all__ = [
     "get_app_handle",
     "get_multiplexed_model_id",
     "multiplexed",
+    "grpc_port",
     "http_port",
     "run",
     "shutdown",

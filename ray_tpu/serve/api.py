@@ -16,6 +16,7 @@ from ray_tpu.serve.handle import CONTROLLER_NAME, DeploymentHandle
 from ray_tpu.serve.proxy import ProxyActor
 
 _PROXY_NAME = "SERVE_PROXY"
+_GRPC_PROXY_NAME = "SERVE_GRPC_PROXY"
 
 
 def _get_or_create_named(name: str, ping, create):
@@ -58,8 +59,9 @@ def _get_or_create_controller():
 
 
 def start(http_host: str = "127.0.0.1", http_port: int = 0,
-          proxy: bool = True):
-    """Start Serve system actors (controller + HTTP proxy)."""
+          proxy: bool = True, grpc_port: Optional[int] = None):
+    """Start Serve system actors (controller + HTTP proxy [+ gRPC proxy
+    when grpc_port is given; 0 = ephemeral])."""
     controller = _get_or_create_controller()
     if proxy:
         p = _get_or_create_named(
@@ -72,7 +74,23 @@ def start(http_host: str = "127.0.0.1", http_port: int = 0,
         # after a shutdown that left the proxy alive) and not know the port
         port = ray_tpu.get(p.get_port.remote(), timeout=60)
         ray_tpu.get(controller.set_http_port.remote(port), timeout=30)
+    if grpc_port is not None:
+        from ray_tpu.serve.grpc_proxy import GrpcProxyActor
+
+        g = _get_or_create_named(
+            _GRPC_PROXY_NAME,
+            ping=lambda pr: ray_tpu.get(pr.get_port.remote(), timeout=10),
+            create=lambda: ray_tpu.remote(GrpcProxyActor).options(
+                name=_GRPC_PROXY_NAME, max_concurrency=16).remote(
+                http_host, grpc_port))
+        ray_tpu.get(g.ready.remote(), timeout=60)
     return controller
+
+
+def grpc_port() -> int:
+    """Port of the running gRPC proxy (start(grpc_port=...) first)."""
+    p = ray_tpu.get_actor(_GRPC_PROXY_NAME)
+    return ray_tpu.get(p.get_port.remote(), timeout=30)
 
 
 def run(app: Application, *, name: str = "default",
@@ -145,14 +163,14 @@ def shutdown():
         ray_tpu.get(controller.shutdown.remote(), timeout=60)
     except Exception:
         pass
-    for actor_name in (_PROXY_NAME, CONTROLLER_NAME):
+    for actor_name in (_PROXY_NAME, _GRPC_PROXY_NAME, CONTROLLER_NAME):
         try:
             ray_tpu.kill(ray_tpu.get_actor(actor_name))
         except Exception:
             pass
     # kill is async; wait for the names to clear so a subsequent
     # serve.start() cannot resolve a dying controller/proxy
-    for actor_name in (_PROXY_NAME, CONTROLLER_NAME):
+    for actor_name in (_PROXY_NAME, _GRPC_PROXY_NAME, CONTROLLER_NAME):
         deadline = time.monotonic() + 10.0
         while time.monotonic() < deadline:
             try:

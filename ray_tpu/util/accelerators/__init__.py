@@ -1,0 +1,12 @@
+"""TPU topology helpers (``ray_tpu.util.accelerators.tpu``).
+
+Counterpart of /root/reference/python/ray/util/accelerators/tpu.py (pod
+helpers :7,:21) and the topology knowledge in
+_private/accelerators/tpu.py:15-61 — written fresh from TPU generation
+facts: chips per host and slice-shape math feed the scheduler's
+ICI-aware gang placement (SURVEY §7).
+"""
+
+from ray_tpu.util.accelerators import tpu
+
+__all__ = ["tpu"]

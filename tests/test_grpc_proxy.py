@@ -1,0 +1,52 @@
+"""gRPC ingress proxy: generic JSON-over-gRPC dispatch to serve apps.
+
+Mirrors /root/reference/python/ray/serve/tests/test_grpc.py in shape.
+"""
+
+import json
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+
+@pytest.fixture(scope="module")
+def cluster(ray_cluster):
+    return ray_cluster
+
+
+def _call(port: int, app: str, payload) -> bytes:
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    try:
+        rpc = channel.unary_unary(
+            f"/rtpu.Serve/{app}",
+            request_serializer=None,
+            response_deserializer=None)
+        return rpc(json.dumps(payload).encode(), timeout=60)
+    finally:
+        channel.close()
+
+
+def test_grpc_dispatch_and_errors(cluster):
+    import ray_tpu.serve as serve
+
+    @serve.deployment
+    class Sq:
+        def __call__(self, body):
+            return {"squared": body["n"] ** 2}
+
+    serve.start(grpc_port=0)
+    serve.run(Sq.bind(), name="grpc_app", route_prefix="/grpc")
+    try:
+        port = serve.grpc_port()
+        out = json.loads(_call(port, "grpc_app", {"n": 7}))
+        assert out == {"squared": 49}
+
+        routes = json.loads(_call(port, "__routes__", None))
+        assert routes.get("grpc_app") == "/grpc"
+
+        with pytest.raises(grpc.RpcError) as err:
+            _call(port, "nope_app", {})
+        assert err.value.code() == grpc.StatusCode.NOT_FOUND
+    finally:
+        serve.delete("grpc_app")

@@ -1,0 +1,53 @@
+"""iter_tf_batches / to_tf + TPU topology helpers."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def cluster(ray_cluster):
+    return ray_cluster
+
+
+def test_tf_batches_and_to_tf(cluster):
+    tf = pytest.importorskip("tensorflow")
+
+    from ray_tpu import data
+
+    ds = data.from_items([
+        {"x": np.array([float(i), float(2 * i)], np.float32),
+         "y": float(3 * i)} for i in range(16)])
+
+    batches = list(ds.iter_tf_batches(batch_size=8))
+    assert len(batches) >= 2
+    assert batches[0]["x"].shape[1] == 2
+    assert batches[0]["x"].dtype == tf.float32
+
+    tfds = ds.to_tf("x", "y", batch_size=8)
+    feats, labels = next(iter(tfds))
+    assert feats.shape[1] == 2 and labels.shape[0] == feats.shape[0]
+    # a keras-style consumption pass over the whole dataset works
+    total = sum(int(lab.shape[0]) for _, lab in tfds)
+    assert total == 16
+
+
+def test_tpu_topology_helpers(monkeypatch):
+    from ray_tpu.util.accelerators import tpu
+
+    assert tpu.parse_accelerator_type("v5litepod-16") == ("v5litepod", 16)
+    assert tpu.num_chips_per_host("v5litepod") == 8
+    assert tpu.num_chips_per_host("v4-32") == 4
+    # v5e counts are chips; v2-v5p counts are TENSORCORES (2/chip)
+    assert tpu.chips_in_slice("v5litepod-16") == 16
+    assert tpu.chips_in_slice("v4-16") == 8
+    assert tpu.num_hosts_in_slice("v5litepod-16") == 2
+    assert tpu.num_hosts_in_slice("v4-16") == 2
+    assert tpu.num_hosts_in_slice("v4-8") == 1
+    assert tpu.pod_head_resource("v6e-64") == "TPU-v6e-head"
+    with pytest.raises(ValueError, match="invalid TPU accelerator"):
+        tpu.parse_accelerator_type("h100-8")
+
+    monkeypatch.setenv("TPU_NAME", "my-slice")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1,h2,h3")
+    assert tpu.get_current_pod_name() == "my-slice"
+    assert tpu.get_current_pod_worker_count() == 4
