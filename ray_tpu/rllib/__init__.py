@@ -6,9 +6,10 @@ replay buffers, and jitted JAX learners (module.py, env_runner.py, ppo.py,
 dqn.py, replay_buffers.py).
 """
 
-from ray_tpu.rllib.bc import BC, BCConfig
+from ray_tpu.rllib.bc import BC, BCConfig, MARWILConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.module import MLPConfig, forward, greedy_action, init_mlp
 from ray_tpu.rllib.ppo import PPO, PPOConfig, compute_gae
 from ray_tpu.rllib.replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
@@ -16,9 +17,12 @@ from ray_tpu.rllib.replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
 __all__ = [
     "BC",
     "BCConfig",
+    "MARWILConfig",
     "DQN",
     "DQNConfig",
     "EnvRunner",
+    "IMPALA",
+    "IMPALAConfig",
     "MLPConfig",
     "PPO",
     "PrioritizedReplayBuffer",

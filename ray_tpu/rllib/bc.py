@@ -34,24 +34,45 @@ class BCConfig:
     grad_clip: float = 10.0
     seed: int = 0
     # offline input: a ray_tpu.data Dataset with "obs" and "actions"
+    # (+ "returns" when beta > 0)
     input_dataset: Any = None
+    # MARWIL advantage temperature; 0 = plain behavior cloning
+    beta: float = 0.0
+    vf_coeff: float = 1.0
 
     def build(self) -> "BC":
         return BC(self)
 
 
-@partial(jax.jit, static_argnames=("lr", "grad_clip"))
-def _bc_update(params, opt_state, obs, actions, *, lr, grad_clip):
+def MARWILConfig(**kwargs) -> "BCConfig":
+    """Reference: rllib/algorithms/marwil — BC with exponential advantage
+    weighting; beta defaults to 1."""
+    kwargs.setdefault("beta", 1.0)
+    return BCConfig(**kwargs)
+
+
+@partial(jax.jit, static_argnames=("lr", "grad_clip", "beta", "vf_coeff"))
+def _bc_update(params, opt_state, obs, actions, returns, *, lr, grad_clip,
+               beta, vf_coeff):
+    """beta=0: plain BC. beta>0: MARWIL — imitation weighted by
+    exp(beta * advantage) with a learned value baseline (reference:
+    rllib/algorithms/marwil)."""
     import optax
 
     tx = optax.chain(optax.clip_by_global_norm(grad_clip), optax.adam(lr))
 
     def loss_fn(p):
-        logits, _ = module_mod.forward(p, obs)
+        logits, values = module_mod.forward(p, obs)
         logp = jax.nn.log_softmax(logits)
         nll = -jnp.take_along_axis(
             logp, actions[:, None].astype(jnp.int32), axis=1)[:, 0]
-        return nll.mean()
+        if beta == 0.0:
+            return nll.mean()
+        adv = returns - values
+        weights = jax.lax.stop_gradient(
+            jnp.clip(jnp.exp(beta * adv), 0.0, 20.0))
+        vf_loss = jnp.mean(adv ** 2)
+        return jnp.mean(weights * nll) + vf_coeff * vf_loss
 
     loss, grads = jax.value_and_grad(loss_fn)(params)
     updates, opt_state = tx.update(grads, opt_state, params)
@@ -89,9 +110,16 @@ class BC:
                                    for o in obs_np])
             obs = jnp.asarray(obs_np.astype(np.float32))
             actions = jnp.asarray(np.asarray(batch["actions"], np.int32))
+            if c.beta > 0.0 and "returns" not in batch:
+                raise ValueError(
+                    "MARWIL (beta > 0) needs a 'returns' column in the "
+                    "offline dataset")
+            returns = jnp.asarray(np.asarray(
+                batch.get("returns", np.zeros(len(actions))), np.float32))
             self.params, self.opt_state, loss = _bc_update(
-                self.params, self.opt_state, obs, actions,
-                lr=c.lr, grad_clip=c.grad_clip)
+                self.params, self.opt_state, obs, actions, returns,
+                lr=c.lr, grad_clip=c.grad_clip, beta=c.beta,
+                vf_coeff=c.vf_coeff)
             losses.append(float(loss))
             n += len(actions)
         self._iter += 1

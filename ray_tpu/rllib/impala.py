@@ -1,0 +1,225 @@
+"""IMPALA: async sampling actors + V-trace off-policy jitted learner.
+
+Counterpart of /root/reference/rllib/algorithms/impala/ (the importance-
+weighted actor-learner architecture): env-runner actors sample with a
+stale behavior policy while the learner updates continuously; the lag is
+corrected with V-trace (Espeholt et al. 2018). TPU-shaping: the whole
+V-trace recursion is a reversed ``lax.scan`` inside ONE jitted update over
+fixed [T, B] shapes — no per-step host math — and sampling overlaps
+learning through ``ray_tpu.wait`` on in-flight rollout futures (the
+reference's aggregation workers collapse into the object store).
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib import module as module_mod
+from ray_tpu.rllib.env_runner import EnvRunner
+
+
+@dataclass
+class IMPALAConfig:
+    """Reference: rllib/algorithms/impala/impala.py IMPALAConfig."""
+
+    env: Union[str, Callable] = "CartPole-v1"
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 4
+    rollout_fragment_length: int = 64
+    gamma: float = 0.99
+    lr: float = 5e-4
+    grad_clip: float = 40.0
+    vf_loss_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    # V-trace clipping (rho_bar governs the value target bias, c_bar the
+    # trace cutting; 1.0/1.0 are the paper's defaults)
+    vtrace_rho_clip: float = 1.0
+    vtrace_c_clip: float = 1.0
+    # how many rollout futures to keep in flight per runner
+    max_requests_in_flight: int = 2
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "IMPALA":
+        return IMPALA(self)
+
+
+@partial(jax.jit, static_argnames=(
+    "lr", "grad_clip", "gamma", "rho_clip", "c_clip", "vf_coeff",
+    "ent_coeff"))
+def _impala_update(params, opt_state, batch, *, lr, grad_clip, gamma,
+                   rho_clip, c_clip, vf_coeff, ent_coeff):
+    import optax
+
+    tx = optax.chain(optax.clip_by_global_norm(grad_clip), optax.adam(lr))
+
+    def loss_fn(p):
+        T, B = batch["actions"].shape
+        obs_flat = batch["obs"].reshape(T * B, -1)
+        logits, values = module_mod.forward(p, obs_flat)
+        logits = logits.reshape(T, B, -1)
+        values = values.reshape(T, B)
+        _, last_value = module_mod.forward(p, batch["last_obs"])  # [B]
+
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None].astype(jnp.int32),
+            axis=-1)[..., 0]                                   # [T, B]
+        # importance ratios vs the BEHAVIOR policy that sampled
+        rhos = jnp.exp(logp - batch["behavior_logp"])
+        clipped_rho = jnp.minimum(rho_clip, rhos)
+        clipped_c = jnp.minimum(c_clip, rhos)
+
+        discounts = gamma * (1.0 - batch["dones"])             # [T, B]
+        values_tp1 = jnp.concatenate(
+            [values[1:], last_value[None]], axis=0)
+        deltas = clipped_rho * (
+            batch["rewards"] + discounts * values_tp1 - values)
+
+        # vs_t - V(s_t) via reversed scan:
+        #   acc_t = delta_t + discount_t * c_t * acc_{t+1}
+        def back(acc, inp):
+            delta_t, disc_t, c_t = inp
+            acc = delta_t + disc_t * c_t * acc
+            return acc, acc
+
+        _, vs_minus_v = jax.lax.scan(
+            back, jnp.zeros_like(last_value),
+            (deltas, discounts, clipped_c), reverse=True)
+        vs = jax.lax.stop_gradient(vs_minus_v + values)
+        vs_tp1 = jnp.concatenate([vs[1:], last_value[None]], axis=0)
+        pg_adv = jax.lax.stop_gradient(
+            clipped_rho * (batch["rewards"] + discounts * vs_tp1 - values))
+
+        pg_loss = -jnp.mean(logp * pg_adv)
+        vf_loss = 0.5 * jnp.mean((vs - values) ** 2)
+        entropy = -jnp.mean(
+            jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        loss = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
+        return loss, (pg_loss, vf_loss, entropy, jnp.mean(rhos))
+
+    (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss, aux
+
+
+class IMPALA:
+    """Tune-compatible trainable: train() -> result dict."""
+
+    def __init__(self, config: IMPALAConfig):
+        import optax
+
+        self.config = config
+        RunnerActor = ray_tpu.remote(EnvRunner)
+        self._runners = [
+            RunnerActor.remote(config.env, config.num_envs_per_runner,
+                               seed=config.seed + 1000 * i)
+            for i in range(config.num_env_runners)
+        ]
+        spec = ray_tpu.get(self._runners[0].env_spec.remote())
+        mcfg = module_mod.MLPConfig(
+            obs_dim=spec["obs_dim"], n_actions=spec["n_actions"],
+            hidden=config.hidden)
+        self.params = module_mod.init_mlp(
+            mcfg, jax.random.PRNGKey(config.seed))
+        tx = optax.chain(optax.clip_by_global_norm(config.grad_clip),
+                         optax.adam(config.lr))
+        self.opt_state = tx.init(self.params)
+        self._iter = 0
+        self._env_steps = 0
+        # async pipeline: rollout futures in flight per runner (sampled
+        # with whatever params the runner had when the task was submitted
+        # — V-trace corrects the staleness)
+        self._inflight: Dict[Any, Any] = {}
+        for r in self._runners:
+            for _ in range(config.max_requests_in_flight):
+                self._submit(r)
+
+    def _submit(self, runner):
+        ref = runner.sample.remote(self.params,
+                                   self.config.rollout_fragment_length)
+        self._inflight[ref.binary()] = (ref, runner)
+
+    def train(self) -> Dict[str, Any]:
+        c = self.config
+        t0 = time.perf_counter()
+        losses, aux_last = [], None
+        n_batches = max(1, c.num_env_runners)
+        for _ in range(n_batches):
+            refs = [ref for ref, _ in self._inflight.values()]
+            ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=120)
+            if not ready:
+                break
+            ref = ready[0]
+            _, runner = self._inflight.pop(ref.binary())
+            rollout = ray_tpu.get(ref)
+            self._submit(runner)  # keep the pipeline full (async!)
+            batch = {
+                "obs": jnp.asarray(rollout["obs"]),          # [T, n, d]
+                "actions": jnp.asarray(rollout["actions"]),
+                "behavior_logp": jnp.asarray(rollout["logp"]),
+                "rewards": jnp.asarray(
+                    rollout["rewards"]
+                    + c.gamma * rollout["trunc_values"]),
+                "dones": jnp.asarray(rollout["dones"], jnp.float32),
+                "last_obs": jnp.asarray(rollout["last_obs"]),
+            }
+            self.params, self.opt_state, loss, aux = _impala_update(
+                self.params, self.opt_state, batch,
+                lr=c.lr, grad_clip=c.grad_clip, gamma=c.gamma,
+                rho_clip=c.vtrace_rho_clip, c_clip=c.vtrace_c_clip,
+                vf_coeff=c.vf_loss_coeff, ent_coeff=c.entropy_coeff)
+            losses.append(float(loss))
+            aux_last = aux
+            self._env_steps += (c.rollout_fragment_length
+                                * c.num_envs_per_runner)
+
+        metrics = ray_tpu.get(
+            [r.get_metrics.remote() for r in self._runners])
+        returns = [x for m in metrics for x in m["episode_returns"]]
+        self._iter += 1
+        out = {
+            "training_iteration": self._iter,
+            "env_steps_sampled": self._env_steps,
+            "loss": float(np.mean(losses)) if losses else None,
+            "episode_return_mean": (float(np.mean(returns))
+                                    if returns else None),
+            "time_this_iter_s": time.perf_counter() - t0,
+        }
+        if aux_last is not None:
+            pg, vf, ent, rho = aux_last
+            out.update(pg_loss=float(pg), vf_loss=float(vf),
+                       entropy=float(ent), mean_rho=float(rho))
+        return out
+
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            pickle.dump({"params": self.params,
+                         "opt_state": self.opt_state,
+                         "iter": self._iter,
+                         "env_steps": self._env_steps}, f)
+
+    def restore(self, path: str) -> None:
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+        self._iter = state["iter"]
+        self._env_steps = state["env_steps"]
+
+    def stop(self) -> None:
+        for r in self._runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

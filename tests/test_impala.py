@@ -1,0 +1,89 @@
+"""IMPALA: V-trace math sanity + CartPole learning beats random.
+
+Mirrors reference rllib/algorithms/impala tests + utils vtrace tests in
+shape: a numpy reference recursion validates the jitted scan, then a
+short async-pipeline run must learn.
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("gymnasium")
+jax = pytest.importorskip("jax")
+
+
+def test_vtrace_matches_numpy_reference():
+    # The on-policy special case (rhos=1) reduces V-trace to n-step TD.
+    import jax.numpy as jnp
+
+    T, B = 5, 3
+    rng = np.random.default_rng(0)
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    values = rng.normal(size=(T, B)).astype(np.float32)
+    last_value = rng.normal(size=(B,)).astype(np.float32)
+    dones = (rng.random((T, B)) < 0.2).astype(np.float32)
+    gamma = 0.9
+
+    # numpy reference recursion (rho = c = 1)
+    discounts = gamma * (1 - dones)
+    values_tp1 = np.concatenate([values[1:], last_value[None]], axis=0)
+    deltas = rewards + discounts * values_tp1 - values
+    acc = np.zeros(B, np.float32)
+    expect = np.zeros((T, B), np.float32)
+    for t in reversed(range(T)):
+        acc = deltas[t] + discounts[t] * acc
+        expect[t] = acc
+
+    # the jitted scan inside _impala_update uses the same recursion; mirror
+    def back(acc, inp):
+        delta_t, disc_t, c_t = inp
+        acc = delta_t + disc_t * c_t * acc
+        return acc, acc
+
+    _, got = jax.lax.scan(
+        back, jnp.zeros(B),
+        (jnp.asarray(deltas), jnp.asarray(discounts), jnp.ones((T, B))),
+        reverse=True)
+    np.testing.assert_allclose(np.asarray(got), expect, rtol=1e-5)
+
+
+def test_impala_learns_cartpole(ray_cluster):
+    from ray_tpu.rllib import IMPALAConfig
+
+    algo = IMPALAConfig(
+        num_env_runners=2, num_envs_per_runner=4,
+        rollout_fragment_length=64, lr=7e-4, entropy_coeff=0.02,
+        seed=1,
+    ).build()
+    try:
+        best = -np.inf
+        result = None
+        for _ in range(30):
+            result = algo.train()
+            if result["episode_return_mean"]:
+                best = max(best, result["episode_return_mean"])
+        assert result["loss"] is not None
+        assert result["mean_rho"] > 0  # off-policy correction active
+        assert best > 60, f"best return {best}"  # random ~22
+    finally:
+        algo.stop()
+
+
+def test_impala_checkpoint(ray_cluster, tmp_path):
+    from ray_tpu.rllib import IMPALAConfig
+
+    algo = IMPALAConfig(num_env_runners=1, num_envs_per_runner=1,
+                        rollout_fragment_length=8, seed=0).build()
+    try:
+        algo.train()
+        path = str(tmp_path / "impala.pkl")
+        algo.save(path)
+        algo2 = IMPALAConfig(num_env_runners=1, num_envs_per_runner=1,
+                             rollout_fragment_length=8, seed=5).build()
+        try:
+            algo2.restore(path)
+            assert algo2._env_steps == algo._env_steps
+        finally:
+            algo2.stop()
+    finally:
+        algo.stop()
