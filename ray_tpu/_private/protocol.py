@@ -70,6 +70,27 @@ class Connection:
             return None
         return pickle.loads(body)
 
+    def send_bytes(self, data: bytes):
+        """Send one raw frame (no pickling) — pre-auth handshakes."""
+        frame = _LEN.pack(len(data)) + data
+        with self._send_lock:
+            self.sock.sendall(frame)
+
+    def recv_bytes(self, max_len: int = 1 << 16) -> bytes | None:
+        """Receive one raw frame WITHOUT unpickling; None on EOF/oversize.
+
+        The untrusted-peer path: nothing the remote sent is interpreted
+        beyond the length prefix, so it is safe to call before a connection
+        has authenticated.
+        """
+        header = self._recv_exact(_LEN.size)
+        if header is None:
+            return None
+        (length,) = _LEN.unpack(header)
+        if length > max_len:
+            return None
+        return self._recv_exact(length)
+
     def _recv_exact(self, n: int) -> bytes | None:
         buf = b""
         while len(buf) < n:

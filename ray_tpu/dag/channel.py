@@ -43,6 +43,20 @@ class _Stop:
 STOP = _Stop()
 
 
+class _Spill:
+    """Marker streamed through a native ring when the payload was too large
+    and spilled through the object store. A dedicated class (not a dict key)
+    so no user payload can ever be mistaken for it."""
+
+    __slots__ = ("oid",)
+
+    def __init__(self, oid: bytes):
+        self.oid = oid
+
+    def __repr__(self):
+        return f"<dag spill {self.oid.hex()[:8]}>"
+
+
 def _ctx():
     w = worker_mod.global_worker()
     if w is None:
@@ -113,8 +127,7 @@ class Channel:
                 # larger than the ring: spill payload through the store,
                 # stream a small marker so ordering is preserved
                 ref = ctx.put_object(value)
-                self._native().write({"__rtpu_spill__": ref.binary()},
-                                     timeout=timeout)
+                self._native().write(_Spill(ref.binary()), timeout=timeout)
             return
         if self._wseq - self._acked > self.capacity:
             deadline = None if timeout is None else time.monotonic() + timeout
@@ -146,8 +159,8 @@ class Channel:
         ctx = _ctx()
         if self.native:
             value = self._native().read(timeout=timeout)
-            if isinstance(value, dict) and "__rtpu_spill__" in value:
-                oid = value["__rtpu_spill__"]
+            if isinstance(value, _Spill):
+                oid = value.oid
                 value = ctx.get_object(ObjectRef(oid), timeout=timeout)
                 try:
                     ctx.store.delete(oid)

@@ -149,6 +149,20 @@ static int lock_robust(Header* h) {
   return rc;
 }
 
+// pthread_cond_timedwait re-acquires the mutex on return, so the peer dying
+// while we were blocked surfaces as EOWNERDEAD here too — it must be marked
+// consistent exactly like lock_robust, or the next unlock/lock goes
+// ENOTRECOVERABLE and wedges the channel for good.
+static int timedwait_robust(pthread_cond_t* cv, Header* h,
+                            const timespec* ts) {
+  int rc = pthread_cond_timedwait(cv, &h->mu, ts);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&h->mu);
+    rc = 0;
+  }
+  return rc;
+}
+
 // Returns 0 ok, -1 timeout, -2 closed, -3 message larger than ring.
 int mc_write(void* handle, const uint8_t* buf, uint64_t len, int timeout_ms) {
   auto* c = static_cast<Channel*>(handle);
@@ -159,7 +173,7 @@ int mc_write(void* handle, const uint8_t* buf, uint64_t len, int timeout_ms) {
   abs_deadline(&ts, timeout_ms);
   if (lock_robust(h) != 0) return -2;
   while (h->capacity - used(h) < need && !h->closed) {
-    if (pthread_cond_timedwait(&h->nonfull, &h->mu, &ts) == ETIMEDOUT) {
+    if (timedwait_robust(&h->nonfull, h, &ts) == ETIMEDOUT) {
       pthread_mutex_unlock(&h->mu);
       return -1;
     }
@@ -192,7 +206,7 @@ int64_t mc_read(void* handle, uint8_t* out, uint64_t out_cap,
       pthread_mutex_unlock(&h->mu);
       return -2;
     }
-    if (pthread_cond_timedwait(&h->nonempty, &h->mu, &ts) == ETIMEDOUT) {
+    if (timedwait_robust(&h->nonempty, h, &ts) == ETIMEDOUT) {
       pthread_mutex_unlock(&h->mu);
       return -1;
     }

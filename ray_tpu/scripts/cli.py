@@ -196,8 +196,9 @@ def cmd_start(args):
         if args.client_server_port is not None:
             from ray_tpu.util.client import ClientServer
 
-            cs = ClientServer(port=args.client_server_port)
-            print(f"  client server: rtpu://0.0.0.0:{cs.port}", flush=True)
+            cs = ClientServer(host=args.client_server_host,
+                              port=args.client_server_port)
+            print(f"  client server: {cs.address}", flush=True)
     else:
         from ray_tpu._private.node import Node
 
@@ -311,6 +312,10 @@ def main(argv=None):
     sp.add_argument("--client-server-port", type=int, default=None,
                     help="serve remote rtpu:// drivers on this TCP port "
                          "(0 = ephemeral)")
+    sp.add_argument("--client-server-host", default="127.0.0.1",
+                    help="bind interface for the client server (default "
+                         "loopback; 0.0.0.0 exposes it — connections are "
+                         "token-authenticated, see the printed address)")
     sp.set_defaults(fn=cmd_start)
     sp = sub.add_parser("stop")
     sp.set_defaults(fn=cmd_stop)

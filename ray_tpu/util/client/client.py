@@ -21,13 +21,22 @@ from ray_tpu.core.object_ref import ObjectRef
 class ClientContext:
     mode = "client"
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, token: Optional[str] = None):
+        import os
+
         self._conn = protocol.connect_tcp(host, port)
         self._lock = threading.Lock()  # one in-flight request at a time
         self.worker_id = b"client"
         self.node = None
         self._fn_cache: dict[int, tuple[object, bytes]] = {}
         self._tls = threading.local()
+        if token is None:
+            token = os.environ.get("RTPU_CLIENT_TOKEN", "")
+        # Raw-frame handshake (mirrors the server: no pickle pre-auth).
+        self._conn.send_bytes(token.encode("utf-8"))
+        if self._conn.recv_bytes() != b"OK":
+            self._conn.close()
+            raise ConnectionError("client auth handshake failed")
         if self._call({"op": "ping"}) != "pong":
             raise ConnectionError("client handshake failed")
 
@@ -91,10 +100,14 @@ class ClientContext:
 
 
 def connect_client(address: str) -> ClientContext:
-    """address: "rtpu://host:port"."""
+    """address: "rtpu://[token@]host:port" (token may also come from the
+    RTPU_CLIENT_TOKEN env var)."""
     hostport = address[len("rtpu://"):]
+    token = None
+    if "@" in hostport:
+        token, _, hostport = hostport.rpartition("@")
     host, _, port = hostport.rpartition(":")
     if not host or not port.isdigit():
         raise ValueError(f"bad client address {address!r}; expected "
-                         f"rtpu://host:port")
-    return ClientContext(host, int(port))
+                         f"rtpu://[token@]host:port")
+    return ClientContext(host, int(port), token=token)

@@ -9,6 +9,8 @@ runtime's submission paths are thread-safe).
 
 from __future__ import annotations
 
+import hmac
+import secrets
 import threading
 import traceback
 from typing import Optional
@@ -22,17 +24,47 @@ from ray_tpu.core.object_ref import ObjectRef
 
 class ClientServer:
     """Serve remote drivers on TCP. Must run in a process already attached
-    to the cluster (ray_tpu.init done)."""
+    to the cluster (ray_tpu.init done).
 
-    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+    Every op the server executes deserializes client-supplied pickles in the
+    cluster-attached driver process, so connections are authenticated: the
+    client must present ``token`` (auto-generated when not given; see
+    ``self.address``) before any other op is accepted.  Pass ``token=""`` to
+    disable authentication — only do that on a trusted, isolated network.
+    The listener binds loopback by default; binding a routable interface is
+    an explicit opt-in.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 token: Optional[str] = None):
         if worker_mod.global_worker_or_none() is None:
             raise RuntimeError("ClientServer requires ray_tpu.init() first")
+        self.host = host
+        self.token = secrets.token_hex(16) if token is None else token
         self._listener = protocol.listener_tcp(host, port)
         self.port = self._listener.getsockname()[1]
         self._shutdown = False
         self._thread = threading.Thread(
             target=self._accept_loop, name="client-server", daemon=True)
         self._thread.start()
+
+    @property
+    def address(self) -> str:
+        """Connect string for ray_tpu.init (embeds the auth token).
+
+        A wildcard bind is rewritten to this host's routable address, since
+        "0.0.0.0" is not connectable from anywhere.
+        """
+        host = self.host
+        if host in ("0.0.0.0", "::", ""):
+            import socket as _socket
+            try:
+                host = _socket.gethostbyname(_socket.gethostname())
+            except OSError:
+                host = "127.0.0.1"
+        if self.token:
+            return f"rtpu://{self.token}@{host}:{self.port}"
+        return f"rtpu://{host}:{self.port}"
 
     def _accept_loop(self):
         while not self._shutdown:
@@ -46,6 +78,25 @@ class ClientServer:
 
     def _serve(self, conn: protocol.Connection):
         ctx = worker_mod.global_worker()
+        # First frame is the raw (never unpickled) token handshake: until it
+        # matches, no byte from this peer reaches pickle.loads.
+        raw = conn.recv_bytes()
+        if raw is None:
+            conn.close()
+            return
+        if self.token and not hmac.compare_digest(
+                raw, self.token.encode("utf-8")):
+            try:
+                conn.send_bytes(b"NO")
+            except OSError:
+                pass
+            conn.close()
+            return
+        try:
+            conn.send_bytes(b"OK")
+        except OSError:
+            conn.close()
+            return
         while True:
             msg = conn.recv()
             if msg is None:

@@ -60,17 +60,39 @@ def _fold(op, xs):
 
 
 class _GroupState:
-    def __init__(self, world_size: int, rank: int, name: str):
+    def __init__(self, world_size: int, rank: int, name: str, incarnation: int):
         if not (0 <= rank < world_size):
             raise ValueError(f"rank {rank} out of range for world {world_size}")
         self.world_size = world_size
         self.rank = rank
         self.name = name
+        # Key prefix includes the incarnation so a destroy + re-init with the
+        # same group name never reads the previous incarnation's stale keys.
+        # All ranks perform the same init/destroy sequence (the same lockstep
+        # contract the per-round seq already relies on), so per-process
+        # incarnation counters agree across ranks.
+        self.incarnation = incarnation
         self.seq = 0
+        # p2p ordering is per (src, dst) pair, independent of the collective
+        # seq: a rank that sends to two peers (or mixes p2p with collectives)
+        # must not skew rendezvous counters for anyone else.
+        self.p2p_send_seq: dict[int, int] = {}  # dst_rank -> next seq
+        self.p2p_recv_seq: dict[int, int] = {}  # src_rank -> next seq
+        # Keys/objects this rank published, per collective round, reclaimed
+        # once every rank has stamped that round's done marker.
+        self.round_pending: dict[int, list[tuple[str, bytes]]] = {}
+        # Outstanding p2p sends: (key, oid) per dst, reclaimed once the
+        # receiver has deleted the rendezvous key (absence == consumed).
+        self.p2p_pending: dict[int, list[tuple[str, bytes]]] = {}
+
+    def prefix(self) -> str:
+        return f"{self.name}/i{self.incarnation}"
 
 
 # group_name -> _GroupState, per process (each actor is its own process).
 _groups: dict[str, _GroupState] = {}
+# group_name -> number of times this process has initialized it.
+_incarnations: dict[str, int] = {}
 
 
 def _ctx():
@@ -117,7 +139,9 @@ def init_collective_group(world_size: int, rank: int,
     if group_name in _groups:
         raise RuntimeError(f"collective group {group_name!r} already "
                            f"initialized in this process")
-    _groups[group_name] = _GroupState(world_size, rank, group_name)
+    inc = _incarnations.get(group_name, 0) + 1
+    _incarnations[group_name] = inc
+    _groups[group_name] = _GroupState(world_size, rank, group_name, inc)
 
 
 def is_group_initialized(group_name: str = "default") -> bool:
@@ -132,8 +156,74 @@ def get_collective_group_size(group_name: str = "default") -> int:
     return _group(group_name).world_size
 
 
-def destroy_collective_group(group_name: str = "default") -> None:
-    _groups.pop(group_name, None)
+def destroy_collective_group(group_name: str = "default",
+                             grace_s: float = 5.0) -> None:
+    g = _groups.pop(group_name, None)
+    if g is None:
+        return
+    # Best-effort farewell barrier: if every rank reaches destroy within the
+    # grace period, all earlier rounds are provably finished cluster-wide
+    # and this rank's leftovers can be reclaimed.  On timeout nothing is
+    # deleted — yanking keys from under a straggler mid-collect is worse
+    # than leaking a round of tiny keys (which the incarnation prefix keeps
+    # from ever being misread).  The barrier round's own token is the one
+    # thing knowingly left behind (~bytes per rank per incarnation).
+    barrier_ok = False
+    try:
+        _publish(g, f"ag/{g.rank}", np.zeros((), np.int8))
+        _collect(g, lambda r: f"ag/{r}", grace_s)
+        _gc_rounds_before(g, g.seq)
+        barrier_ok = True
+    except Exception:
+        pass
+    # p2p: receiver deletes the rendezvous key on recv, so key-absence means
+    # consumed (free our object).  A key still present after a SUCCESSFUL
+    # farewell barrier is an unmatched send — a program error per the
+    # lockstep contract — reclaim it outright.  If the barrier timed out a
+    # straggler may still be about to recv, so only confirmed-consumed sends
+    # are freed (same leave-it-in-place policy as the collective rounds).
+    for entries in g.p2p_pending.values():
+        for key, oid in entries:
+            if barrier_ok or _kv_get(key) is None:
+                _reclaim(key, oid)
+
+
+def _reclaim(key: Optional[str], oid: Optional[bytes]) -> None:
+    """Best-effort delete of a rendezvous key and its published object."""
+    w = _ctx()
+    if key is not None:
+        try:
+            _kv_del(key)
+        except Exception:
+            pass
+    if oid is not None:
+        try:
+            w.store.delete(oid)
+        except Exception:
+            pass
+        node = getattr(w, "node", None)
+        nid = getattr(node, "node_id", None) if node is not None else None
+        if nid:
+            try:
+                w.rpc("remove_object_location", {"oid": oid, "node_id": nid})
+            except Exception:
+                pass
+
+
+def _gc_rounds_before(g: _GroupState, seq: int) -> None:
+    """Reclaim this rank's published keys/objects for all rounds < seq.
+
+    Only called once the caller has PROOF every rank finished those rounds:
+    completing an all-publish collect at round ``seq`` means every rank
+    published at ``seq``, which it does strictly after finishing every
+    earlier round (including broadcast rounds where only the src published).
+    A broadcast src that races ahead therefore never reclaims anything on
+    its own authority — its pending rounds wait for the next all-publish
+    round to confirm the stragglers caught up.
+    """
+    for s in [s for s in g.round_pending if s < seq]:
+        for key, oid in g.round_pending.pop(s):
+            _reclaim(key, oid)
 
 
 def _group(group_name: str) -> _GroupState:
@@ -155,15 +245,22 @@ def _to_host(tensor) -> np.ndarray:
 
 def _publish(g: _GroupState, tag: str, arr: np.ndarray) -> None:
     ref = _ctx().put_object(arr)
-    _kv_put(f"{g.name}/{g.seq}/{tag}", ref.binary())
+    key = f"{g.prefix()}/{g.seq}/{tag}"
+    _kv_put(key, ref.binary())
+    g.round_pending.setdefault(g.seq, []).append((key, ref.binary()))
 
 
 def _collect(g: _GroupState, tag_of, timeout: float) -> List[np.ndarray]:
     from ray_tpu import api
     out = []
     for r in range(g.world_size):
-        oid = _wait_kv(f"{g.name}/{g.seq}/{tag_of(r)}", timeout)
-        out.append(api.get(ObjectRef(oid), timeout=timeout))
+        oid = _wait_kv(f"{g.prefix()}/{g.seq}/{tag_of(r)}", timeout)
+        value = api.get(ObjectRef(oid), timeout=timeout)
+        if isinstance(value, np.ndarray):
+            # Own the bytes: the publisher reclaims the backing shm object
+            # once a later round proves everyone has moved past this one.
+            value = np.array(value)
+        out.append(value)
     return out
 
 
@@ -173,6 +270,9 @@ def allgather(tensor, group_name: str = "default",
     g = _group(group_name)
     _publish(g, f"ag/{g.rank}", _to_host(tensor))
     vals = _collect(g, lambda r: f"ag/{r}", timeout)
+    # Every rank published this round, so every earlier round is finished
+    # cluster-wide: reclaim our stale keys/objects (bounds per-step growth).
+    _gc_rounds_before(g, g.seq)
     g.seq += 1
     return vals
 
@@ -209,17 +309,42 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default",
     g = _group(group_name)
     if g.rank == src_rank:
         _publish(g, f"bc/{src_rank}", _to_host(tensor))
-    oid = _wait_kv(f"{g.name}/{g.seq}/bc/{src_rank}", timeout)
+    oid = _wait_kv(f"{g.prefix()}/{g.seq}/bc/{src_rank}", timeout)
     g.seq += 1
-    return api.get(ObjectRef(oid), timeout=timeout)
+    value = api.get(ObjectRef(oid), timeout=timeout)
+    if isinstance(value, np.ndarray):
+        value = np.array(value)  # own the bytes (src reclaims later)
+    return value
 
 
 def send(tensor, dst_rank: int, group_name: str = "default") -> None:
-    """Point-to-point send (pairs with recv on dst_rank)."""
+    """Point-to-point send (pairs with recv on dst_rank).
+
+    Ordered per (src, dst) pair — matching sends/recvs advance a dedicated
+    counter, so interleaving sends to several peers or mixing p2p with
+    collectives never skews anyone's rendezvous sequence.
+    """
     g = _group(group_name)
+    # Reclaim earlier sends to this peer the receiver has consumed: recv
+    # deletes the rendezvous key after reading, so key-absence is the ack.
+    still = []
+    for key, oid in g.p2p_pending.get(dst_rank, []):
+        if _kv_get(key) is None:
+            _reclaim(None, oid)
+        else:
+            still.append((key, oid))
+    if still:
+        g.p2p_pending[dst_rank] = still
+    else:
+        g.p2p_pending.pop(dst_rank, None)
+    n = g.p2p_send_seq.get(dst_rank, 0)
     ref = _ctx().put_object(_to_host(tensor))
-    _kv_put(f"{g.name}/p2p/{g.rank}->{dst_rank}/{g.seq}", ref.binary())
-    g.seq += 1
+    key = f"{g.prefix()}/p2p/{g.rank}->{dst_rank}/{n}"
+    _kv_put(key, ref.binary())
+    # Advance only after the publish succeeded, so a failed send can be
+    # retried at the same sequence number.
+    g.p2p_send_seq[dst_rank] = n + 1
+    g.p2p_pending.setdefault(dst_rank, []).append((key, ref.binary()))
 
 
 def recv(src_rank: int, group_name: str = "default",
@@ -231,10 +356,21 @@ def recv(src_rank: int, group_name: str = "default",
     """
     from ray_tpu import api
     g = _group(group_name)
-    oid = _wait_kv(f"{g.name}/p2p/{src_rank}->{g.rank}/{g.seq}", timeout)
-    _kv_del(f"{g.name}/p2p/{src_rank}->{g.rank}/{g.seq}")
-    g.seq += 1
-    return api.get(ObjectRef(oid), timeout=timeout)
+    n = g.p2p_recv_seq.get(src_rank, 0)
+    key = f"{g.prefix()}/p2p/{src_rank}->{g.rank}/{n}"
+    oid = _wait_kv(key, timeout)
+    value = api.get(ObjectRef(oid), timeout=timeout)
+    if isinstance(value, np.ndarray):
+        # Own the bytes before acking — the sender may free the backing shm
+        # object the moment it observes the ack.
+        value = np.array(value)
+    # Advance only once the value is in hand: a timed-out recv may be
+    # retried and must wait on the same sequence number.
+    g.p2p_recv_seq[src_rank] = n + 1
+    # Deleting the rendezvous key doubles as the consumption ack: the sender
+    # frees the published object once it observes the key gone.
+    _kv_del(key)
+    return value
 
 
 def barrier(group_name: str = "default", timeout: float = 60.0) -> None:

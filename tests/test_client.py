@@ -20,10 +20,10 @@ def client_server(ray_cluster):
     server.shutdown()
 
 
-def _run_client(port: int, body: str) -> str:
+def _run_client(server, body: str) -> str:
     script = textwrap.dedent(f"""
         import ray_tpu
-        ray_tpu.init(address="rtpu://127.0.0.1:{port}")
+        ray_tpu.init(address="{server.address}")
     """) + textwrap.dedent(body)
     proc = subprocess.run(
         [sys.executable, "-c", script], capture_output=True, text=True,
@@ -33,7 +33,7 @@ def _run_client(port: int, body: str) -> str:
 
 
 def test_client_tasks_and_objects(client_server):
-    out = _run_client(client_server.port, """
+    out = _run_client(client_server, """
         @ray_tpu.remote
         def add(a, b):
             return a + b
@@ -51,7 +51,7 @@ def test_client_tasks_and_objects(client_server):
 
 
 def test_client_actors_and_state(client_server):
-    out = _run_client(client_server.port, """
+    out = _run_client(client_server, """
         @ray_tpu.remote
         class Counter:
             def __init__(self):
@@ -72,7 +72,7 @@ def test_client_actors_and_state(client_server):
 
 
 def test_client_error_propagation(client_server):
-    out = _run_client(client_server.port, """
+    out = _run_client(client_server, """
         @ray_tpu.remote
         def boom():
             raise ValueError("remote kaboom")
@@ -84,3 +84,20 @@ def test_client_error_propagation(client_server):
             print("caught:", "remote kaboom" in str(e))
     """)
     assert "caught: True" in out
+
+
+def test_client_auth_rejected(client_server):
+    script = textwrap.dedent(f"""
+        import ray_tpu
+        try:
+            ray_tpu.init(
+                address="rtpu://wrong-token@127.0.0.1:{client_server.port}")
+            print("CONNECTED")
+        except ConnectionError:
+            print("rejected")
+    """)
+    proc = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=120)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "rejected" in proc.stdout

@@ -89,3 +89,65 @@ def test_declare_collective_group(cluster):
         np.testing.assert_allclose(o, [3.0])
     for a in actors:
         ray_tpu.kill(a)
+
+
+def test_iterative_loop_reclaims_and_reinit(cluster):
+    """Iterative allreduce must not grow the GCS KV unboundedly, p2p to two
+    peers must not skew rendezvous, and destroy+re-init with the same group
+    name must not read the previous incarnation's keys."""
+    import ray_tpu
+    from ray_tpu.util import collective
+
+    @ray_tpu.remote
+    class Rank:
+        def __init__(self, rank, world):
+            self.rank, self.world = rank, world
+
+        def run_epoch(self, value):
+            collective.init_collective_group(
+                self.world, self.rank, group_name="loop")
+            outs = []
+            for step in range(6):  # several rounds: GC must keep up
+                out = collective.allreduce(
+                    np.array([value + step]), group_name="loop")
+                outs.append(float(out[0]))
+            collective.destroy_collective_group("loop")
+            return outs
+
+        def mixed_p2p(self):
+            from ray_tpu.util.collective import recv, send
+            collective.init_collective_group(
+                self.world, self.rank, group_name="p2p")
+            try:
+                if self.rank == 0:
+                    # interleave sends to two peers with a collective
+                    send(np.array([10.0]), dst_rank=1, group_name="p2p")
+                    send(np.array([20.0]), dst_rank=2, group_name="p2p")
+                    collective.barrier(group_name="p2p")
+                    send(np.array([11.0]), dst_rank=1, group_name="p2p")
+                    return None
+                got = [float(recv(0, group_name="p2p")[0])]
+                collective.barrier(group_name="p2p")
+                if self.rank == 1:
+                    got.append(float(recv(0, group_name="p2p")[0]))
+                return got
+            finally:
+                collective.destroy_collective_group("p2p")
+
+    workers = [Rank.remote(i, 2) for i in range(2)]
+    # epoch 1 then epoch 2 reuse the same group name end-to-end
+    for epoch in range(2):
+        outs = ray_tpu.get([w.run_epoch.remote(float(i))
+                            for i, w in enumerate(workers)])
+        expected = [1.0 + 2 * s for s in range(6)]  # (0+s)+(1+s)
+        assert outs[0] == expected and outs[1] == expected
+    for w in workers:
+        ray_tpu.kill(w)
+
+    trio = [Rank.remote(i, 3) for i in range(3)]
+    got = ray_tpu.get([w.mixed_p2p.remote() for w in trio])
+    assert got[0] is None
+    assert got[1] == [10.0, 11.0]
+    assert got[2] == [20.0]
+    for w in trio:
+        ray_tpu.kill(w)
