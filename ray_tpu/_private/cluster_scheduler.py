@@ -1,0 +1,186 @@
+"""Cluster-level scheduling: peer links, spillback policy, bundle placement.
+
+Counterpart of the reference's cluster scheduling layer
+(/root/reference/src/ray/raylet/scheduling/cluster_task_manager.cc driving
+cluster_resource_scheduler.cc:145 GetBestSchedulableNode with the hybrid
+policy in policy/hybrid_scheduling_policy.cc, and the PG bundle strategies in
+policy/bundle_scheduling_policy.cc).  The local dispatch loop stays in
+scheduler.py (the reference's local_task_manager.cc); this module owns the
+decisions and plumbing that involve OTHER nodes.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from ray_tpu._private import protocol
+from ray_tpu._private.task_spec import MAX_SPILLS, TaskSpec
+
+
+class PeerLinks:
+    """Cached one-way connections to other nodes' schedulers, plus one-shot
+    request/response calls (reference: the per-peer gRPC clients in
+    src/ray/rpc/node_manager/)."""
+
+    def __init__(self, node_id: bytes, lookup_node: Callable):
+        self._node_id = node_id
+        self._lookup_node = lookup_node  # node_id -> NodeInfo | None
+        self._peers: dict[bytes, protocol.Connection] = {}
+        self._lock = threading.Lock()
+
+    def send(self, node_id: bytes, msg: dict) -> bool:
+        """Send a one-way control message to another node's scheduler.
+
+        The TCP connect happens OUTSIDE the links lock and with a short
+        timeout: callers hold the scheduler lock (dispatch loop), and a
+        peer that just went dark must not stall the whole node for a full
+        SYN timeout per pending task."""
+        with self._lock:
+            conn = self._peers.get(node_id)
+        if conn is None:
+            node = self._lookup_node(node_id)
+            if node is None or not node.alive or not node.sched_socket:
+                return False
+            try:
+                conn = protocol.connect_addr(node.sched_socket, timeout=2.0)
+            except (OSError, ConnectionError):
+                return False
+            with self._lock:
+                existing = self._peers.get(node_id)
+                if existing is not None:
+                    conn.close()  # lost the race; use the cached one
+                    conn = existing
+                else:
+                    self._peers[node_id] = conn
+        try:
+            conn.send(msg)
+            return True
+        except OSError:
+            with self._lock:
+                self._peers.pop(node_id, None)
+            return False
+
+    def one_shot_rpc(self, sched_addr: str, method: str, params: dict):
+        """Request/response against a peer scheduler over a fresh
+        connection (the cached peer conns are one-way fire-and-forget)."""
+        conn = protocol.connect_addr(sched_addr, timeout=5.0)
+        try:
+            conn.send({"t": "rpc", "method": method, "params": params})
+            resp = conn.recv()
+        finally:
+            conn.close()
+        if resp is None or not resp.get("ok"):
+            raise RuntimeError(
+                f"peer rpc {method} failed: "
+                f"{resp.get('error') if resp else 'connection closed'}")
+        return resp["result"]
+
+    def drop(self, node_id: bytes):
+        with self._lock:
+            self._peers.pop(node_id, None)
+
+
+def pick_spill_target(
+    spec: TaskSpec,
+    node_id: bytes,
+    total_resources: dict,
+    cluster_nodes: dict,
+) -> Optional[bytes]:
+    """Pick a peer node for a task this node can't run right now
+    (reference: hybrid policy spillback,
+    policy/hybrid_scheduling_policy.cc — local-first, then best feasible
+    remote by available capacity).  Debits the cached view of the chosen
+    node so the next task in the same pass picks a different node instead
+    of dogpiling this one; the target's own heartbeat re-syncs truth."""
+    if spec.pg_id is not None or spec.spill_count >= MAX_SPILLS:
+        return None  # PG bundles are reserved on this node
+    if spec.node_affinity == node_id and not spec.affinity_soft:
+        return None
+    res = spec.resources or {}
+    locally_feasible = all(
+        total_resources.get(k, 0) >= v for k, v in res.items())
+    best, best_score = None, -1.0
+    for nid, node in cluster_nodes.items():
+        if nid == node_id or not node.alive:
+            continue
+        if not all(node.resources.get(k, 0) >= v for k, v in res.items()):
+            continue  # never feasible there
+        has_now = all(node.available.get(k, 0) >= v for k, v in res.items())
+        if not has_now and locally_feasible:
+            # feasible here eventually: only spill to nodes with free
+            # capacity right now
+            continue
+        score = (1000.0 if has_now else 0.0) + sum(
+            node.available.get(k, 0) for k in ("CPU", "TPU"))
+        if score > best_score:
+            best, best_score = nid, score
+    if best is not None:
+        spec.spill_count += 1
+        avail = cluster_nodes[best].available
+        for k, v in res.items():
+            avail[k] = avail.get(k, 0) - v
+    return best
+
+
+def assign_bundles(
+    avail: dict[bytes, dict],
+    bundles: list[dict],
+    strategy: str,
+) -> Optional[list[bytes]]:
+    """Pick a node per placement-group bundle from a cluster availability
+    view; None = infeasible (reference: bundle_scheduling_policy.cc)."""
+
+    def fits(node_avail: dict, b: dict) -> bool:
+        return all(node_avail.get(k, 0) >= v for k, v in b.items())
+
+    def take(node_avail: dict, b: dict):
+        for k, v in b.items():
+            node_avail[k] = node_avail.get(k, 0) - v
+
+    order = sorted(avail, key=lambda n: -avail[n].get("CPU", 0))
+    assignment: list[Optional[bytes]] = [None] * len(bundles)
+    if strategy in ("STRICT_PACK",):
+        for nid in order:
+            trial = dict(avail[nid])
+            good = True
+            for b in bundles:
+                if not fits(trial, b):
+                    good = False
+                    break
+                take(trial, b)
+            if good:
+                return [nid] * len(bundles)
+        return None
+    if strategy in ("STRICT_SPREAD",):
+        used: set[bytes] = set()
+        for i, b in enumerate(bundles):
+            placed = False
+            for nid in order:
+                if nid in used or not fits(avail[nid], b):
+                    continue
+                take(avail[nid], b)
+                used.add(nid)
+                assignment[i] = nid
+                placed = True
+                break
+            if not placed:
+                return None
+        return assignment  # type: ignore[return-value]
+    # PACK: prefer fewest nodes (first-fit over pack order);
+    # SPREAD: best-effort round-robin over distinct nodes
+    rr = 0
+    for i, b in enumerate(bundles):
+        placed = False
+        tries = (order if strategy == "PACK"
+                 else order[rr % len(order):] + order[:rr % len(order)])
+        for nid in tries:
+            if fits(avail[nid], b):
+                take(avail[nid], b)
+                assignment[i] = nid
+                placed = True
+                break
+        if not placed:
+            return None
+        rr += 1
+    return assignment  # type: ignore[return-value]

@@ -22,7 +22,13 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
-from ray_tpu._private.protocol import Connection, connect, listener
+from ray_tpu._private.protocol import (
+    Connection,
+    authenticate_server_side,
+    connect_addr,
+    is_tcp_addr,
+    listener_addr,
+)
 
 # Actor lifecycle states (reference: src/ray/design_docs/actor_states.rst).
 DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
@@ -238,8 +244,8 @@ _GCS_METHODS = frozenset({
 class GcsServer:
     def __init__(self, gcs: Gcs, socket_path: str):
         self.gcs = gcs
-        self.socket_path = socket_path
-        self._listener = listener(socket_path)
+        self._listener, self.socket_path = listener_addr(socket_path)
+        self._is_tcp = is_tcp_addr(self.socket_path)
         self._shutdown = False
         self._thread = threading.Thread(
             target=self._accept_loop, name="gcs-accept", daemon=True)
@@ -255,6 +261,10 @@ class GcsServer:
                              daemon=True).start()
 
     def _serve(self, conn: Connection):
+        # TCP peers must pass the cluster-token handshake before any frame
+        # of theirs is unpickled (see protocol.py).
+        if not authenticate_server_side(conn, self._is_tcp):
+            return
         while True:
             msg = conn.recv()
             if msg is None:
@@ -290,7 +300,7 @@ class GcsClient:
 
     def __init__(self, socket_path: str):
         self._socket_path = socket_path
-        self._conn = connect(socket_path)
+        self._conn = connect_addr(socket_path)
         self._lock = threading.Lock()
 
     def _call(self, method: str, *args, **kwargs):
@@ -302,7 +312,7 @@ class GcsClient:
                 resp = None
             if resp is None:
                 # one reconnect attempt (head may have restarted the server)
-                self._conn = connect(self._socket_path)
+                self._conn = connect_addr(self._socket_path)
                 self._conn.send({"m": method, "a": args, "k": kwargs})
                 resp = self._conn.recv()
                 if resp is None:

@@ -72,9 +72,39 @@ class Node:
         include_dashboard: bool = True,
         node_id: Optional[bytes] = None,
         merge_default_resources: bool = True,
+        listen_host: Optional[str] = None,
     ):
+        """listen_host: bind the node's control-plane services (GCS on the
+        head, scheduler everywhere) to TCP on this interface instead of
+        unix sockets — required for clusters spanning hosts.  The object
+        store stays node-local shm either way; cross-node object bytes
+        flow through the schedulers' chunked fetch path.  Defaults to the
+        RTPU_LISTEN_HOST env var (unset = unix sockets)."""
         self.node_id = node_id or os.urandom(16)
         self.is_head = head
+        self.listen_host = (listen_host
+                            if listen_host is not None
+                            else os.environ.get("RTPU_LISTEN_HOST") or None)
+        if self.listen_host:
+            from ray_tpu._private import protocol as _protocol
+
+            if gcs_address is not None:
+                # joining node: a token embedded in the address wins, else
+                # RTPU_CLUSTER_TOKEN must already hold the head's token
+                tok, gcs_address = _protocol.split_token_addr(gcs_address)
+                if tok:
+                    os.environ[_protocol._TOKEN_ENV] = tok
+                if (not _protocol.cluster_token()
+                        and _protocol.is_tcp_addr(gcs_address)):
+                    raise ValueError(
+                        "joining a TCP cluster requires the head's cluster "
+                        "token: set RTPU_CLUSTER_TOKEN or use a "
+                        "token@host:port address")
+                _protocol.ensure_cluster_token()
+            else:
+                # head: generate the cluster token (exported via env so
+                # worker processes and external nodes inherit it)
+                _protocol.ensure_cluster_token()
         ts = time.strftime("%Y-%m-%d_%H-%M-%S")
         self.session_dir = session_dir or (
             f"/tmp/ray_tpu/session_{ts}_{os.getpid()}_{self.node_id[:3].hex()}"
@@ -102,11 +132,15 @@ class Node:
             # dropping them (reference: object spilling, SURVEY §2.1)
             spill_dir=os.path.join(self.session_dir, "spill"),
         )
-        sched_socket = os.path.join(self.session_dir, "sched.sock")
+        if self.listen_host:
+            sched_socket = f"{self.listen_host}:0"  # kernel-assigned port
+        else:
+            sched_socket = os.path.join(self.session_dir, "sched.sock")
         if head:
             self.gcs = Gcs()
-            self.gcs_server = GcsServer(
-                self.gcs, os.path.join(self.session_dir, "gcs.sock"))
+            gcs_bind = (f"{self.listen_host}:0" if self.listen_host
+                        else os.path.join(self.session_dir, "gcs.sock"))
+            self.gcs_server = GcsServer(self.gcs, gcs_bind)
             self.gcs_address = self.gcs_server.socket_path
         else:
             if gcs_address is None:
@@ -115,10 +149,6 @@ class Node:
             self.gcs = GcsClient(gcs_address)
             self.gcs_server = None
             self.gcs_address = gcs_address
-        self.gcs.register_node(NodeInfo(
-            self.node_id, resources=dict(merged), is_head=head,
-            sched_socket=sched_socket,
-            store_socket=self.store_server.socket_path))
         self.scheduler = Scheduler(
             socket_path=sched_socket,
             store_socket=self.store_server.socket_path,
@@ -131,6 +161,13 @@ class Node:
             node_id=self.node_id,
             is_head=head,
         )
+        # Register AFTER the scheduler binds: with TCP the advertised
+        # address carries the kernel-assigned port.
+        self.sched_address = self.scheduler.socket_path
+        self.gcs.register_node(NodeInfo(
+            self.node_id, resources=dict(merged), is_head=head,
+            sched_socket=self.sched_address,
+            store_socket=self.store_server.socket_path))
         if head:
             # Job submission lives on the head (reference: JobManager in the
             # dashboard head process, dashboard/modules/job/job_manager.py).
@@ -145,7 +182,7 @@ class Node:
             try:
                 from ray_tpu.dashboard import DashboardHead
 
-                self.dashboard = DashboardHead(self.gcs, sched_socket)
+                self.dashboard = DashboardHead(self.gcs, self.sched_address)
                 self.dashboard_url = self.dashboard.url
                 if self.dashboard_url:
                     self.gcs.kv_put("dashboard", b"url",

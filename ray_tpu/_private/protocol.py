@@ -137,3 +137,128 @@ def listener_tcp(host: str = "0.0.0.0", port: int = 0) -> socket.socket:
     s.bind((host, port))
     s.listen(128)
     return s
+
+
+# ---------------------------------------------------------------------------
+# Address strings.  Every service endpoint in the cluster (GCS, scheduler,
+# per-worker servers) is named by one string that is either a unix socket
+# path ("/tmp/ray_tpu/session_x/sched.sock" — same-host, zero config) or a
+# "host:port" TCP endpoint (multi-host clusters).  The reference's analogue
+# is gRPC target strings (src/ray/rpc/); keeping both transports behind one
+# connect/listen pair lets the whole control plane switch to TCP per-node.
+#
+# TCP security: every frame on these connections is unpickled, so a TCP
+# connection must prove membership before its first frame is parsed — a
+# raw (never-unpickled) cluster-token handshake, same mechanism as the
+# rtpu:// client server.  The token lives in RTPU_CLUSTER_TOKEN: the head
+# generates one when it binds TCP, worker nodes/processes inherit it via
+# the environment (or a "token@host:port" address).  Unix-socket
+# connections skip the handshake — they are same-host and guarded by
+# filesystem permissions, like the reference's raylet socket.
+# ---------------------------------------------------------------------------
+
+_TOKEN_ENV = "RTPU_CLUSTER_TOKEN"
+
+
+def cluster_token() -> str:
+    return os.environ.get(_TOKEN_ENV, "")
+
+
+def ensure_cluster_token() -> str:
+    """Generate (and export for child processes) a token if none is set."""
+    tok = os.environ.get(_TOKEN_ENV)
+    if not tok:
+        import secrets
+
+        tok = secrets.token_hex(16)
+        os.environ[_TOKEN_ENV] = tok
+    return tok
+
+
+def split_token_addr(addr: str) -> tuple[str | None, str]:
+    """Parse "token@host:port" -> (token, "host:port"); no token -> None."""
+    if "@" in addr and not addr.startswith("/"):
+        token, _, rest = addr.rpartition("@")
+        return token, rest
+    return None, addr
+
+
+def is_tcp_addr(addr: str) -> bool:
+    if addr.startswith("/") or addr.startswith("."):
+        return False
+    host, _, port = addr.rpartition(":")
+    return bool(host) and port.isdigit()
+
+
+def connect_addr(addr: str, timeout: float = 10.0) -> Connection:
+    """Connect to a unix-path or host:port address.
+
+    TCP connections perform the cluster-token handshake before returning,
+    so callers never talk to a listener they can't authenticate to."""
+    token, addr = split_token_addr(addr)
+    if is_tcp_addr(addr):
+        host, _, port = addr.rpartition(":")
+        conn = connect_tcp(host.strip("[]"), int(port), timeout=timeout)
+        tok = token if token is not None else cluster_token()
+        try:
+            conn.send_bytes(tok.encode("utf-8"))
+            if conn.recv_bytes() != b"OK":
+                conn.close()
+                raise ConnectionRefusedError(
+                    f"cluster-token handshake rejected by {addr} (set "
+                    f"{_TOKEN_ENV} to the head's token)")
+        except OSError:
+            conn.close()
+            raise
+        return conn
+    return connect(addr)
+
+
+def authenticate_server_side(conn: Connection, is_tcp: bool) -> bool:
+    """Server half of the handshake; call before the first recv().
+
+    Returns False (connection closed) on mismatch.  Unix connections are
+    exempt (same-host, filesystem-guarded)."""
+    if not is_tcp:
+        return True
+    import hmac
+
+    raw = conn.recv_bytes()
+    if raw is None or not hmac.compare_digest(
+            raw, cluster_token().encode("utf-8")):
+        try:
+            conn.send_bytes(b"NO")
+        except OSError:
+            pass
+        conn.close()
+        return False
+    try:
+        conn.send_bytes(b"OK")
+    except OSError:
+        conn.close()
+        return False
+    return True
+
+
+def advertised_host(host: str) -> str:
+    """A connectable form of a bind host (0.0.0.0/:: -> this host's IP)."""
+    if host in ("0.0.0.0", "::", ""):
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"
+    return host
+
+
+def listener_addr(addr: str) -> tuple[socket.socket, str]:
+    """Listen on a unix-path or host:port address.
+
+    Returns (socket, advertised_addr): for TCP the advertised address
+    carries the kernel-assigned port and a connectable host (a wildcard
+    bind is rewritten — "0.0.0.0:p" is not dialable from peers).
+    """
+    if is_tcp_addr(addr):
+        host, _, port = addr.rpartition(":")
+        s = listener_tcp(host.strip("[]"), int(port))
+        return s, f"{advertised_host(host)}:{s.getsockname()[1]}"
+    return listener(addr), addr

@@ -1,24 +1,28 @@
-"""Node scheduler + worker pool ("raylet-lite").
+"""Node scheduler ("raylet-lite"): local dispatch + node service frontend.
 
 Single-node counterpart of the reference raylet
-(/root/reference/src/ray/raylet/node_manager.cc scheduling via
-scheduling/cluster_task_manager.cc + local_task_manager.cc, worker pool in
-worker_pool.h): owns the worker process pool, a pending-task queue, resource
-accounting (CPU/TPU/custom + placement-group bundles), actor→worker routing,
-and failure handling (crashed workers fail or retry their in-flight tasks).
+(/root/reference/src/ray/raylet/node_manager.cc), decomposed the same way
+the reference is:
 
-Runs as threads inside the head process in this round; the worker protocol is
-already socket-based so the scheduler can move out-of-process (and native)
-without changing workers.  TPU specifics: ``TPU`` is a first-class resource,
-and a worker granted TPU chips receives ``TPU_VISIBLE_CHIPS`` so concurrent
-JAX processes don't fight over the same device.
+- worker pool               -> _private/worker_pool.py   (worker_pool.h)
+- local dispatch loop       -> HERE                      (local_task_manager.cc)
+- cluster scheduling policy -> _private/cluster_scheduler.py
+                                                          (cluster_task_manager.cc,
+                                                           scheduling/policy/)
+- object transfer           -> _private/object_transfer.py (object_manager/)
+- task spec                 -> _private/task_spec.py     (common/task/task_spec.h)
+
+The Scheduler class wires them together and serves the node's socket (worker
+registration, task completion, peer spillback, control RPCs).  TPU
+specifics: ``TPU`` is a first-class resource, and a worker granted TPU chips
+receives ``TPU_VISIBLE_CHIPS`` so concurrent JAX processes don't fight over
+the same device.  The listen address may be a unix path (same-host) or
+"host:port" (multi-host TCP) — see protocol.connect_addr.
 """
 
 from __future__ import annotations
 
 import os
-import subprocess
-import sys
 import threading
 import time
 import traceback
@@ -26,27 +30,31 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ray_tpu._private import cluster_scheduler as cluster_mod
 from ray_tpu._private import gcs as gcs_mod
-from ray_tpu._private import protocol
-from ray_tpu._private.protocol import Connection, listener
+from ray_tpu._private.object_transfer import ObjectTransfer
+from ray_tpu._private.protocol import (
+    Connection,
+    authenticate_server_side,
+    is_tcp_addr,
+    listener_addr,
+)
 from ray_tpu._private.serialization import store_error_best_effort
+from ray_tpu._private.task_spec import (  # noqa: F401  (re-exported surface)
+    ACTOR_CREATION,
+    ACTOR_METHOD,
+    FETCH_CHUNK,
+    MAX_SPILLS,
+    TASK,
+    TaskSpec,
+)
+from ray_tpu._private.worker_pool import WorkerPool, WorkerState
 from ray_tpu.core.store_client import StoreClient
 from ray_tpu.exceptions import (
     ActorDiedError,
     TaskCancelledError,
     WorkerCrashedError,
 )
-
-TASK = "task"
-ACTOR_CREATION = "actor_creation"
-ACTOR_METHOD = "actor_method"
-
-# Cross-node object transfer chunk (reference: object_manager.h:53
-# object_chunk_size, ~1-5MB); bounds per-message memory during pulls.
-FETCH_CHUNK = 4 << 20
-# A task may spill between nodes at most this many times before it settles
-# where it is (prevents forwarding ping-pong under racing load reports).
-MAX_SPILLS = 4
 
 # Scheduler event tracing for debugging scheduling/routing issues: set
 # RTPU_DEBUG_SCHED to a file path.  Call sites are gated on _DEBUG_SCHED so
@@ -62,59 +70,6 @@ def _dbg(msg):
             f.write(f"{time.time():.3f} {msg}\n")
     except OSError:
         pass
-
-
-@dataclass
-class TaskSpec:
-    task_id: bytes
-    kind: str  # TASK | ACTOR_CREATION | ACTOR_METHOD
-    fn_id: bytes  # GCS KV key of the pickled function/class
-    args_blob: bytes  # cloudpickle of (args, kwargs) with ObjectRef markers
-    return_ids: list[bytes]
-    resources: dict = field(default_factory=dict)
-    actor_id: Optional[bytes] = None
-    method_name: Optional[str] = None
-    name: str = ""
-    max_retries: int = 0
-    retries_left: int = 0
-    max_restarts: int = 0
-    max_concurrency: int = 1
-    actor_name: Optional[str] = None
-    pg_id: Optional[bytes] = None
-    pg_bundle: Optional[int] = None
-    runtime_env: Optional[dict] = None
-    # "device": return value stays resident on the producing actor (HBM for
-    # jax.Arrays); the store gets a marker (reference: GPU objects / RDT,
-    # python/ray/_private/gpu_object_manager.py:16)
-    tensor_transport: Optional[str] = None
-    # cluster scheduling (reference: hybrid policy spillback,
-    # src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.cc, and
-    # NodeAffinitySchedulingStrategy, util/scheduling_strategies.py:41)
-    spill_count: int = 0
-    node_affinity: Optional[bytes] = None
-    affinity_soft: bool = True
-    origin_node: Optional[bytes] = None  # forwarder to notify on completion
-
-
-@dataclass
-class WorkerState:
-    worker_id: bytes
-    proc: subprocess.Popen
-    conn: Optional[Connection] = None
-    idle: bool = False
-    actor_id: Optional[bytes] = None  # set once this worker hosts an actor
-    in_flight: dict = field(default_factory=dict)  # task_id -> TaskSpec
-    held_resources: dict = field(default_factory=dict)
-    held_pg: Optional[tuple[bytes, int]] = None
-    alive: bool = True
-    # Blocked-in-get bookkeeping: while a worker blocks on an unresolved
-    # object its granted resources are released back to the pool (reference:
-    # NotifyDirectCallTaskBlocked in src/ray/raylet/node_manager.cc) so
-    # dependency chains can't deadlock the node.
-    blocked_count: int = 0
-    blocked_resources: dict = field(default_factory=dict)
-    blocked_pg: Optional[tuple[bytes, int]] = None
-    held_chips: list = field(default_factory=list)  # physical TPU chip indices
 
 
 @dataclass
@@ -144,7 +99,6 @@ class Scheduler:
         node_id: Optional[bytes] = None,
         is_head: bool = True,
     ):
-        self.socket_path = socket_path
         self.store_socket = store_socket
         self.shm_name = shm_name
         self.store_capacity = store_capacity
@@ -153,14 +107,10 @@ class Scheduler:
         self.is_head = is_head
         self.total_resources = dict(node_resources)
         self.available = dict(node_resources)
-        self.min_workers = min_workers
-        self.max_workers = max_workers
-        self.worker_env = worker_env or {}
 
         self._lock = threading.RLock()
         self._wake = threading.Condition(self._lock)
         self._pending: deque[TaskSpec] = deque()
-        self._workers: dict[bytes, WorkerState] = {}
         self._actor_workers: dict[bytes, bytes] = {}  # actor_id -> worker_id
         self._pgs: dict[bytes, PlacementGroupState] = {}
         self._task_index: dict[bytes, TaskSpec] = {}  # task_id -> spec (pending/running)
@@ -176,8 +126,6 @@ class Scheduler:
         # thread so the scheduling loop never blocks on a GCS round-trip
         self._cluster_nodes: dict[bytes, "gcs_mod.NodeInfo"] = {}
         self._known_alive: set[bytes] = set()
-        self._peers: dict[bytes, Connection] = {}  # node_id -> sched conn
-        self._peer_lock = threading.Lock()
         # task_id -> (node_id, spec) for specs forwarded to other nodes
         self._forwarded: dict[bytes, tuple[bytes, TaskSpec]] = {}
         # actor_id -> (ts, ActorInfo): TTL cache for method routing
@@ -191,11 +139,24 @@ class Scheduler:
         self._task_events: dict[bytes, dict] = {}
         self._task_events_cap = int(
             os.environ.get("RTPU_TASK_EVENTS_CAP", 20000))
-        self._pulls: set[bytes] = set()  # oids with an in-flight pull
-        self._pull_lock = threading.Lock()
 
         self._store = StoreClient(store_socket, shm_name, store_capacity)
-        self._listener = listener(socket_path)
+        self._listener, self.socket_path = listener_addr(socket_path)
+        self._is_tcp = is_tcp_addr(self.socket_path)
+        self._links = cluster_mod.PeerLinks(self.node_id, self._lookup_node)
+        self._transfer = ObjectTransfer(
+            self._store, gcs, self.node_id, self._lookup_node,
+            lambda: self._shutdown)
+        self._pool = WorkerPool(
+            scheduler_addr=self.socket_path,
+            store_socket=store_socket,
+            shm_name=shm_name,
+            store_capacity=store_capacity,
+            node_id=self.node_id,
+            min_workers=min_workers,
+            max_workers=max_workers,
+            worker_env=worker_env,
+        )
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="sched-accept", daemon=True
         )
@@ -208,8 +169,25 @@ class Scheduler:
         self._accept_thread.start()
         self._sched_thread.start()
         self._heartbeat_thread.start()
-        for _ in range(min_workers):
-            self._spawn_worker()
+        with self._lock:
+            for _ in range(min_workers):
+                self._pool.spawn_worker()
+
+    # convenience accessors over the decomposed parts -----------------------
+    @property
+    def _workers(self) -> dict[bytes, WorkerState]:
+        return self._pool.workers
+
+    def _lookup_node(self, node_id: bytes):
+        node = self._cluster_nodes.get(node_id)
+        if node is None:
+            try:
+                node = self.gcs.get_node(node_id)
+                if node is not None:
+                    self._cluster_nodes[node_id] = node
+            except Exception:
+                node = None
+        return node
 
     # ------------------------------------------------------------------
     # Public API (called from the driver thread and from worker readers)
@@ -307,7 +285,7 @@ class Scheduler:
                         # Mark cancelled so worker-death handling fails the
                         # task with TaskCancelledError instead of retrying.
                         self._cancelled.add(task_id)
-                        self._terminate_worker(w)
+                        self._pool.terminate_worker(w)
                         return True
             return False
 
@@ -317,8 +295,8 @@ class Scheduler:
             fwd = self._forwarded.get(task_id)
         if fwd is None:
             return False
-        return self._peer_send(fwd[0], {"t": "cancel", "task_id": task_id,
-                                        "force": force})
+        return self._links.send(fwd[0], {"t": "cancel", "task_id": task_id,
+                                         "force": force})
 
     def kill_actor(self, actor_id: bytes, no_restart: bool = True):
         with self._lock:
@@ -330,7 +308,7 @@ class Scheduler:
                         and info.node_id != self.node_id):
                     if no_restart:
                         self.gcs.update_actor(actor_id, max_restarts=0)
-                    self._peer_send(info.node_id, {
+                    self._links.send(info.node_id, {
                         "t": "kill_actor", "actor_id": actor_id,
                         "no_restart": no_restart})
                     return
@@ -345,14 +323,17 @@ class Scheduler:
             if no_restart:
                 self.gcs.update_actor(actor_id, max_restarts=0)
             if w is not None:
-                self._terminate_worker(w)
+                self._pool.terminate_worker(w)
 
+    # ------------------------------------------------------------------
+    # Placement groups (2PC reserve/commit; reference:
+    # gcs_placement_group_scheduler.cc + bundle_scheduling_policy.cc)
+    # ------------------------------------------------------------------
     def create_placement_group(self, pg_id: bytes, bundles: list[dict],
                                strategy: str) -> bool:
         """Cluster-wide gang reservation: assign each bundle to a node by
         strategy, then 2PC-reserve (all nodes or none — rollback on any
-        failure).  Reference: gcs_placement_group_scheduler.cc reserve/
-        commit + bundle_scheduling_policy.cc strategies."""
+        failure)."""
         assignment = self._assign_bundles(bundles, strategy)
         if assignment is None:
             return False
@@ -368,10 +349,10 @@ class Scheduler:
             else:
                 node = self._cluster_nodes.get(node_id)
                 try:
-                    ok = self._one_shot_rpc(node.sched_socket, "pg_reserve",
-                                            {"pg_id": pg_id,
-                                             "bundles": subset,
-                                             "strategy": strategy})
+                    ok = self._links.one_shot_rpc(
+                        node.sched_socket, "pg_reserve",
+                        {"pg_id": pg_id, "bundles": subset,
+                         "strategy": strategy})
                 except Exception:
                     ok = False
             if not ok:
@@ -384,8 +365,9 @@ class Scheduler:
                 else:
                     node = self._cluster_nodes.get(node_id)
                     try:
-                        self._one_shot_rpc(node.sched_socket, "pg_release",
-                                           {"pg_id": pg_id})
+                        self._links.one_shot_rpc(node.sched_socket,
+                                                 "pg_release",
+                                                 {"pg_id": pg_id})
                     except Exception:
                         pass
             return False
@@ -395,10 +377,9 @@ class Scheduler:
 
     def _assign_bundles(self, bundles: list[dict],
                         strategy: str) -> Optional[list[bytes]]:
-        """Pick a node per bundle from the cluster view; None = infeasible.
-
-        Reads the GCS directly (not the heartbeat-cached view): PG creation
-        is rare and must see nodes that joined within the last tick."""
+        """Build the cluster availability view, then delegate to the bundle
+        policy.  Reads the GCS directly (not the heartbeat-cached view): PG
+        creation is rare and must see nodes that joined in the last tick."""
         with self._lock:
             avail: dict[bytes, dict] = {self.node_id: dict(self.available)}
         try:
@@ -409,60 +390,7 @@ class Scheduler:
         for nid, n in nodes.items():
             if nid != self.node_id and n.alive:
                 avail[nid] = dict(n.available)
-
-        def fits(node_avail: dict, b: dict) -> bool:
-            return all(node_avail.get(k, 0) >= v for k, v in b.items())
-
-        def take(node_avail: dict, b: dict):
-            for k, v in b.items():
-                node_avail[k] = node_avail.get(k, 0) - v
-
-        order = sorted(avail, key=lambda n: -avail[n].get("CPU", 0))
-        assignment: list[Optional[bytes]] = [None] * len(bundles)
-        if strategy in ("STRICT_PACK",):
-            for nid in order:
-                trial = dict(avail[nid])
-                good = True
-                for b in bundles:
-                    if not fits(trial, b):
-                        good = False
-                        break
-                    take(trial, b)
-                if good:
-                    return [nid] * len(bundles)
-            return None
-        if strategy in ("STRICT_SPREAD",):
-            used: set[bytes] = set()
-            for i, b in enumerate(bundles):
-                placed = False
-                for nid in order:
-                    if nid in used or not fits(avail[nid], b):
-                        continue
-                    take(avail[nid], b)
-                    used.add(nid)
-                    assignment[i] = nid
-                    placed = True
-                    break
-                if not placed:
-                    return None
-            return assignment  # type: ignore[return-value]
-        # PACK: prefer fewest nodes (first-fit over pack order);
-        # SPREAD: best-effort round-robin over distinct nodes
-        rr = 0
-        for i, b in enumerate(bundles):
-            placed = False
-            tries = (order if strategy == "PACK"
-                     else order[rr % len(order):] + order[:rr % len(order)])
-            for nid in tries:
-                if fits(avail[nid], b):
-                    take(avail[nid], b)
-                    assignment[i] = nid
-                    placed = True
-                    break
-            if not placed:
-                return None
-            rr += 1
-        return assignment  # type: ignore[return-value]
+        return cluster_mod.assign_bundles(avail, bundles, strategy)
 
     def pg_reserve(self, pg_id: bytes, bundles: dict[int, dict],
                    strategy: str) -> bool:
@@ -529,28 +457,13 @@ class Scheduler:
                 if node is None or not node.alive:
                     continue
                 try:
-                    self._one_shot_rpc(node.sched_socket, "pg_release",
-                                       {"pg_id": pg_id})
+                    self._links.one_shot_rpc(node.sched_socket, "pg_release",
+                                             {"pg_id": pg_id})
                 except Exception:
                     pass
 
     def placement_group_table(self) -> dict:
         return self.gcs.list_pgs()
-
-    def _one_shot_rpc(self, sched_socket: str, method: str, params: dict):
-        """Request/response against a peer scheduler over a fresh
-        connection (the cached peer conns are one-way fire-and-forget)."""
-        conn = protocol.connect(sched_socket)
-        try:
-            conn.send({"t": "rpc", "method": method, "params": params})
-            resp = conn.recv()
-        finally:
-            conn.close()
-        if resp is None or not resp.get("ok"):
-            raise RuntimeError(
-                f"peer rpc {method} failed: "
-                f"{resp.get('error') if resp else 'connection closed'}")
-        return resp["result"]
 
     def state_snapshot(self) -> dict:
         with self._lock:
@@ -572,53 +485,22 @@ class Scheduler:
     def shutdown(self):
         with self._lock:
             self._shutdown = True
-            workers = list(self._workers.values())
             self._wake.notify_all()
-        for w in workers:
-            try:
-                w.proc.terminate()
-            except OSError:
-                pass
-        for w in workers:
-            try:
-                w.proc.wait(timeout=2)
-            except subprocess.TimeoutExpired:
-                w.proc.kill()
+        self._pool.shutdown_all()
         try:
             self._listener.close()
         except OSError:
             pass
-        try:
-            os.unlink(self.socket_path)
-        except OSError:
-            pass
+        if self.socket_path.startswith("/"):
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
         self._store.close()
 
     # ------------------------------------------------------------------
-    # Worker pool
+    # Node service: worker + peer connections
     # ------------------------------------------------------------------
-    def _spawn_worker(self) -> WorkerState:
-        worker_id = os.urandom(8)
-        env = dict(os.environ)
-        env.update(self.worker_env)
-        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
-        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
-        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
-        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_main",
-             "--scheduler-socket", self.socket_path,
-             "--store-socket", self.store_socket,
-             "--shm-name", self.shm_name,
-             "--store-capacity", str(self.store_capacity),
-             "--worker-id", worker_id.hex()],
-            env=env,
-        )
-        w = WorkerState(worker_id=worker_id, proc=proc)
-        self._workers[worker_id] = w
-        return w
-
     def _accept_loop(self):
         while not self._shutdown:
             try:
@@ -630,6 +512,10 @@ class Scheduler:
                              daemon=True).start()
 
     def _reader_loop(self, conn: Connection):
+        # TCP peers must pass the cluster-token handshake before any frame
+        # of theirs is unpickled (see protocol.py).
+        if not authenticate_server_side(conn, self._is_tcp):
+            return
         worker: Optional[WorkerState] = None
         while True:
             msg = conn.recv()
@@ -659,10 +545,7 @@ class Scheduler:
             elif t == "sealed":
                 # a worker sealed an object into this node's store: record
                 # the location so other nodes can pull it
-                try:
-                    self.gcs.add_object_location(msg["oid"], self.node_id)
-                except Exception:
-                    pass
+                self.note_sealed(msg["oid"])
             elif t == "submit_spilled":
                 self.submit_spilled(msg["spec"])
             elif t == "spilled_done":
@@ -804,8 +687,9 @@ class Scheduler:
         if method == "object_locations":
             return self.gcs.get_object_locations(params["oid"])
         if method == "fetch_object":
-            return self._serve_fetch(params["oid"], params.get("offset", 0),
-                                     params.get("chunk", FETCH_CHUNK))
+            return self._transfer.serve_fetch(
+                params["oid"], params.get("offset", 0),
+                params.get("chunk", FETCH_CHUNK))
         if method == "note_sealed":
             self.note_sealed(params["oid"])
             return True
@@ -835,105 +719,12 @@ class Scheduler:
             return self._store.stats()
         raise ValueError(f"unknown rpc method {method!r}")
 
-    # ------------------------------------------------------------------
-    # Cluster: object transfer (reference: object_manager/ push/pull —
-    # chunked transfer, pull retry over locations)
-    # ------------------------------------------------------------------
+    # -- object transfer passthrough (see _private/object_transfer.py) ------
     def note_sealed(self, oid: bytes):
-        """Record that this node's store holds a sealed copy of oid."""
-        try:
-            self.gcs.add_object_location(oid, self.node_id)
-        except Exception:
-            pass
+        self._transfer.note_sealed(oid)
 
     def trigger_pull(self, oid: bytes) -> bool:
-        """Start (or join) an async pull of oid into the local store."""
-        with self._pull_lock:
-            if oid in self._pulls:
-                return False
-            self._pulls.add(oid)
-        threading.Thread(target=self._pull_object, args=(oid,),
-                         daemon=True).start()
-        return True
-
-    def _pull_object(self, oid: bytes):
-        """One pull attempt: if any remote node holds the object, fetch it.
-
-        Exits immediately when no remote copy exists yet (the object is
-        still being computed) — the waiting getter re-requests the pull
-        periodically, so there is no long-lived polling thread per object
-        and no deadline after which a slow producer's result becomes
-        unfetchable."""
-        try:
-            for _ in range(3):  # a few attempts over the location set
-                if self._shutdown:
-                    return
-                try:
-                    if self._store.contains(oid):
-                        return
-                    locs = self.gcs.get_object_locations(oid)
-                except Exception:
-                    return
-                remote = [n for n in locs if n != self.node_id]
-                if not remote:
-                    return  # not sealed anywhere else yet
-                for nid in remote:
-                    node = self._cluster_nodes.get(nid) or self.gcs.get_node(nid)
-                    if node is None or not node.alive or not node.sched_socket:
-                        continue
-                    if self._fetch_from(node.sched_socket, oid):
-                        self.note_sealed(oid)
-                        return
-                time.sleep(0.1)
-        finally:
-            with self._pull_lock:
-                self._pulls.discard(oid)
-
-    def _fetch_from(self, sched_socket: str, oid: bytes) -> bool:
-        """Chunked fetch over a dedicated connection (big transfers must not
-        head-of-line-block control messages)."""
-        try:
-            conn = protocol.connect(sched_socket)
-        except OSError:
-            return False
-        try:
-            data = bytearray()
-            size = None
-            while size is None or len(data) < size:
-                conn.send({"t": "rpc", "method": "fetch_object",
-                           "params": {"oid": oid, "offset": len(data),
-                                      "chunk": FETCH_CHUNK}})
-                resp = conn.recv()
-                if (resp is None or not resp.get("ok")
-                        or not resp["result"]["found"]):
-                    return False
-                r = resp["result"]
-                size = r["size"]
-                data += r["data"]
-                if size == 0:
-                    break
-            try:
-                buf = self._store.create(oid, len(data))
-                buf[:len(data)] = bytes(data)
-                self._store.seal(oid)
-            except FileExistsError:
-                pass  # concurrent pull/local compute won the race
-            return True
-        except OSError:
-            return False
-        finally:
-            conn.close()
-
-    def _serve_fetch(self, oid: bytes, offset: int, chunk: int) -> dict:
-        view = self._store.get(oid, 0)
-        if view is None:
-            return {"found": False}
-        try:
-            size = len(view)
-            return {"found": True, "size": size,
-                    "data": bytes(view[offset:offset + chunk])}
-        finally:
-            self._store.release(oid)
+        return self._transfer.trigger_pull(oid)
 
     # ------------------------------------------------------------------
     # Cluster: peer forwarding + liveness (reference: ray_syncer resource
@@ -971,32 +762,6 @@ class Scheduler:
                     traceback.print_exc()
             time.sleep(0.25 if len(self._known_alive) > 1 else 0.5)
 
-    def _peer_send(self, node_id: bytes, msg: dict) -> bool:
-        """Send a one-way control message to another node's scheduler."""
-        with self._peer_lock:
-            conn = self._peers.get(node_id)
-            if conn is None:
-                node = self._cluster_nodes.get(node_id)
-                if node is None:
-                    try:
-                        node = self.gcs.get_node(node_id)
-                    except Exception:
-                        node = None
-                if node is None or not node.alive or not node.sched_socket:
-                    return False
-                try:
-                    conn = protocol.connect(node.sched_socket)
-                except OSError:
-                    return False
-                self._peers[node_id] = conn
-        try:
-            conn.send(msg)
-            return True
-        except OSError:
-            with self._peer_lock:
-                self._peers.pop(node_id, None)
-            return False
-
     def _forward(self, spec: TaskSpec, node_id: bytes) -> bool:
         """Hand a pending spec to another node (caller holds the lock).
 
@@ -1014,7 +779,7 @@ class Scheduler:
         relay = spec.origin_node is not None and spec.origin_node != self.node_id
         if not relay:
             spec.origin_node = self.node_id
-        if not self._peer_send(node_id, {"t": "submit_spilled", "spec": spec}):
+        if not self._links.send(node_id, {"t": "submit_spilled", "spec": spec}):
             if not relay:
                 spec.origin_node = None
             return False
@@ -1024,7 +789,7 @@ class Scheduler:
         # cross-node task aggregation to avoid double counting
         self._record_task_event_locked(spec, "FORWARDED")
         if relay:
-            self._peer_send(spec.origin_node, {
+            self._links.send(spec.origin_node, {
                 "t": "spill_moved", "task_id": spec.task_id,
                 "node": node_id})
         else:
@@ -1036,15 +801,14 @@ class Scheduler:
 
     def _notify_origin(self, spec: TaskSpec):
         if spec.origin_node and spec.origin_node != self.node_id:
-            self._peer_send(spec.origin_node,
-                            {"t": "spilled_done", "task_id": spec.task_id})
+            self._links.send(spec.origin_node,
+                             {"t": "spilled_done", "task_id": spec.task_id})
 
     def _on_node_dead(self, node_id: bytes):
         """Reconcile after a peer died: recover forwarded specs; on the
         head, restart (or fail) actors that lived there (reference:
         gcs_actor_manager.cc:1319 OnActorDead/RestartActor)."""
-        with self._peer_lock:
-            self._peers.pop(node_id, None)
+        self._links.drop(node_id)
         with self._lock:
             orphaned = [(tid, spec) for tid, (nid, spec)
                         in self._forwarded.items() if nid == node_id]
@@ -1091,6 +855,9 @@ class Scheduler:
                     info.actor_id, state=gcs_mod.DEAD,
                     death_cause=f"node {node_id.hex()[:8]} died")
 
+    # ------------------------------------------------------------------
+    # Worker lifecycle events
+    # ------------------------------------------------------------------
     def _on_worker_blocked(self, worker: WorkerState):
         with self._lock:
             worker.blocked_count += 1
@@ -1251,12 +1018,6 @@ class Scheduler:
         spec.return_ids = []  # restart produces no new creation return
         return spec
 
-    def _terminate_worker(self, w: WorkerState):
-        try:
-            w.proc.terminate()
-        except OSError:
-            pass
-
     def _release_worker_grants(self, worker: WorkerState):
         if worker.held_pg is not None:
             pg_id, bundle = worker.held_pg
@@ -1286,7 +1047,7 @@ class Scheduler:
         self._notify_origin(spec)
 
     # ------------------------------------------------------------------
-    # Scheduling loop
+    # Local dispatch loop (reference: local_task_manager.cc)
     # ------------------------------------------------------------------
     def _schedule_loop(self):
         while True:
@@ -1467,14 +1228,7 @@ class Scheduler:
                 # it is alive (reference: scheduling_strategies.py:41).
                 # The cached view lags new registrations by a heartbeat
                 # tick, so miss -> authoritative GCS lookup (rare path).
-                target = self._cluster_nodes.get(spec.node_affinity)
-                if target is None:
-                    try:
-                        target = self.gcs.get_node(spec.node_affinity)
-                        if target is not None:
-                            self._cluster_nodes[spec.node_affinity] = target
-                    except Exception:
-                        target = None
+                target = self._lookup_node(spec.node_affinity)
                 if target is not None and target.alive:
                     if self._forward(spec, spec.node_affinity):
                         progress = True
@@ -1491,17 +1245,19 @@ class Scheduler:
                 # soft affinity to a dead node: fall through, run anywhere
             granted = self._acquire_resources(spec)
             if granted is None:
-                target = self._spill_target(spec)
+                target = cluster_mod.pick_spill_target(
+                    spec, self.node_id, self.total_resources,
+                    self._cluster_nodes)
                 if target is not None and self._forward(spec, target):
                     progress = True
                 else:
                     remaining.append(spec)
                 continue
-            w = self._find_idle_worker()
+            w = self._pool.find_idle_worker()
             if w is None:
                 self._return_resources(spec, granted)
                 remaining.append(spec)
-                self._maybe_grow_pool()
+                self._pool.maybe_grow()
                 continue
             w.idle = False
             w.held_resources = granted
@@ -1520,46 +1276,6 @@ class Scheduler:
             progress = True
         self._pending = remaining
         return progress
-
-    def _spill_target(self, spec: TaskSpec) -> Optional[bytes]:
-        """Pick a peer node for a task this node can't run right now
-        (reference: hybrid policy spillback,
-        policy/hybrid_scheduling_policy.cc — local-first, then best
-        feasible remote by available capacity).  Caller holds the lock."""
-        if spec.pg_id is not None or spec.spill_count >= MAX_SPILLS:
-            return None  # PG bundles are reserved on this node
-        if (spec.node_affinity == self.node_id
-                and not spec.affinity_soft):
-            return None
-        res = spec.resources or {}
-        locally_feasible = all(
-            self.total_resources.get(k, 0) >= v for k, v in res.items())
-        best, best_score = None, -1.0
-        for nid, node in self._cluster_nodes.items():
-            if nid == self.node_id or not node.alive:
-                continue
-            if not all(node.resources.get(k, 0) >= v
-                       for k, v in res.items()):
-                continue  # never feasible there
-            has_now = all(node.available.get(k, 0) >= v
-                          for k, v in res.items())
-            if not has_now and locally_feasible:
-                # feasible here eventually: only spill to nodes with free
-                # capacity right now
-                continue
-            score = (1000.0 if has_now else 0.0) + sum(
-                node.available.get(k, 0) for k in ("CPU", "TPU"))
-            if score > best_score:
-                best, best_score = nid, score
-        if best is not None:
-            spec.spill_count += 1
-            # debit the cached view so the NEXT task in this scheduling
-            # pass picks a different node instead of dogpiling this one;
-            # the target's own heartbeat re-syncs the true value
-            avail = self._cluster_nodes[best].available
-            for k, v in res.items():
-                avail[k] = avail.get(k, 0) - v
-        return best
 
     def _acquire_resources(self, spec: TaskSpec) -> Optional[dict]:
         res = spec.resources or {}
@@ -1592,18 +1308,6 @@ class Scheduler:
         else:
             for k, v in granted.items():
                 self.available[k] = self.available.get(k, 0) + v
-
-    def _find_idle_worker(self) -> Optional[WorkerState]:
-        for w in self._workers.values():
-            if w.alive and w.idle and w.conn is not None and w.actor_id is None:
-                return w
-        return None
-
-    def _maybe_grow_pool(self):
-        n_normal = len([w for w in self._workers.values()
-                        if w.alive and w.actor_id is None])
-        if n_normal < self.max_workers:
-            self._spawn_worker()
 
     def _dispatch(self, w: WorkerState, spec: TaskSpec):
         self._record_task_event(spec, "RUNNING", worker_id=w.worker_id)

@@ -1,0 +1,57 @@
+"""Task specification: the unit of work the runtime schedules.
+
+Counterpart of the reference's ``TaskSpecification``
+(/root/reference/src/ray/common/task/task_spec.h): one record carrying
+everything a node needs to execute a task, an actor creation, or an actor
+method — function blob id, pickled args, return object ids, resource asks,
+placement-group/bundle binding, retry budgets, and cluster-scheduling
+bookkeeping (spill counts, affinity, origin node for spillback recovery).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+TASK = "task"
+ACTOR_CREATION = "actor_creation"
+ACTOR_METHOD = "actor_method"
+
+# Cross-node object transfer chunk (reference: object_manager.h:53
+# object_chunk_size, ~1-5MB); bounds per-message memory during pulls.
+FETCH_CHUNK = 4 << 20
+# A task may spill between nodes at most this many times before it settles
+# where it is (prevents forwarding ping-pong under racing load reports).
+MAX_SPILLS = 4
+
+
+@dataclass
+class TaskSpec:
+    task_id: bytes
+    kind: str  # TASK | ACTOR_CREATION | ACTOR_METHOD
+    fn_id: bytes  # GCS KV key of the pickled function/class
+    args_blob: bytes  # cloudpickle of (args, kwargs) with ObjectRef markers
+    return_ids: list[bytes]
+    resources: dict = field(default_factory=dict)
+    actor_id: Optional[bytes] = None
+    method_name: Optional[str] = None
+    name: str = ""
+    max_retries: int = 0
+    retries_left: int = 0
+    max_restarts: int = 0
+    max_concurrency: int = 1
+    actor_name: Optional[str] = None
+    pg_id: Optional[bytes] = None
+    pg_bundle: Optional[int] = None
+    runtime_env: Optional[dict] = None
+    # "device": return value stays resident on the producing actor (HBM for
+    # jax.Arrays); the store gets a marker (reference: GPU objects / RDT,
+    # python/ray/_private/gpu_object_manager.py:16)
+    tensor_transport: Optional[str] = None
+    # cluster scheduling (reference: hybrid policy spillback,
+    # src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.cc, and
+    # NodeAffinitySchedulingStrategy, util/scheduling_strategies.py:41)
+    spill_count: int = 0
+    node_affinity: Optional[bytes] = None
+    affinity_soft: bool = True
+    origin_node: Optional[bytes] = None  # forwarder to notify on completion

@@ -22,7 +22,7 @@ import cloudpickle
 
 from ray_tpu._private import protocol
 from ray_tpu._private import runtime_env as runtime_env_mod
-from ray_tpu._private.scheduler import ACTOR_CREATION, ACTOR_METHOD, TaskSpec
+from ray_tpu._private.task_spec import ACTOR_CREATION, ACTOR_METHOD, TaskSpec
 from ray_tpu._private.serialization import store_error_best_effort
 from ray_tpu._private.worker import WorkerContext, set_global_worker
 from ray_tpu.core.object_ref import ObjectRef
@@ -34,7 +34,7 @@ class WorkerRuntime:
         self.worker_id = bytes.fromhex(args.worker_id)
         self.store = StoreClient(args.store_socket, args.shm_name,
                                  args.store_capacity)
-        self.conn = protocol.connect(args.scheduler_socket)
+        self.conn = protocol.connect_addr(args.scheduler_socket)
         self.scheduler_socket = args.scheduler_socket
         self.actors: dict[bytes, object] = {}
         self.actor_pools: dict[bytes, ThreadPoolExecutor] = {}
@@ -54,7 +54,7 @@ class WorkerRuntime:
         set_global_worker(self.ctx)
 
     def _rpc(self, method: str, params: dict):
-        conn = protocol.connect(self.scheduler_socket)
+        conn = protocol.connect_addr(self.scheduler_socket)
         try:
             conn.send({"t": "rpc", "method": method, "params": params})
             resp = conn.recv()

@@ -184,7 +184,7 @@ class StandardAutoscaler:
 
     @staticmethod
     def _node_rpc(sock: str, method: str, params: Optional[dict] = None):
-        conn = protocol.connect(sock)
+        conn = protocol.connect_addr(sock)
         try:
             conn.send({"t": "rpc", "method": method, "params": params or {}})
             resp = conn.recv()

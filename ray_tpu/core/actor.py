@@ -14,7 +14,7 @@ from typing import Optional
 import cloudpickle
 
 from ray_tpu._private import ids
-from ray_tpu._private.scheduler import ACTOR_CREATION, ACTOR_METHOD, TaskSpec
+from ray_tpu._private.task_spec import ACTOR_CREATION, ACTOR_METHOD, TaskSpec
 from ray_tpu._private.worker import global_worker
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu._private.runtime_env import package as package_runtime_env

@@ -31,7 +31,7 @@ _INDEX_HTML = """<!doctype html><title>ray_tpu dashboard</title>
 
 
 def _node_rpc(sock: str, method: str, params: Optional[dict] = None):
-    conn = protocol.connect(sock)
+    conn = protocol.connect_addr(sock)
     try:
         conn.send({"t": "rpc", "method": method, "params": params or {}})
         resp = conn.recv()

@@ -19,7 +19,7 @@ __all__ = ["JobSubmissionClient", "JobStatus", "JobInfo"]
 
 
 def _rpc(sock: str, method: str, params: Optional[dict] = None):
-    conn = protocol.connect(sock)
+    conn = protocol.connect_addr(sock)
     try:
         conn.send({"t": "rpc", "method": method, "params": params or {}})
         resp = conn.recv()

@@ -44,7 +44,7 @@ def _ping(sock: str) -> bool:
 
 
 def _rpc(sock: str, method: str, params: Optional[dict] = None):
-    conn = protocol.connect(sock)
+    conn = protocol.connect_addr(sock)
     try:
         conn.send({"t": "rpc", "method": method, "params": params or {}})
         resp = conn.recv()

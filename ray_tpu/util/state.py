@@ -21,7 +21,7 @@ def _rpc(method: str, params: Optional[dict] = None):
 
 def _node_rpc(sched_socket: str, method: str, params: Optional[dict] = None):
     """One-shot rpc against a specific node's scheduler."""
-    conn = protocol.connect(sched_socket)
+    conn = protocol.connect_addr(sched_socket)
     try:
         conn.send({"t": "rpc", "method": method, "params": params or {}})
         resp = conn.recv()
