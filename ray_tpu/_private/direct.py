@@ -1,0 +1,542 @@
+"""Direct actor-call transport + in-process memory store.
+
+Counterpart of the reference's core-worker fast paths:
+
+- ``CoreWorkerMemoryStore`` (/root/reference/src/ray/core_worker/
+  store_provider/memory_store/): small objects never touch the node's shm
+  store daemon — results of direct actor calls land in the CALLER's
+  in-process memory store and ``get`` resolves them with a condvar wake,
+  not a daemon round-trip.
+- Direct task push (``normal_task_submitter.cc:548`` PushNormalTask /
+  ``actor_task_submitter.cc``): once an actor is ALIVE, method calls flow
+  caller → actor worker over a dedicated connection, bypassing the node
+  scheduler entirely.  The scheduler still PLACES actors (the lease); the
+  steady-state hot path is two processes and one socket.
+
+Ordering: one connection per (caller, actor) gives per-caller FIFO — the
+same guarantee the reference's ActorSchedulingQueue enforces.  The caller
+only switches to the direct path once no scheduler-path calls to that actor
+are outstanding (see WorkerContext.submit_actor_method), so the transition
+window cannot reorder.
+
+Failure model: any transport error (including injected RPC chaos) collapses
+to "connection lost".  The caller then re-resolves the actor: still ALIVE
+at the same address → reconnect and RESEND outstanding calls (the worker
+dedups by task id and replays cached replies, making resend exactly-once);
+restarted elsewhere or DEAD → outstanding calls fail with ActorDiedError,
+matching the scheduler path's semantics for in-flight methods.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from collections import OrderedDict
+from typing import Callable, Optional
+
+from ray_tpu._private import protocol
+from ray_tpu._private import serialization
+from ray_tpu.exceptions import ActorDiedError
+
+# Results at or below this serialized size return inline in the reply and
+# live in the caller's memory store; larger results go through the shm
+# store as before (reference: max_direct_call_object_size, 100KB).
+INLINE_MAX = int(os.environ.get("RTPU_INLINE_MAX", 100 * 1024))
+
+_MEMSTORE_MAX_ENTRIES = int(os.environ.get("RTPU_MEMSTORE_ENTRIES", 65536))
+_MEMSTORE_MAX_BYTES = int(os.environ.get("RTPU_MEMSTORE_BYTES", 256 << 20))
+
+
+class _Entry:
+    __slots__ = ("event", "payload", "in_store", "promoted", "escaped")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.payload: Optional[bytes] = None  # store-format payload
+        self.in_store = False  # result went to the shm store instead
+        self.promoted = False  # payload was copied to the shm store too
+        # the ref was pickled while the call was still in flight: the value
+        # must be promoted to the shm store the moment it arrives, because
+        # another process may already be blocking on it there
+        self.escaped = False
+
+
+class MemoryStore:
+    """In-process object store for small objects (store-format payloads).
+
+    States per oid: pending (direct call in flight), fulfilled (payload
+    bytes present), or in-store (value lives in the shm store — callers
+    fall through to the daemon path).  Bounded: oldest fulfilled entries
+    are promoted to the shm store and dropped when over the cap.
+    """
+
+    def __init__(self, promote_cb: Optional[Callable[[bytes, bytes], None]] = None):
+        # RLock: ObjectRef.__del__ (GC, can fire on ANY thread at ANY
+        # point, including while this very thread holds the lock) calls
+        # discard() — a plain Lock would self-deadlock.
+        self._lock = threading.RLock()
+        self._entries: OrderedDict[bytes, _Entry] = OrderedDict()
+        self._bytes = 0
+        self._promote_cb = promote_cb
+
+    def expect(self, oid: bytes) -> None:
+        """Pre-register a pending entry (a direct call will fulfill it)."""
+        with self._lock:
+            if oid not in self._entries:
+                self._entries[oid] = _Entry()
+
+    def put_payload(self, oid: bytes, payload: bytes) -> None:
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is None:
+                # no expect()ed entry: the last local ref was dropped
+                # (fire-and-forget call) — nobody can ever read this
+                return
+            if e.event.is_set():
+                return  # first fulfillment wins (retried call)
+            e.payload = payload
+            self._bytes += len(payload)
+            escaped = e.escaped and not e.promoted
+            if escaped:
+                e.promoted = True
+            e.event.set()
+            evict = self._over_cap_locked()
+        if escaped and self._promote_cb is not None:
+            # the ref left this process while the call was in flight;
+            # someone may be blocking on the shm store for it right now
+            try:
+                self._promote_cb(oid, payload)
+            except Exception:
+                pass
+        for oid_e, payload_e in evict:
+            if self._promote_cb is not None:
+                try:
+                    self._promote_cb(oid_e, payload_e)
+                except Exception:
+                    pass
+
+    def mark_escaped(self, oid: bytes) -> Optional[bytes]:
+        """The ref is being pickled (may leave the process).  Returns a
+        payload the CALLER must promote to the shm store now (fulfilled,
+        unpromoted entries); pending entries are flagged and promote
+        themselves on delivery."""
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is None or e.in_store or e.promoted:
+                return None
+            if not e.event.is_set():
+                e.escaped = True
+                return None
+            e.promoted = True
+            return e.payload
+
+    def mark_in_store(self, oid: bytes) -> None:
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is None:
+                return  # last local ref dropped; store copy stands alone
+            if not e.event.is_set():
+                e.in_store = True
+                e.event.set()
+
+    def _over_cap_locked(self) -> list[tuple[bytes, bytes]]:
+        """Collect fulfilled entries to evict (promote) — caller promotes
+        outside the lock."""
+        evict: list[tuple[bytes, bytes]] = []
+        while (len(self._entries) > _MEMSTORE_MAX_ENTRIES
+               or self._bytes > _MEMSTORE_MAX_BYTES):
+            victim = None
+            for oid, e in self._entries.items():
+                if e.event.is_set():
+                    victim = (oid, e)
+                    break
+            if victim is None:
+                break  # only pending entries left: nothing evictable
+            oid, e = victim
+            del self._entries[oid]
+            if e.payload is not None:
+                self._bytes -= len(e.payload)
+                evict.append((oid, e.payload))
+        return evict
+
+    def lookup(self, oid: bytes) -> Optional[_Entry]:
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is not None:
+                self._entries.move_to_end(oid)  # LRU touch
+            return e
+
+    def contains_value(self, oid: bytes) -> bool:
+        """True if a payload is present RIGHT NOW (for wait())."""
+        e = self.lookup(oid)
+        return e is not None and e.event.is_set() and not e.in_store
+
+    def discard(self, oid: bytes) -> None:
+        with self._lock:
+            e = self._entries.pop(oid, None)
+            if e is not None and e.payload is not None:
+                self._bytes -= len(e.payload)
+
+
+def fail_payload(exc: BaseException, tb: str = "") -> bytes:
+    """Store-format error payload (get() on it raises, like the store)."""
+    return serialization.serialize_error(exc, tb)
+
+
+# ---------------------------------------------------------------------------
+# Caller side
+# ---------------------------------------------------------------------------
+
+class _Channel:
+    """One caller's connection to one actor's worker process.
+
+    Per-caller FIFO holds across transport failures: the channel repairs
+    itself IN PLACE under its lock — outstanding calls are resent over the
+    fresh connection before any new ``call`` (blocked on the lock) can
+    send, so resends can never be overtaken.  Repair gives up (and fails
+    the outstanding calls with ActorDiedError) when the actor is no longer
+    ALIVE at this address.
+    """
+
+    def __init__(self, actor_id: bytes, addr: str, client: "DirectClient"):
+        self.actor_id = actor_id
+        self.addr = addr
+        self._client = client
+        self._conn = protocol.connect_addr(addr, timeout=5.0)
+        self._lock = threading.Lock()
+        # task_id -> spec, in send order (for resend after reconnect)
+        self._outstanding: OrderedDict[bytes, object] = OrderedDict()
+        self.dead = False
+        self._epoch = 0  # bumps per successful repair; stale readers exit
+        self._start_reader(self._conn, self._epoch)
+
+    def _start_reader(self, conn, epoch: int):
+        threading.Thread(target=self._read_loop, args=(conn, epoch),
+                         name="direct-read", daemon=True).start()
+
+    def call(self, spec) -> bool:
+        with self._lock:
+            if self.dead:
+                return False
+            self._outstanding[spec.task_id] = spec
+            for oid in spec.return_ids:
+                self._client.memstore.expect(oid)
+            try:
+                self._conn.send({"t": "call", "spec": spec})
+            except (OSError, ConnectionError):
+                # the repair path owns it now (runs under this same lock
+                # from the reader thread once it sees the broken conn)
+                pass
+            return True
+
+    def _read_loop(self, conn, epoch: int):
+        while True:
+            try:
+                msg = conn.recv()
+            except (OSError, ConnectionError):
+                msg = None
+            if msg is None:
+                self._on_broken(conn, epoch)
+                return
+            if msg.get("t") != "result":
+                continue
+            self._deliver(msg)
+
+    def _deliver(self, msg: dict):
+        task_id = msg["task_id"]
+        with self._lock:
+            spec = self._outstanding.pop(task_id, None)
+        if spec is None:
+            return
+        if msg.get("in_store"):
+            for oid in spec.return_ids:
+                self._client.memstore.mark_in_store(oid)
+        else:
+            self._client.memstore.put_payload(
+                spec.return_ids[0], msg["payload"])
+
+    def _on_broken(self, conn, epoch: int):
+        """Connection lost (EOF, reset, or injected chaos): repair in
+        place — reconnect and resend outstanding calls while holding the
+        channel lock, so no new call can jump the queue; if the actor is
+        gone, fail the outstanding calls and retire the channel."""
+        with self._lock:
+            if self.dead or epoch != self._epoch:
+                return  # a newer incarnation already took over
+            try:
+                conn.close()
+            except OSError:
+                pass
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                state, addr = self._client.resolve(self.actor_id,
+                                                   use_cache=False)
+                if state is None:
+                    # resolve itself failed (transient control-plane error,
+                    # e.g. injected chaos): retry within the deadline
+                    time.sleep(0.1)
+                    continue
+                if state != "ALIVE" or addr != self.addr:
+                    break  # dead/restarting/moved: in-flight calls are lost
+                try:
+                    fresh = protocol.connect_addr(self.addr, timeout=5.0)
+                    for spec in self._outstanding.values():
+                        fresh.send({"t": "call", "spec": spec})
+                except (OSError, ConnectionError):
+                    time.sleep(0.1)
+                    continue
+                self._conn = fresh
+                self._epoch += 1
+                self._start_reader(fresh, self._epoch)
+                return
+            # actor unreachable: retire the channel, fail what's in flight
+            self.dead = True
+            pending = list(self._outstanding.values())
+            self._outstanding.clear()
+        self._client._forget(self.actor_id, self)
+        err = fail_payload(ActorDiedError(
+            "actor died while executing method (direct call lost)"))
+        for spec in pending:
+            for oid in spec.return_ids:
+                self._client.memstore.put_payload(oid, err)
+
+
+class DirectClient:
+    """Per-process registry of direct channels + actor address cache.
+    Caller identity IS the connection — per-caller FIFO comes from each
+    caller owning its own channel to the actor."""
+
+    def __init__(self, memstore: MemoryStore, rpc: Callable):
+        self.memstore = memstore
+        self._rpc = rpc  # scheduler rpc(method, params)
+        self._channels: dict[bytes, _Channel] = {}
+        self._addr_cache: dict[bytes, tuple[float, str, Optional[str]]] = {}
+        self._lock = threading.Lock()
+
+    def resolve(self, actor_id: bytes,
+                use_cache: bool = True) -> tuple[Optional[str], Optional[str]]:
+        """(state, addr) for an actor, with a short TTL cache."""
+        now = time.monotonic()
+        if use_cache:
+            hit = self._addr_cache.get(actor_id)
+            if hit is not None and now - hit[0] < 1.0:
+                return hit[1], hit[2]
+        try:
+            info = self._rpc("actor_addr", {"actor_id": actor_id})
+        except Exception:
+            return None, None
+        if info is None:
+            self._addr_cache[actor_id] = (now, None, None)
+            return None, None
+        self._addr_cache[actor_id] = (now, info["state"], info.get("addr"))
+        return info["state"], info.get("addr")
+
+    def submit(self, spec) -> bool:
+        """Try to push an actor method directly; False -> use the
+        scheduler path."""
+        # A live channel short-circuits resolution: while calls are in
+        # flight a transient resolve failure must not bounce this caller
+        # back to the scheduler path (that could reorder its stream).
+        with self._lock:
+            chan = self._channels.get(spec.actor_id)
+        if chan is not None and not chan.dead and chan.call(spec):
+            return True
+        state, addr = self.resolve(spec.actor_id)
+        if state != "ALIVE" or not addr:
+            return False
+        try:
+            chan = self._channel_for(spec.actor_id, addr)
+        except (OSError, ConnectionError):
+            self._addr_cache.pop(spec.actor_id, None)
+            return False
+        return chan.call(spec)
+
+    def _channel_for(self, actor_id: bytes, addr: str) -> _Channel:
+        with self._lock:
+            chan = self._channels.get(actor_id)
+            if chan is not None and not chan.dead and chan.addr == addr:
+                return chan
+            chan = _Channel(actor_id, addr, self)
+            self._channels[actor_id] = chan
+            return chan
+
+    def _forget(self, actor_id: bytes, chan: "_Channel"):
+        with self._lock:
+            if self._channels.get(actor_id) is chan:
+                del self._channels[actor_id]
+        self._addr_cache.pop(actor_id, None)
+
+
+# ---------------------------------------------------------------------------
+# Worker (callee) side
+# ---------------------------------------------------------------------------
+
+class DirectServer:
+    """Per-worker listener executing direct actor calls.
+
+    Replies inline for small single-return results; stores large/multi
+    results in the shm store and replies in_store.  Dedups by task id so a
+    caller reconnect-and-resend (chaos / transient transport loss) replays
+    the cached reply instead of re-executing — effective exactly-once.
+    """
+
+    def __init__(self, runtime, bind_addr: str):
+        self._runtime = runtime  # WorkerRuntime (worker_main)
+        self._listener, self.addr = protocol.listener_addr(bind_addr)
+        self._is_tcp = protocol.is_tcp_addr(self.addr)
+        # task_id -> reply dict (completed) | threading.Event (running)
+        self._done: OrderedDict[bytes, dict] = OrderedDict()
+        self._done_bytes = 0
+        self._done_bytes_cap = 32 << 20  # inline payloads pinned for dedup
+        self._running: dict[bytes, threading.Event] = {}
+        self._state_lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="direct-accept", daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self):
+        while True:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            conn = protocol.Connection(sock)
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: protocol.Connection):
+        # TCP callers must pass the cluster-token handshake before any
+        # frame of theirs is unpickled (see protocol.py).
+        if not protocol.authenticate_server_side(conn, self._is_tcp):
+            return
+        while True:
+            try:
+                msg = conn.recv()
+            except (OSError, ConnectionError):
+                conn.close()
+                return
+            if msg is None:
+                conn.close()
+                return
+            t = msg.get("t")
+            if t == "hello":
+                continue
+            if t != "call":
+                continue
+            spec = msg["spec"]
+            self._handle_call(spec, conn)
+
+    def _send_reply(self, conn: protocol.Connection, reply: dict):
+        try:
+            conn.send(reply)
+        except (OSError, ConnectionError):
+            # Reply lost (incl. injected chaos): promote to connection
+            # loss so the caller's resend path takes over; the cached
+            # reply serves the resend.
+            conn.close()
+
+    def _handle_call(self, spec, conn: protocol.Connection):
+        with self._state_lock:
+            cached = self._done.get(spec.task_id)
+            if cached is not None:
+                running = None
+            else:
+                running = self._running.get(spec.task_id)
+                if running is None:
+                    self._running[spec.task_id] = threading.Event()
+        if cached is not None:
+            self._send_reply(conn, cached)
+            return
+        if running is not None:
+            # duplicate of an in-flight call (resend after reconnect):
+            # wait for the original execution — however long it takes
+            # (the scheduler path imposes no method deadline either) —
+            # then replay its reply
+            while not running.wait(timeout=60):
+                pass
+            with self._state_lock:
+                cached = self._done.get(spec.task_id)
+            self._send_reply(conn, cached or {
+                "t": "result", "task_id": spec.task_id, "ok": False,
+                "in_store": False,
+                "payload": fail_payload(RuntimeError(
+                    "duplicate direct call completed without a reply"))})
+            return
+        rt = self._runtime
+        pool = rt.actor_pools.get(spec.actor_id)
+        if pool is not None:
+            # Concurrent actor (max_concurrency > 1): execute on the pool
+            # and reply from the completion callback, so one slow call
+            # doesn't serialize this caller's other in-flight calls.
+            fut = pool.submit(rt.run_actor_method, spec)
+            fut.add_done_callback(
+                lambda f: self._complete(spec, self._reply_from(spec, f),
+                                         conn))
+            return
+        with rt.actor_lock(spec.actor_id):
+            try:
+                result = rt.run_actor_method(spec)
+                reply = self._pack_result(spec, result)
+            except BaseException as e:  # noqa: BLE001 — ship to caller
+                reply = self._pack_error(spec, e, traceback.format_exc())
+        self._complete(spec, reply, conn)
+
+    def _reply_from(self, spec, fut) -> dict:
+        exc = fut.exception()
+        if exc is not None:
+            return self._pack_error(spec, exc, "")
+        try:
+            return self._pack_result(spec, fut.result())
+        except BaseException as e:  # noqa: BLE001
+            return self._pack_error(spec, e, traceback.format_exc())
+
+    def _complete(self, spec, reply: dict, conn: protocol.Connection):
+        with self._state_lock:
+            self._done[spec.task_id] = reply
+            self._done_bytes += len(reply.get("payload") or b"")
+            # Bounded by count AND bytes: the cache only needs to cover the
+            # caller's reconnect window (sub-second), so eviction far
+            # beyond that is safe — a resend older than the window would
+            # re-execute, which is why the dedup guarantee is "effective"
+            # exactly-once, not absolute.
+            while (len(self._done) > 4096
+                   or self._done_bytes > self._done_bytes_cap):
+                _, old = self._done.popitem(last=False)
+                self._done_bytes -= len(old.get("payload") or b"")
+            ev = self._running.pop(spec.task_id, None)
+        if ev is not None:
+            ev.set()
+        self._send_reply(conn, reply)
+
+    def _pack_error(self, spec, exc: BaseException, tb: str) -> dict:
+        rt = self._runtime
+        reply = {"t": "result", "task_id": spec.task_id, "ok": False,
+                 "in_store": False, "payload": None}
+        payload = serialization.serialize_error(exc, tb, raised_by_task=True)
+        if len(payload) <= INLINE_MAX and len(spec.return_ids) == 1:
+            reply["payload"] = payload
+        else:
+            for oid in spec.return_ids:
+                if serialization.store_error_best_effort(
+                        rt.store, oid, exc, tb, raised_by_task=True):
+                    rt.notify_sealed(oid)
+            reply["in_store"] = True
+        return reply
+
+    def _pack_result(self, spec, result) -> dict:
+        rt = self._runtime
+        reply = {"t": "result", "task_id": spec.task_id, "ok": True,
+                 "in_store": False, "payload": None}
+        n = len(spec.return_ids)
+        if n == 1 and spec.tensor_transport is None:
+            size, token = serialization.serialized_size(result)
+            if size <= INLINE_MAX:
+                buf = bytearray(size)
+                serialization.write_payload(memoryview(buf), token)
+                reply["payload"] = bytes(buf)
+                return reply
+        rt.store_returns(spec, result)
+        reply["in_store"] = True
+        return reply

@@ -53,6 +53,10 @@ class ActorInfo:
     max_restarts: int = 0
     death_cause: Optional[str] = None
     class_name: str = ""
+    # direct-call endpoint of the hosting worker process (reference: the
+    # actor's rpc::Address in gcs.proto ActorTableData) — callers push
+    # method calls here, bypassing the node scheduler
+    addr: Optional[str] = None
 
 
 @dataclass

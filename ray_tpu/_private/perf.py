@@ -95,9 +95,30 @@ def main():
     results.append(timeit("single client get <- plasma (10MB)",
                           lambda: ray_tpu.get(consume.remote(ref))))
 
-    print(json.dumps({"microbenchmark":
-                      {r["name"]: round(r["rate_per_s"], 1)
-                       for r in results}}))
+    summary = {r["name"]: round(r["rate_per_s"], 1) for r in results}
+    print(json.dumps({"microbenchmark": summary}))
+
+    # Record against the reference's committed CI numbers
+    # (release/perf_metrics/microbenchmark.json via BASELINE.md) so the
+    # core-perf trajectory is tracked in-repo.
+    reference = {
+        "1:1 actor calls sync": 2020.0,
+        "1:1 actor calls async (batch 50)": 7484.0,
+        "n:n actor calls async (4 actors, batch 200)": 27465.0,
+    }
+    record = {
+        "results_per_s": summary,
+        "vs_reference": {
+            name: round(summary[name] / ref, 3)
+            for name, ref in reference.items() if name in summary
+        },
+        "reference_source": "release/perf_metrics/microbenchmark.json",
+    }
+    try:
+        with open("BENCH_core.json", "w") as f:
+            json.dump(record, f, indent=1)
+    except OSError:
+        pass
     return results
 
 

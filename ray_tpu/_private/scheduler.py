@@ -530,6 +530,7 @@ class Scheduler:
                         conn.close()
                         return
                     worker.conn = conn
+                    worker.server_addr = msg.get("server_addr")
                     worker.idle = True
                     self._wake.notify_all()
             elif t == "done":
@@ -590,6 +591,14 @@ class Scheduler:
         if method == "actor_state":
             info = self.gcs.get_actor(params["actor_id"])
             return None if info is None else info.state
+        if method == "actor_addr":
+            # direct-call routing: the actor's state + its worker's
+            # direct-server endpoint (None until ALIVE)
+            info = self.gcs.get_actor(params["actor_id"])
+            if info is None:
+                return None
+            return {"state": info.state,
+                    "addr": getattr(info, "addr", None)}
         if method == "kill_actor":
             self.kill_actor(params["actor_id"], params.get("no_restart", True))
             return True
@@ -846,7 +855,8 @@ class Scheduler:
                 self.gcs.update_actor(info.actor_id,
                                       state=gcs_mod.RESTARTING,
                                       num_restarts=info.num_restarts + 1,
-                                      worker_id=None, node_id=None)
+                                      worker_id=None, node_id=None,
+                                      addr=None)
                 creation = self._creation_spec_for(info.actor_id)
                 if creation is not None:
                     self.submit_spilled(creation)
@@ -920,7 +930,8 @@ class Scheduler:
                 if msg["ok"]:
                     self.gcs.update_actor(spec.actor_id, state=gcs_mod.ALIVE,
                                           worker_id=worker.worker_id,
-                                          node_id=self.node_id)
+                                          node_id=self.node_id,
+                                          addr=worker.server_addr)
                 else:
                     self.gcs.update_actor(spec.actor_id, state=gcs_mod.DEAD,
                                           death_cause=msg.get("error"))
@@ -974,7 +985,7 @@ class Scheduler:
                     self.gcs.update_actor(dead_actor,
                                           state=gcs_mod.RESTARTING,
                                           num_restarts=info.num_restarts + 1,
-                                          worker_id=None)
+                                          worker_id=None, addr=None)
                     creation = self._creation_spec_for(dead_actor)
                     if creation is not None:
                         self._pending.appendleft(creation)

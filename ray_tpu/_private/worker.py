@@ -59,6 +59,82 @@ class WorkerContext:
         # bearing: without it a GC'd function's address can be reused by a
         # new function, which would then resolve to the stale blob.
         self._fn_cache: dict[int, tuple[object, bytes]] = {}
+        # Direct actor-call path (set up by init_direct): in-process memory
+        # store for inline results + per-actor direct channels.
+        self.memstore = None
+        self._direct = None
+        # actor_id -> return oids of scheduler-path method calls not yet
+        # observed complete; the direct path engages only once drained so
+        # the path switch can never reorder a caller's method stream.
+        self._fallback_pending: dict[bytes, list[bytes]] = {}
+        self._fallback_lock = threading.Lock()
+
+    def init_direct(self, rpc_fn) -> None:
+        """Enable the direct actor-call path (memory store + channels)."""
+        from ray_tpu._private import direct
+
+        self.memstore = direct.MemoryStore(promote_cb=self._promote_payload)
+        self._direct = direct.DirectClient(self.memstore, rpc_fn)
+        from ray_tpu.core import object_ref as object_ref_mod
+
+        object_ref_mod.set_escape_hook(self._on_ref_escape)
+        # Local ref counting: when the last live ObjectRef for an oid in
+        # this process is GC'd, its memory-store entry is dropped (never
+        # promoted) — small direct-call results don't pile garbage into
+        # the shm store.
+        self._ref_counts: dict[bytes, int] = {}
+        # RLock: __del__ hooks can fire via GC while this thread is inside
+        # _on_ref_created holding the lock.
+        self._ref_lock = threading.RLock()
+        object_ref_mod.set_lifecycle_hooks(self._on_ref_created,
+                                           self._on_ref_deleted)
+
+    def _on_ref_created(self, oid: bytes) -> None:
+        with self._ref_lock:
+            self._ref_counts[oid] = self._ref_counts.get(oid, 0) + 1
+
+    def _on_ref_deleted(self, oid: bytes) -> None:
+        with self._ref_lock:
+            n = self._ref_counts.get(oid, 0) - 1
+            if n > 0:
+                self._ref_counts[oid] = n
+                return
+            self._ref_counts.pop(oid, None)
+        ms = self.memstore
+        if ms is not None:
+            ms.discard(oid)
+
+    def _promote_payload(self, oid: bytes, payload: bytes) -> None:
+        """Copy a memory-store payload into the shm store (so other
+        processes can resolve the ref) — called when a ref escapes this
+        process or the memory store evicts."""
+        try:
+            buf = self.store.create(oid, len(payload))
+            try:
+                buf[:len(payload)] = payload
+            finally:
+                buf.release()
+            self.store.seal(oid)
+        except FileExistsError:
+            return  # already in the store
+        except Exception:
+            return
+        if self._seal_notify is not None:
+            self._seal_notify(oid)
+
+    def _on_ref_escape(self, oid: bytes) -> None:
+        """An ObjectRef is being pickled (it may leave this process): if its
+        value lives only in the in-process memory store, promote it to the
+        shm store so any receiver can resolve it.  A still-pending entry is
+        flagged instead — the delivery path promotes it the moment the
+        direct reply lands (another process may already be blocking on the
+        shm store for it)."""
+        ms = self.memstore
+        if ms is None:
+            return
+        payload = ms.mark_escaped(oid)
+        if payload is not None:
+            self._promote_payload(oid, payload)
 
     @property
     def current_task_id(self) -> Optional[bytes]:
@@ -75,6 +151,50 @@ class WorkerContext:
     @current_actor_id.setter
     def current_actor_id(self, value: Optional[bytes]):
         self._tls.actor_id = value
+
+    # -- actor calls --------------------------------------------------------
+    def submit_actor_method(self, spec) -> None:
+        """Submit an actor method: direct push when the actor is ALIVE and
+        this caller has no scheduler-path calls still in flight to it
+        (the drain rule keeps the per-caller order across the path
+        switch); otherwise the scheduler path."""
+        direct = self._direct
+        aid = spec.actor_id
+        if direct is not None:
+            with self._fallback_lock:
+                pend = self._fallback_pending.get(aid)
+                if pend:
+                    # drop entries whose result (value or error) is sealed —
+                    # those calls finished executing
+                    pend = [o for o in pend if not self._result_sealed(o)]
+                    if pend:
+                        self._fallback_pending[aid] = pend
+                    else:
+                        del self._fallback_pending[aid]
+                drained = not pend
+            if drained and direct.submit(spec):
+                return
+        self.submit(spec)
+        if direct is not None and spec.return_ids:
+            with self._fallback_lock:
+                self._fallback_pending.setdefault(aid, []).append(
+                    spec.return_ids[0])
+                # bound the bookkeeping under pathological no-get workloads
+                if len(self._fallback_pending[aid]) > 512:
+                    self._fallback_pending[aid] = [
+                        o for o in self._fallback_pending[aid]
+                        if not self._result_sealed(o)][-512:]
+
+    def _result_sealed(self, oid: bytes) -> bool:
+        """Has a scheduler-path call's result (value or error) sealed
+        ANYWHERE?  Cross-node actors seal on their own node, so a local
+        store miss falls through to the location directory."""
+        if self.store.contains(oid):
+            return True
+        try:
+            return bool(self.rpc("object_locations", {"oid": oid}))
+        except Exception:
+            return False
 
     # -- objects -----------------------------------------------------------
     def put_object(self, value, oid: Optional[bytes] = None) -> ObjectRef:
@@ -115,6 +235,12 @@ class WorkerContext:
 
     def get_object_raw(self, ref: ObjectRef, timeout: Optional[float] = None):
         oid = ref.binary()
+        if self.memstore is not None:
+            e = self.memstore.lookup(oid)
+            if e is not None:
+                value = self._get_from_memstore(e, timeout)
+                if value is not _MEMSTORE_FALLTHROUGH:
+                    return value
         try:
             return self._get_object_inner(ref, oid, timeout)
         except ObjectEvictedError:
@@ -122,6 +248,31 @@ class WorkerContext:
                 f"object {ref} was evicted from the object store before it "
                 f"could be fetched (store under memory pressure); increase "
                 f"object_store_memory or fetch results sooner") from None
+
+    def _get_from_memstore(self, entry, timeout: Optional[float]):
+        """Resolve a memory-store entry: wait for the direct reply (condvar
+        wake, no store polling), deserialize inline payloads, or fall
+        through when the result went to the shm store."""
+        from ray_tpu._private.serialization import deserialize
+
+        if not entry.event.is_set():
+            # Short grace before declaring this worker blocked: sub-ms
+            # replies (the common case) skip the scheduler notification.
+            if not entry.event.wait(0.005):
+                blocked = self._block_notify is not None
+                if blocked:
+                    self._block_notify(True)
+                try:
+                    if not entry.event.wait(timeout):
+                        raise GetTimeoutError(
+                            f"get timed out after {timeout}s waiting for a "
+                            f"direct actor-call result")
+                finally:
+                    if blocked:
+                        self._block_notify(False)
+        if entry.in_store:
+            return _MEMSTORE_FALLTHROUGH
+        return deserialize(memoryview(entry.payload))
 
     def _get_object_inner(self, ref, oid, timeout: Optional[float]):
         # Fast path: already sealed, no block notification needed.
@@ -162,6 +313,12 @@ class WorkerContext:
         except Exception:
             pass  # pulls are best-effort; the caller keeps polling
 
+    def _has_local(self, oid: bytes) -> bool:
+        """Sealed locally: inline in the memory store or in the shm store."""
+        if self.memstore is not None and self.memstore.contains_value(oid):
+            return True
+        return self.store.contains(oid)
+
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
         pending = list(refs)
         ready: list[ObjectRef] = []
@@ -175,7 +332,7 @@ class WorkerContext:
                     if fetch_local:
                         next_pull = time.monotonic() + 2.0
                         for ref in pending:
-                            if not self.store.contains(ref.binary()):
+                            if not self._has_local(ref.binary()):
                                 self.request_pull(ref.binary())
                     else:
                         # ready = sealed ANYWHERE in the cluster (reference
@@ -184,7 +341,7 @@ class WorkerContext:
                         for ref in pending:
                             oid = ref.binary()
                             if (oid not in remote_ready
-                                    and not self.store.contains(oid)):
+                                    and not self._has_local(oid)):
                                 try:
                                     if self.rpc("object_locations",
                                                 {"oid": oid}):
@@ -193,7 +350,7 @@ class WorkerContext:
                                     pass
                 still = []
                 for ref in pending:
-                    if (self.store.contains(ref.binary())
+                    if (self._has_local(ref.binary())
                             or ref.binary() in remote_ready):
                         ready.append(ref)
                     else:
@@ -230,12 +387,21 @@ class WorkerContext:
         return fn_id
 
 
+_MEMSTORE_FALLTHROUGH = object()  # sentinel: "check the shm store instead"
+
 _global_worker: Optional[WorkerContext] = None
 
 
 def set_global_worker(w: Optional[WorkerContext]):
     global _global_worker
     _global_worker = w
+    if w is None:
+        # Drop the ref hooks so a dead context isn't called from ObjectRef
+        # pickling/GC after shutdown.
+        from ray_tpu.core import object_ref as object_ref_mod
+
+        object_ref_mod.set_escape_hook(None)
+        object_ref_mod.set_lifecycle_hooks(None, None)
 
 
 def global_worker() -> WorkerContext:

@@ -15,6 +15,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import threading
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 
@@ -39,6 +40,10 @@ class WorkerRuntime:
         self.actors: dict[bytes, object] = {}
         self.actor_pools: dict[bytes, ThreadPoolExecutor] = {}
         self.fn_cache: dict[bytes, object] = {}
+        # Serializes method execution on a non-concurrent actor across the
+        # two delivery paths (scheduler conn + direct server connections).
+        self._actor_locks: dict[bytes, threading.Lock] = {}
+        self._actor_locks_guard = threading.Lock()
 
         self.ctx = WorkerContext(
             mode="worker",
@@ -52,6 +57,21 @@ class WorkerRuntime:
                 {"t": "sealed", "oid": oid}),
         )
         set_global_worker(self.ctx)
+        # Direct-call server: callers push actor methods straight to this
+        # process (see _private/direct.py).  TCP clusters bind the same
+        # interface as the scheduler; unix clusters use a per-worker path.
+        from ray_tpu._private.direct import DirectServer
+
+        if protocol.is_tcp_addr(args.scheduler_socket):
+            host, _, _ = args.scheduler_socket.rpartition(":")
+            bind = f"{host}:0"
+        else:
+            bind = os.path.join(
+                os.path.dirname(args.store_socket),
+                f"w_{self.worker_id.hex()}.sock")
+        self.direct_server = DirectServer(self, bind)
+        # Caller-side direct path for actor calls made FROM this worker.
+        self.ctx.init_direct(self._rpc)
 
     def _rpc(self, method: str, params: dict):
         conn = protocol.connect_addr(self.scheduler_socket)
@@ -65,8 +85,20 @@ class WorkerRuntime:
                                f"{resp.get('error') if resp else 'closed'}")
         return resp["result"]
 
+    def actor_lock(self, actor_id) -> threading.Lock:
+        with self._actor_locks_guard:
+            lock = self._actor_locks.get(actor_id)
+            if lock is None:
+                lock = threading.Lock()
+                self._actor_locks[actor_id] = lock
+            return lock
+
+    def notify_sealed(self, oid: bytes):
+        self.conn.send({"t": "sealed", "oid": oid})
+
     def run(self):
-        self.conn.send({"t": "register", "worker_id": self.worker_id.hex()})
+        self.conn.send({"t": "register", "worker_id": self.worker_id.hex(),
+                        "server_addr": self.direct_server.addr})
         while True:
             msg = self.conn.recv()
             if msg is None:
@@ -123,6 +155,37 @@ class WorkerRuntime:
         kwargs = {k: self.ctx.get_object(v) if isinstance(v, ObjectRef) else v
                   for k, v in kwargs.items()}
         return args, kwargs
+
+    def _invoke_method(self, spec: TaskSpec):
+        """Resolve args and run one actor method; returns the raw result."""
+        instance = self.actors.get(spec.actor_id)
+        if instance is None:
+            raise RuntimeError(
+                f"actor {spec.actor_id.hex()[:8]} not on this worker")
+        args, kwargs = self._resolve_args(spec.args_blob)
+        if spec.method_name == "__rtpu_apply__":
+            # Universal hidden method (counterpart of the reference's
+            # __ray_call__): run fn(actor_instance, *rest) inside the
+            # actor's process — substrate for declare_collective_group
+            # and device-object send/recv.
+            fn = args[0]
+            return fn(instance, *args[1:], **kwargs)
+        return getattr(instance, spec.method_name)(*args, **kwargs)
+
+    def run_actor_method(self, spec: TaskSpec):
+        """Direct-path execution: run the method on the CALLING thread with
+        task ids set thread-locally; the caller (DirectServer) owns result
+        packing and actor-lock acquisition."""
+        self.ctx.current_task_id = spec.task_id
+        self.ctx.current_actor_id = spec.actor_id
+        try:
+            return self._invoke_method(spec)
+        finally:
+            self.ctx.current_task_id = None
+            self.ctx.current_actor_id = None
+
+    def store_returns(self, spec: TaskSpec, result):
+        self._store_returns(spec, result)
 
     def _store_returns(self, spec: TaskSpec, result):
         n = len(spec.return_ids)
@@ -194,21 +257,14 @@ class WorkerRuntime:
                         max_workers=spec.max_concurrency)
                 self._store_returns(spec, None)
             elif spec.kind == ACTOR_METHOD:
-                instance = self.actors.get(spec.actor_id)
-                if instance is None:
-                    raise RuntimeError(
-                        f"actor {spec.actor_id.hex()[:8]} not on this worker")
-                args, kwargs = self._resolve_args(spec.args_blob)
-                if spec.method_name == "__rtpu_apply__":
-                    # Universal hidden method (counterpart of the reference's
-                    # __ray_call__): run fn(actor_instance, *rest) inside the
-                    # actor's process — substrate for declare_collective_group
-                    # and device-object send/recv.
-                    fn = args[0]
-                    self._store_returns(spec, fn(instance, *args[1:], **kwargs))
+                if self.actor_pools.get(spec.actor_id) is not None:
+                    # concurrent actor: the pool provides the parallelism
+                    self._store_returns(spec, self._invoke_method(spec))
                 else:
-                    method = getattr(instance, spec.method_name)
-                    self._store_returns(spec, method(*args, **kwargs))
+                    # serialize against direct-path deliveries of the same
+                    # actor (direct.py executes on per-connection threads)
+                    with self.actor_lock(spec.actor_id):
+                        self._store_returns(spec, self._invoke_method(spec))
             else:
                 fn = self._load_function(spec.fn_id)
                 args, kwargs = self._resolve_args(spec.args_blob)

@@ -24,6 +24,9 @@ class WorkerState:
     worker_id: bytes
     proc: subprocess.Popen
     conn: Optional[Connection] = None
+    # the worker process's direct-call server endpoint (reported at
+    # registration); published to the GCS when an actor lands on it
+    server_addr: Optional[str] = None
     idle: bool = False
     actor_id: Optional[bytes] = None  # set once this worker hosts an actor
     in_flight: dict = field(default_factory=dict)  # task_id -> TaskSpec

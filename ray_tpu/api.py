@@ -125,9 +125,11 @@ def _attach_driver(node: Node):
         store=node.new_store_client(),
         submit_fn=scheduler.submit,
         rpc_fn=driver_rpc,
+        worker_id=os.urandom(8),  # so runtime-context ids are non-empty
         node=node,
         seal_notify_fn=scheduler.note_sealed,
     )
+    ctx.init_direct(driver_rpc)
     worker_mod.set_global_worker(ctx)
     return ctx
 

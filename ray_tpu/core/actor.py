@@ -87,7 +87,13 @@ class ActorHandle:
             name=f"{self._class_name}.{method_name}",
             tensor_transport=tensor_transport,
         )
-        worker.submit(spec)
+        # Direct push when available (driver/worker contexts); the client
+        # proxy context only has the plain submit path.
+        submit_method = getattr(worker, "submit_actor_method", None)
+        if submit_method is not None:
+            submit_method(spec)
+        else:
+            worker.submit(spec)
         refs = [ObjectRef(oid) for oid in return_ids]
         return refs[0] if num_returns == 1 else refs
 

@@ -1,0 +1,54 @@
+"""Loss heads tuned for the TPU memory system.
+
+The naive LM loss materializes fp32 logits of shape (batch, seq, vocab) —
+for GPT-2 124M at batch 8 x seq 1024 that is a 1.6 GB tensor written to and
+re-read from HBM, and the head matmul runs off the MXU's fast path when its
+inputs are fp32.  ``chunked_softmax_xent`` instead:
+
+- keeps the head matmul in bf16 with fp32 accumulation
+  (``preferred_element_type``) — the MXU's native mode;
+- scans over sequence chunks so only (batch, chunk, vocab) logits ever
+  exist, with ``jax.checkpoint`` on the chunk so the backward pass
+  recomputes chunk logits instead of storing them.
+
+No reference counterpart: the reference delegates loss math to
+torch/vLLM (SURVEY §2.4); this is TPU-native net-new.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def chunked_softmax_xent(x: jax.Array, head: jax.Array, targets: jax.Array,
+                         chunk: int = 256) -> jax.Array:
+    """Mean next-token cross-entropy without materializing full logits.
+
+    x:       (batch, seq, d_model) activations (any float dtype; bf16 keeps
+             the matmul on the MXU fast path)
+    head:    (d_model, vocab) output projection (tied embeddings: pass
+             ``wte.T`` — XLA folds the transpose into the dot)
+    targets: (batch, seq) int32 gold next tokens
+    """
+    b, s, _ = x.shape
+    if chunk <= 0 or s % chunk != 0:
+        chunk = s  # fall back to one chunk (still bf16 + f32 accumulation)
+    n = s // chunk
+    xc = x.reshape(b, n, chunk, x.shape[-1]).swapaxes(0, 1)
+    tc = targets.reshape(b, n, chunk).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def chunk_nll(xch, tch):
+        logits = jnp.dot(xch, head.astype(xch.dtype),
+                         preferred_element_type=jnp.float32)
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, tch[..., None], axis=-1)[..., 0]
+        return jnp.sum(logz - gold)
+
+    def body(carry, xt):
+        xch, tch = xt
+        return carry + chunk_nll(xch, tch), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (xc, tc))
+    return total / (b * s)

@@ -37,6 +37,13 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
 
 import pytest  # noqa: E402
 
+# Hang forensics: if any test wedges the process for 10 minutes, dump every
+# thread's stack to a file (pytest's capture hides stderr, so a file it is).
+import faulthandler  # noqa: E402
+
+_hang_dump = open("/tmp/pytest_hang_dump.txt", "w")
+faulthandler.dump_traceback_later(600, repeat=True, file=_hang_dump)
+
 
 @pytest.fixture(scope="session")
 def ray_cluster():

@@ -1,0 +1,138 @@
+"""Direct actor-call transport (_private/direct.py): ordering, inline
+results, escape promotion, and fallbacks.
+
+Mirrors the reference's direct-call tests in shape
+(/root/reference/python/ray/tests/test_actor.py ordering +
+core_worker direct task transport): calls flow caller -> actor worker
+without a scheduler hop once the actor is ALIVE.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster(ray_cluster):
+    return ray_cluster
+
+
+def test_ordering_across_path_transition(cluster):
+    """Calls fired immediately after .remote() (scheduler path, actor not
+    yet ALIVE) and calls fired later (direct path) must execute in
+    submission order."""
+
+    @ray_tpu.remote
+    class Seq:
+        def __init__(self):
+            self.log = []
+
+        def add(self, i):
+            self.log.append(i)
+            return i
+
+        def get_log(self):
+            return list(self.log)
+
+    a = Seq.remote()
+    # burst across the creation window: early ones queue via the
+    # scheduler, later ones switch to direct only once those drained
+    refs = [a.add.remote(i) for i in range(30)]
+    assert ray_tpu.get(refs, timeout=60) == list(range(30))
+    assert ray_tpu.get(a.get_log.remote(), timeout=30) == list(range(30))
+    ray_tpu.kill(a)
+
+
+def test_inline_results_and_errors(cluster):
+    @ray_tpu.remote
+    class Box:
+        def small(self):
+            return {"k": 1}
+
+        def big(self):
+            return np.zeros(1_000_000, np.float64)  # > inline cap -> store
+
+        def boom(self):
+            raise KeyError("direct-boom")
+
+    b = Box.remote()
+    assert ray_tpu.get(b.small.remote(), timeout=30) == {"k": 1}
+    arr = ray_tpu.get(b.big.remote(), timeout=60)
+    assert arr.nbytes == 8_000_000
+    with pytest.raises(KeyError):
+        ray_tpu.get(b.boom.remote(), timeout=30)
+    # wait() must see direct inline results as ready
+    refs = [b.small.remote() for _ in range(4)]
+    ready, pending = ray_tpu.wait(refs, num_returns=4, timeout=30)
+    assert len(ready) == 4 and not pending
+    ray_tpu.kill(b)
+
+
+def test_pending_result_ref_passed_to_task(cluster):
+    """The escape race: a ref whose direct call is still in flight is
+    passed straight into a task on another process — the value must be
+    promoted to the shm store when the reply lands (this exact sequence
+    deadlocked before the escaped-entry promotion)."""
+
+    @ray_tpu.remote
+    class Slow:
+        def compute(self, x):
+            import time
+
+            time.sleep(0.3)  # guarantee the ref escapes while pending
+            return x * 2
+
+    @ray_tpu.remote
+    def consume(v):
+        return v + 1
+
+    s = Slow.remote()
+    ray_tpu.get(s.compute.remote(0), timeout=30)  # direct path is live
+    for i in range(3):
+        ref = s.compute.remote(i)  # in flight for ~0.3s
+        out = ray_tpu.get(consume.remote(ref), timeout=60)  # escapes NOW
+        assert out == i * 2 + 1
+    ray_tpu.kill(s)
+
+
+def test_chained_actor_to_actor_direct(cluster):
+    """Workers are direct callers too: an actor calling another actor."""
+
+    @ray_tpu.remote
+    class Adder:
+        def add(self, x):
+            return x + 10
+
+    @ray_tpu.remote
+    class Front:
+        def __init__(self, backend):
+            self.backend = backend
+
+        def run(self, x):
+            return ray_tpu.get(self.backend.add.remote(x)) * 2
+
+    back = Adder.remote()
+    front = Front.remote(back)
+    assert ray_tpu.get(front.run.remote(5), timeout=60) == 30
+    ray_tpu.kill(back)
+    ray_tpu.kill(front)
+
+
+def test_direct_calls_fail_over_on_actor_death(cluster):
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    v = Victim.remote()
+    assert ray_tpu.get(v.ping.remote(), timeout=30) == "pong"
+    v.die.remote()
+    with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+        for _ in range(100):  # one of these must surface the death
+            ray_tpu.get(v.ping.remote(), timeout=30)
