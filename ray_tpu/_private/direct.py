@@ -50,7 +50,8 @@ _MEMSTORE_MAX_BYTES = int(os.environ.get("RTPU_MEMSTORE_BYTES", 256 << 20))
 
 
 class _Entry:
-    __slots__ = ("event", "payload", "in_store", "promoted", "escaped")
+    __slots__ = ("event", "payload", "in_store", "promoted", "escaped",
+                 "orphaned")
 
     def __init__(self):
         self.event = threading.Event()
@@ -61,6 +62,9 @@ class _Entry:
         # must be promoted to the shm store the moment it arrives, because
         # another process may already be blocking on it there
         self.escaped = False
+        # every LOCAL ref died while the call was in flight; drop the
+        # entry once its delivery obligations (promotion) are met
+        self.orphaned = False
 
 
 class MemoryStore:
@@ -102,6 +106,11 @@ class MemoryStore:
             if escaped:
                 e.promoted = True
             e.event.set()
+            if e.orphaned:
+                # all local refs died mid-flight; the entry only survived
+                # for its promotion duty — drop it now
+                self._entries.pop(oid, None)
+                self._bytes -= len(payload)
             evict = self._over_cap_locked()
         if escaped and self._promote_cb is not None:
             # the ref left this process while the call was in flight;
@@ -140,6 +149,8 @@ class MemoryStore:
             if not e.event.is_set():
                 e.in_store = True
                 e.event.set()
+            if e.orphaned:
+                self._entries.pop(oid, None)
 
     def _over_cap_locked(self) -> list[tuple[bytes, bytes]]:
         """Collect fulfilled entries to evict (promote) — caller promotes
@@ -174,9 +185,18 @@ class MemoryStore:
         return e is not None and e.event.is_set() and not e.in_store
 
     def discard(self, oid: bytes) -> None:
+        """Last local ref died.  A pending ESCAPED entry is kept (marked
+        orphaned): a remote process may be blocking on the shm store for
+        this value, and only the delivery path can promote it there."""
         with self._lock:
-            e = self._entries.pop(oid, None)
-            if e is not None and e.payload is not None:
+            e = self._entries.get(oid)
+            if e is None:
+                return
+            if not e.event.is_set() and e.escaped:
+                e.orphaned = True
+                return
+            self._entries.pop(oid, None)
+            if e.payload is not None:
                 self._bytes -= len(e.payload)
 
 

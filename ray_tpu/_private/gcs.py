@@ -84,6 +84,11 @@ class Gcs:
         self.job_config: dict = {}
         # object_id -> set of node_ids holding a sealed copy
         self.object_locations: dict[bytes, set[bytes]] = {}
+        # objects that HAD a sealed copy and lost every one (node death):
+        # the owner's get() consults this to trigger lineage re-execution
+        # instead of waiting forever (reference:
+        # src/ray/core_worker/object_recovery_manager.h:43)
+        self.lost_objects: set[bytes] = set()
         # pg_id -> {bundles, strategy, assignment: [node_id per bundle]}
         self.placement_groups: dict[bytes, dict] = {}
 
@@ -150,9 +155,19 @@ class Gcs:
             if info is None or not info.alive:
                 return False
             info.alive = False
-            # drop the dead node from every object's location set
-            for locs in self.object_locations.values():
+            # drop the dead node from every object's location set; objects
+            # with no surviving copy become tombstoned as LOST so owners
+            # can re-execute their lineage
+            for oid, locs in list(self.object_locations.items()):
                 locs.discard(node_id)
+                if not locs:
+                    del self.object_locations[oid]
+                    if len(self.lost_objects) >= 1_000_000:
+                        # bounded: evict an arbitrary OLD tombstone rather
+                        # than dropping the new one (fresh losses are the
+                        # ones with live waiters)
+                        self.lost_objects.pop()
+                    self.lost_objects.add(oid)
         return True
 
     def check_node_health(self) -> list[bytes]:
@@ -168,6 +183,15 @@ class Gcs:
     def add_object_location(self, oid: bytes, node_id: bytes):
         with self._lock:
             self.object_locations.setdefault(oid, set()).add(node_id)
+            self.lost_objects.discard(oid)  # re-created (reconstruction)
+
+    def object_lost(self, oid: bytes) -> bool:
+        with self._lock:
+            return oid in self.lost_objects
+
+    def clear_object_lost(self, oid: bytes):
+        with self._lock:
+            self.lost_objects.discard(oid)
 
     def remove_object_location(self, oid: bytes, node_id: bytes):
         with self._lock:
@@ -240,6 +264,7 @@ _GCS_METHODS = frozenset({
     "list_actors", "register_node", "list_nodes", "get_node", "heartbeat",
     "mark_node_dead", "add_object_location", "remove_object_location",
     "get_object_locations", "all_object_locations",
+    "object_lost", "clear_object_lost",
     "register_pg", "get_pg", "remove_pg", "list_pgs",
     "kv_put", "kv_get", "kv_del", "kv_keys",
 })

@@ -695,6 +695,19 @@ class Scheduler:
             return self.trigger_pull(params["oid"])
         if method == "object_locations":
             return self.gcs.get_object_locations(params["oid"])
+        if method == "object_lost":
+            return self.gcs.object_lost(params["oid"])
+        if method == "clear_object_lost":
+            self.gcs.clear_object_lost(params["oid"])
+            return True
+        if method == "free_object":
+            return self.free_object(params["oid"])
+        if method == "free_local":
+            try:
+                self._store.delete(params["oid"])
+            except Exception:
+                pass
+            return True
         if method == "fetch_object":
             return self._transfer.serve_fetch(
                 params["oid"], params.get("offset", 0),
@@ -734,6 +747,42 @@ class Scheduler:
 
     def trigger_pull(self, oid: bytes) -> bool:
         return self._transfer.trigger_pull(oid)
+
+    def free_object(self, oid: bytes) -> bool:
+        """Delete every copy of an object cluster-wide and clear its
+        directory entries — used by lineage reconstruction to clear a
+        sealed stale result (e.g. an error recorded for a task that is
+        about to re-execute).  Reference: FreeObjects
+        (src/ray/protobuf/object_manager.proto:60)."""
+        try:
+            locs = self.gcs.get_object_locations(oid)
+        except Exception:
+            locs = []
+        for nid in locs:
+            if nid == self.node_id:
+                try:
+                    self._store.delete(oid)
+                except Exception:
+                    pass
+            else:
+                node = self._lookup_node(nid)
+                if node is None or not node.alive:
+                    continue
+                try:
+                    self._links.one_shot_rpc(node.sched_socket, "free_local",
+                                             {"oid": oid})
+                except Exception:
+                    pass
+            try:
+                self.gcs.remove_object_location(oid, nid)
+            except Exception:
+                pass
+        # the caller is about to re-create it; drop any lost tombstone
+        try:
+            self.gcs.clear_object_lost(oid)
+        except Exception:
+            pass
+        return True
 
     # ------------------------------------------------------------------
     # Cluster: peer forwarding + liveness (reference: ray_syncer resource

@@ -11,6 +11,7 @@ the current global context, so user code behaves identically in both.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Callable, Optional
@@ -68,6 +69,16 @@ class WorkerContext:
         # the path switch can never reorder a caller's method stream.
         self._fallback_pending: dict[bytes, list[bytes]] = {}
         self._fallback_lock = threading.Lock()
+        # Lineage: return oid -> producing TaskSpec, recorded at submission
+        # (owner side), so a lost object can be re-created by re-executing
+        # its task — reference: TaskManager lineage + ObjectRecoveryManager
+        # (src/ray/core_worker/task_manager.h:175,
+        # object_recovery_manager.h:43).  Bounded by entries and bytes.
+        self._lineage: "dict[bytes, object]" = {}
+        self._lineage_order: list[bytes] = []
+        self._lineage_bytes = 0
+        self._lineage_lock = threading.Lock()
+        self._recon_left: dict[bytes, int] = {}
 
     def init_direct(self, rpc_fn) -> None:
         """Enable the direct actor-call path (memory store + channels)."""
@@ -196,6 +207,70 @@ class WorkerContext:
         except Exception:
             return False
 
+    # -- lineage ------------------------------------------------------------
+    def record_lineage(self, spec) -> None:
+        """Remember the producing spec for each return oid (task outputs
+        only; puts are not reconstructable, matching the reference)."""
+        cost = len(spec.args_blob) + 256  # accounted PER return oid
+        with self._lineage_lock:
+            for oid in spec.return_ids:
+                if oid not in self._lineage:
+                    self._lineage_order.append(oid)
+                    self._lineage_bytes += cost
+                self._lineage[oid] = spec
+            while (self._lineage_bytes > 64 << 20
+                   or len(self._lineage_order) > 100_000):
+                old = self._lineage_order.pop(0)
+                dropped = self._lineage.pop(old, None)
+                if dropped is not None:
+                    self._lineage_bytes -= len(dropped.args_blob) + 256
+
+    def _maybe_reconstruct(self, oid: bytes) -> bool:
+        """Re-execute the producing task of a lost object; True if a
+        resubmission happened (the caller should keep waiting)."""
+        import copy
+
+        with self._lineage_lock:
+            spec = self._lineage.get(oid)
+            if spec is None:
+                return False
+            left = self._recon_left.get(
+                oid, int(os.environ.get("RTPU_MAX_RECONSTRUCTIONS", 3)))
+            if left <= 0:
+                return False
+            self._recon_left[oid] = left - 1
+        # Clear stale state: any surviving copies of the task's returns
+        # (e.g. a sealed error from a failed chain attempt) and the lost
+        # tombstone, so the re-execution's writes win.
+        for rid in spec.return_ids:
+            try:
+                self.rpc("free_object", {"oid": rid})
+            except Exception:
+                pass
+            try:
+                self.store.delete(rid)
+            except Exception:
+                pass
+        fresh = copy.copy(spec)
+        fresh.spill_count = 0
+        fresh.origin_node = None
+        self.submit(fresh)
+        return True
+
+    def _lost_upstream_oid(self, exc: BaseException) -> bytes:
+        """If exc is (or wraps) an ObjectLostError, the lost oid."""
+        from ray_tpu.exceptions import ObjectLostError as _Lost
+
+        seen = exc
+        for _ in range(4):
+            if isinstance(seen, _Lost) and getattr(seen, "oid", b""):
+                return seen.oid
+            nxt = getattr(seen, "cause", None)  # TaskError chain
+            if not isinstance(nxt, BaseException):
+                return b""
+            seen = nxt
+        return b""
+
     # -- objects -----------------------------------------------------------
     def put_object(self, value, oid: Optional[bytes] = None) -> ObjectRef:
         if isinstance(value, ObjectRef):
@@ -241,13 +316,41 @@ class WorkerContext:
                 value = self._get_from_memstore(e, timeout)
                 if value is not _MEMSTORE_FALLTHROUGH:
                     return value
-        try:
-            return self._get_object_inner(ref, oid, timeout)
-        except ObjectEvictedError:
-            raise ObjectLostError(
-                f"object {ref} was evicted from the object store before it "
-                f"could be fetched (store under memory pressure); increase "
-                f"object_store_memory or fetch results sooner") from None
+        # Reconstruction loop: a lost object (node death, eviction) whose
+        # producing spec this owner holds is transparently re-executed; a
+        # result that RAISES a wrapped ObjectLostError means an UPSTREAM
+        # dependency was lost — rebuild it, re-run this task, try again.
+        # The caller's timeout bounds the WHOLE loop, not each attempt.
+        # Note: stored upstream errors arrive as dynamic TaskError duals
+        # that subclass ObjectLostError (serialization._as_raisable), so
+        # one except arm sees both direct and wrapped losses.
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for _ in range(8):
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            try:
+                return self._get_object_inner(ref, oid, remaining)
+            except ObjectEvictedError:
+                if self._maybe_reconstruct(oid):
+                    continue
+                raise ObjectLostError(
+                    f"object {ref} was evicted from the object store before "
+                    f"it could be fetched (store under memory pressure); "
+                    f"increase object_store_memory or fetch results sooner",
+                    oid=oid) from None
+            except ObjectLostError as e:
+                lost = (getattr(e, "oid", b"")
+                        or self._lost_upstream_oid(e))
+                if lost == oid and self._maybe_reconstruct(oid):
+                    continue
+                if (lost and lost != oid
+                        and self._maybe_reconstruct(lost)
+                        and self._maybe_reconstruct(oid)):
+                    continue  # chain rebuilt: upstream + this task re-run
+                raise
+        raise ObjectLostError(
+            f"object {ref} could not be reconstructed (kept getting lost "
+            f"across {8} attempts)", oid=oid)
 
     def _get_from_memstore(self, entry, timeout: Optional[float]):
         """Resolve a memory-store entry: wait for the direct reply (condvar
@@ -294,6 +397,17 @@ class WorkerContext:
                     # periodically for as long as we keep waiting.
                     next_pull = time.monotonic() + 2.0
                     self.request_pull(oid)
+                    # every copy may have died with its node: surface LOST
+                    # instead of waiting forever (the owner's get loop
+                    # re-executes lineage; non-owners propagate the error)
+                    try:
+                        lost = self.rpc("object_lost", {"oid": oid})
+                    except Exception:
+                        lost = False
+                    if lost and not self.store.contains(oid):
+                        raise ObjectLostError(
+                            f"object {ref} was lost: every node holding a "
+                            f"copy died", oid=oid)
                 view = self.store.get(oid, _GET_CHUNK_MS)
                 if view is not None:
                     return deserialize(
@@ -395,11 +509,17 @@ _global_worker: Optional[WorkerContext] = None
 def set_global_worker(w: Optional[WorkerContext]):
     global _global_worker
     _global_worker = w
-    if w is None:
-        # Drop the ref hooks so a dead context isn't called from ObjectRef
-        # pickling/GC after shutdown.
-        from ray_tpu.core import object_ref as object_ref_mod
+    # The ObjectRef hooks always track the CURRENT context: cleared on
+    # shutdown (a dead context must not be called from pickling/GC) and
+    # re-installed when a context is restored (tests swap contexts while
+    # running several clusters in one process).
+    from ray_tpu.core import object_ref as object_ref_mod
 
+    if w is not None and getattr(w, "memstore", None) is not None:
+        object_ref_mod.set_escape_hook(w._on_ref_escape)
+        object_ref_mod.set_lifecycle_hooks(w._on_ref_created,
+                                           w._on_ref_deleted)
+    else:
         object_ref_mod.set_escape_hook(None)
         object_ref_mod.set_lifecycle_hooks(None, None)
 

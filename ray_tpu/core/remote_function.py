@@ -93,6 +93,11 @@ class RemoteFunction:
             **strategy_fields(options),
         )
         worker.submit(spec)
+        # Owner-side lineage: lost outputs re-execute this spec (client
+        # proxy contexts have no lineage store — getattr guard).
+        record = getattr(worker, "record_lineage", None)
+        if record is not None:
+            record(spec)
         refs = [ObjectRef(oid) for oid in return_ids]
         return refs[0] if num_returns == 1 else refs
 

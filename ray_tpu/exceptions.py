@@ -36,7 +36,28 @@ class GetTimeoutError(RayTpuError, TimeoutError):
 
 
 class ObjectLostError(RayTpuError):
-    """Object is no longer available (evicted and not reconstructable)."""
+    """Object is no longer available (lost with its node, or evicted).
+
+    ``oid`` (when known) identifies the lost object so an owner holding
+    its lineage can re-execute the producing task (reference:
+    src/ray/core_worker/object_recovery_manager.h:43).
+    """
+
+    def __init__(self, *args, oid: bytes = b""):
+        super().__init__(*args)
+        self.oid = oid
+
+    def __reduce__(self):
+        if type(self) is not ObjectLostError:
+            # dynamic TaskError duals (serialization._as_raisable) subclass
+            # this — they must keep their own pickling, not collapse to a
+            # bare ObjectLostError
+            return super().__reduce__()
+        return (_rebuild_object_lost, (self.args, self.oid))
+
+
+def _rebuild_object_lost(args, oid):
+    return ObjectLostError(*args, oid=oid)
 
 
 class TaskCancelledError(RayTpuError):

@@ -136,3 +136,32 @@ def test_direct_calls_fail_over_on_actor_death(cluster):
     with pytest.raises(ray_tpu.exceptions.ActorDiedError):
         for _ in range(100):  # one of these must surface the death
             ray_tpu.get(v.ping.remote(), timeout=30)
+
+
+def test_escaped_ref_survives_local_drop(cluster):
+    """A pending direct-call ref is pickled into a task and then every
+    LOCAL ObjectRef to it is dropped: the value must still reach the
+    consumer (escaped entries defer refcount discard until promoted)."""
+
+    @ray_tpu.remote
+    class Slow:
+        def compute(self, x):
+            import time
+
+            time.sleep(0.3)
+            return x * 3
+
+    @ray_tpu.remote
+    def consume(v):
+        return int(v) + 5
+
+    s = Slow.remote()
+    ray_tpu.get(s.compute.remote(0), timeout=30)  # direct path live
+    import gc
+
+    ref = s.compute.remote(7)          # in flight ~0.3s
+    out_ref = consume.remote(ref)      # ref escapes into the args blob
+    del ref                            # last local ref dies mid-flight
+    gc.collect()
+    assert ray_tpu.get(out_ref, timeout=60) == 26
+    ray_tpu.kill(s)

@@ -1,0 +1,155 @@
+"""Object lineage reconstruction: lost task outputs re-execute their
+producing tasks (reference: src/ray/core_worker/object_recovery_manager.h:43
++ reference_count.h lineage pinning; python/ray/tests/test_reconstruction.py
+in shape)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+
+@pytest.fixture
+def cluster():
+    import ray_tpu.api as api
+    from ray_tpu._private import worker as worker_mod
+
+    prev_ctx = worker_mod._global_worker
+    prev_node = api._global_node
+    worker_mod.set_global_worker(None)
+    api._global_node = None
+
+    c = Cluster(head_node_args={
+        "resources": {"CPU": 2.0}, "min_workers": 1,
+        "object_store_memory": 1 << 27})
+    ray_tpu.init(_existing_node=c.head_node)
+    try:
+        yield c
+    finally:
+        api._global_node = None
+        worker_mod.set_global_worker(None)
+        c.shutdown()
+        worker_mod.set_global_worker(prev_ctx)
+        api._global_node = prev_node
+
+
+def _add_worker(c, cpus=2.0):
+    node = c.add_node(resources={"CPU": cpus}, min_workers=1,
+                      object_store_memory=1 << 27)
+    c.wait_for_nodes()
+    return node
+
+
+def _wait_sealed_remotely(ref, node_id, timeout=30):
+    """Block until the object is recorded on the given node."""
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        locs = w.rpc("object_locations", {"oid": ref.binary()})
+        if node_id in locs:
+            return
+        time.sleep(0.1)
+    raise TimeoutError("object never sealed on the target node")
+
+
+def test_lost_output_reexecutes(cluster):
+    """Kill the node holding a task output; get() re-runs the task."""
+    worker_node = _add_worker(cluster)
+    target = worker_node.node_id.hex()
+
+    @ray_tpu.remote
+    def produce(tag):
+        import numpy as np
+
+        return np.full((50_000,), tag, dtype=np.int64)
+
+    ref = produce.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(target, soft=True)
+    ).remote(7)
+    _wait_sealed_remotely(ref, worker_node.node_id)
+    # the ONLY copy lives on the worker node — kill it
+    cluster.remove_node(worker_node)
+    arr = ray_tpu.get(ref, timeout=120)
+    assert int(arr[0]) == 7 and arr.shape == (50_000,)
+
+
+def test_lost_chain_reexecutes(cluster):
+    """A two-step pipeline where BOTH intermediate objects die with the
+    node: the whole chain re-executes."""
+    worker_node = _add_worker(cluster)
+    target = worker_node.node_id.hex()
+    strat = NodeAffinitySchedulingStrategy(target, soft=True)
+
+    @ray_tpu.remote
+    def step_a(x):
+        import numpy as np
+
+        return np.arange(x)
+
+    @ray_tpu.remote
+    def step_b(a):
+        return int(a.sum())
+
+    a_ref = step_a.options(scheduling_strategy=strat).remote(1000)
+    b_ref = step_b.options(scheduling_strategy=strat).remote(a_ref)
+    assert ray_tpu.get(b_ref, timeout=60) == 499500  # computed once
+    _wait_sealed_remotely(a_ref, worker_node.node_id)
+    cluster.remove_node(worker_node)
+    # b's value was fetched to the driver already; ask for a fresh deep
+    # get of the chain output that must rebuild a on the surviving node
+    arr = ray_tpu.get(a_ref, timeout=120)
+    assert int(arr[-1]) == 999
+
+
+def test_unreconstructable_put_raises(cluster):
+    """ray_tpu.put objects have no lineage: losing every copy surfaces
+    ObjectLostError rather than hanging."""
+    worker_node = _add_worker(cluster)
+
+    # seal a put object ONLY on the remote node by creating it there
+    @ray_tpu.remote
+    def make_put():
+        import numpy as np
+
+        return [ray_tpu.put(np.ones(1000))]  # wrapped: refs can't be returned bare
+
+    inner_ref = ray_tpu.get(make_put.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            worker_node.node_id.hex(), soft=True)).remote(), timeout=60)[0]
+    _wait_sealed_remotely(inner_ref, worker_node.node_id)
+    cluster.remove_node(worker_node)
+    with pytest.raises(ray_tpu.exceptions.ObjectLostError):
+        ray_tpu.get(inner_ref, timeout=60)
+
+
+def test_upstream_lost_inside_task_rebuilds_chain(cluster):
+    """A consumer task fails because its ARG was lost (the wrapped
+    TaskError(ObjectLostError) path): the owner rebuilds the upstream
+    object AND re-runs the consumer."""
+    worker_node = _add_worker(cluster)
+    target = worker_node.node_id.hex()
+
+    @ray_tpu.remote
+    def produce(n):
+        import numpy as np
+
+        return np.arange(n)
+
+    @ray_tpu.remote
+    def consume(a):
+        return int(a.sum())
+
+    a_ref = produce.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(target, soft=True)
+    ).remote(1000)
+    _wait_sealed_remotely(a_ref, worker_node.node_id)
+    cluster.remove_node(worker_node)
+    # submit the consumer ONLY AFTER the producer's node is gone: its arg
+    # resolution hits the lost object inside the worker
+    b_ref = consume.options(max_retries=0).remote(a_ref)
+    assert ray_tpu.get(b_ref, timeout=120) == 499500
