@@ -370,30 +370,82 @@ class LLMEngine:
             positions[i] = s.num_tokens  # position of the new token
             tables[i, :len(s.pages)] = s.pages
             active[i] = True
+        all_greedy = all(s.request.params.temperature <= 0
+                         for _, s in active_slots)
+        if all_greedy:
+            # Burst decode: chain several device-fed greedy steps and fetch
+            # once.  The host round trip (PCIe/tunnel) costs many times the
+            # decode compute itself; each step's argmax token feeds the
+            # next step ON DEVICE.  Overshoot is safe: a slot that finishes
+            # mid-burst keeps writing into its own (or the null) pages and
+            # the extra tokens are simply not emitted.
+            # Stay responsive to admissions only when one could actually
+            # happen: work waiting, a slot to put it in, AND enough free
+            # pages for the head-of-queue request (mirrors _admit's own
+            # checks) — otherwise burst; admission is impossible until a
+            # sequence finishes anyway.
+            can_admit = False
+            if any(s is None for s in self._slots):
+                try:
+                    head = self._waiting.queue[0]  # type: ignore[attr-defined]
+                    need = len(head.prompt_tokens)
+                    if head.kind != "prefill_only":
+                        need += head.params.max_tokens
+                    n_pages = -(-need // self.cfg.page_size)
+                    can_admit = self.allocator.can_allocate(n_pages)
+                except IndexError:
+                    pass
+            burst = 1 if can_admit else 8
+            toks_dev = jnp.asarray(tokens)
+            pos_dev = jnp.asarray(positions)
+            tables_dev = jnp.asarray(tables)
+            active_dev = jnp.asarray(active)
+            steps = []
+            for j in range(burst):
+                toks_dev, self.cache_k, self.cache_v = \
+                    lm.decode_step_greedy(
+                        self.params, toks_dev, self.cache_k, self.cache_v,
+                        tables_dev, pos_dev + j, active_dev,
+                        self.model_cfg)
+                steps.append(toks_dev)
+            # ONE host round trip for the whole burst (stack on device)
+            rows = np.asarray(jnp.stack(steps)) if burst > 1 else [
+                np.asarray(steps[0])]
+            self._stats["decode_steps"] += burst
+            for row in rows:
+                for i, s in active_slots:
+                    if self._slots[i] is not s:
+                        continue  # finished earlier in this burst
+                    self._accept_token(i, s, int(row[i]))
+            return True
         logits, self.cache_k, self.cache_v = lm.decode_step(
-            self.params, jnp.asarray(tokens), self.cache_k, self.cache_v,
-            jnp.asarray(tables), jnp.asarray(positions),
+            self.params, jnp.asarray(tokens), self.cache_k,
+            self.cache_v, jnp.asarray(tables), jnp.asarray(positions),
             jnp.asarray(active), self.model_cfg)
         logits_np = np.asarray(logits)
         self._stats["decode_steps"] += 1
         for i, s in active_slots:
             tok = self._sample_one(logits_np[i], s.request.params, s.rng)
-            s.num_tokens += 1  # last_token's KV is now in the cache
-            sp = s.request.params
-            if tok in sp.stop_token_ids:
-                s.request.out_queue.put(None)
-                self.allocator.free(s.pages)
-                self._slots[i] = None
-                continue
-            s.generated.append(tok)
-            self._emit(s, tok)
-            if len(s.generated) >= sp.max_tokens:
-                s.request.out_queue.put(None)
-                self.allocator.free(s.pages)
-                self._slots[i] = None
-            else:
-                s.last_token = tok
+            self._accept_token(i, s, tok)
         return True
+
+    def _accept_token(self, i: int, s: _Slot, tok: int):
+        """Record one sampled token for slot i: emit, finish, or continue."""
+        s.num_tokens += 1  # last_token's KV is now in the cache
+        sp = s.request.params
+        if tok in sp.stop_token_ids:
+            s.request.out_queue.put(None)
+            self.allocator.free(s.pages)
+            self._slots[i] = None
+            return
+        s.generated.append(tok)
+        self._emit(s, tok)
+        if len(s.generated) >= sp.max_tokens:
+            s.request.out_queue.put(None)
+            self.allocator.free(s.pages)
+            self._slots[i] = None
+        else:
+            s.last_token = tok
 
     def _emit(self, slot: _Slot, token: int):
         self._stats["tokens_generated"] += 1

@@ -85,9 +85,8 @@ def prefill(params, tokens, cache_k, cache_v, page_rows, true_len,
     return logits, cache_k, cache_v
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2, 3))
-def decode_step(params, tokens, cache_k, cache_v, page_tables, positions,
-                active, cfg: LlamaConfig):
+def _decode_impl(params, tokens, cache_k, cache_v, page_tables, positions,
+                 active, cfg: LlamaConfig):
     """One token for EVERY slot (the continuous-batching hot loop).
 
     tokens: [B] int32 current token per slot; positions: [B] its position;
@@ -141,3 +140,22 @@ def decode_step(params, tokens, cache_k, cache_v, page_tables, positions,
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = x.astype(jnp.float32) @ params["lm_head"]
     return logits, cache_k, cache_v
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2, 3))
+def decode_step(params, tokens, cache_k, cache_v, page_tables, positions,
+                active, cfg: LlamaConfig):
+    return _decode_impl(params, tokens, cache_k, cache_v, page_tables,
+                        positions, active, cfg)
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2, 3))
+def decode_step_greedy(params, tokens, cache_k, cache_v, page_tables,
+                       positions, active, cfg: LlamaConfig):
+    """Greedy decode: argmax ON DEVICE, so the host fetches [B] int32
+    instead of [B, vocab] fp32 logits — the tunnel/PCIe round trip is the
+    decode loop's fixed cost when every active request samples greedily."""
+    logits, cache_k, cache_v = _decode_impl(
+        params, tokens, cache_k, cache_v, page_tables, positions, active,
+        cfg)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache_k, cache_v

@@ -27,6 +27,9 @@ class GPT2Config:
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
     remat: bool = False
+    # sequence-chunked cross-entropy (models/losses.py): avoids the
+    # (batch, seq, vocab) fp32 logits tensor; 0 disables chunking
+    loss_chunk: int = 256
 
     @property
     def head_dim(self) -> int:
@@ -140,7 +143,8 @@ def _layer(cfg: GPT2Config, x, p, attn_impl):
     return x
 
 
-def apply(params, tokens, cfg: GPT2Config, attn_impl: str = "auto"):
+def trunk(params, tokens, cfg: GPT2Config, attn_impl: str = "auto"):
+    """Embeddings -> final layer norm, WITHOUT the LM head: (b, s, d)."""
     dtype = jnp.dtype(cfg.dtype)
     s = tokens.shape[1]
     x = (params["wte"][tokens] + params["wpe"][:s][None]).astype(dtype)
@@ -153,13 +157,20 @@ def apply(params, tokens, cfg: GPT2Config, attn_impl: str = "auto"):
         return step(x, layer_params), None
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
-    x = layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.norm_eps)
-    return x.astype(jnp.float32) @ params["wte"].T  # tied LM head
+    return layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.norm_eps)
+
+
+def apply(params, tokens, cfg: GPT2Config, attn_impl: str = "auto"):
+    x = trunk(params, tokens, cfg, attn_impl)
+    # tied LM head: bf16 operands with fp32 accumulation — the MXU's
+    # native mode (an fp32 matmul here halves the headline throughput)
+    return jnp.dot(x, params["wte"].T.astype(x.dtype),
+                   preferred_element_type=jnp.float32)
 
 
 def loss_fn(params, tokens, cfg: GPT2Config, attn_impl: str = "auto"):
-    logits = apply(params, tokens[:, :-1], cfg, attn_impl)
-    targets = tokens[:, 1:]
-    logz = jax.scipy.special.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold)
+    from ray_tpu.models.losses import chunked_softmax_xent
+
+    x = trunk(params, tokens[:, :-1], cfg, attn_impl)
+    return chunked_softmax_xent(x, params["wte"].T, tokens[:, 1:],
+                                chunk=cfg.loss_chunk)

@@ -33,6 +33,9 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
     remat: bool = True  # checkpoint each layer: recompute activations in bwd
+    # sequence-chunked cross-entropy (models/losses.py): avoids the
+    # (batch, seq, vocab) fp32 logits tensor; 0 disables chunking
+    loss_chunk: int = 256
 
     @property
     def head_dim(self) -> int:
@@ -170,9 +173,9 @@ def _layer(cfg: LlamaConfig, x, layer_params, positions, attn_impl, mesh,
     return x
 
 
-def apply(params, tokens, cfg: LlamaConfig, attn_impl: str = "auto",
+def trunk(params, tokens, cfg: LlamaConfig, attn_impl: str = "auto",
           mesh=None, rules=None):
-    """Forward pass: tokens (batch, seq) int32 -> logits (batch, seq, vocab).
+    """Embeddings -> final RMS norm, WITHOUT the LM head: (b, s, d).
 
     Layers run under lax.scan over the stacked layer params; each step is
     optionally rematerialized (jax.checkpoint) to trade FLOPs for HBM.
@@ -192,17 +195,24 @@ def apply(params, tokens, cfg: LlamaConfig, attn_impl: str = "auto",
         return step(x, layer_params), None
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    # Final projection in fp32 for a stable softmax/CE.
-    return x.astype(jnp.float32) @ params["lm_head"]
+    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+
+
+def apply(params, tokens, cfg: LlamaConfig, attn_impl: str = "auto",
+          mesh=None, rules=None):
+    """Forward pass: tokens (batch, seq) int32 -> logits (batch, seq, vocab)."""
+    x = trunk(params, tokens, cfg, attn_impl, mesh=mesh, rules=rules)
+    # bf16 operands, fp32 accumulation (preferred_element_type) — the
+    # MXU's native mode; logits come out fp32 for a stable softmax.
+    return jnp.dot(x, params["lm_head"].astype(x.dtype),
+                   preferred_element_type=jnp.float32)
 
 
 def loss_fn(params, tokens, cfg: LlamaConfig, attn_impl: str = "auto",
             mesh=None, rules=None):
     """Next-token cross-entropy; tokens (batch, seq)."""
-    logits = apply(params, tokens[:, :-1], cfg, attn_impl, mesh=mesh,
-                   rules=rules)
-    targets = tokens[:, 1:]
-    logz = jax.scipy.special.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold)
+    from ray_tpu.models.losses import chunked_softmax_xent
+
+    x = trunk(params, tokens[:, :-1], cfg, attn_impl, mesh=mesh, rules=rules)
+    return chunked_softmax_xent(x, params["lm_head"], tokens[:, 1:],
+                                chunk=cfg.loss_chunk)

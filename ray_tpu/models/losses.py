@@ -32,23 +32,35 @@ def chunked_softmax_xent(x: jax.Array, head: jax.Array, targets: jax.Array,
     targets: (batch, seq) int32 gold next tokens
     """
     b, s, _ = x.shape
-    if chunk <= 0 or s % chunk != 0:
-        chunk = s  # fall back to one chunk (still bf16 + f32 accumulation)
-    n = s // chunk
-    xc = x.reshape(b, n, chunk, x.shape[-1]).swapaxes(0, 1)
-    tc = targets.reshape(b, n, chunk).swapaxes(0, 1)
 
-    @jax.checkpoint
-    def chunk_nll(xch, tch):
+    def nll(xch, tch, mch):
         logits = jnp.dot(xch, head.astype(xch.dtype),
                          preferred_element_type=jnp.float32)
         logz = jax.scipy.special.logsumexp(logits, axis=-1)
         gold = jnp.take_along_axis(logits, tch[..., None], axis=-1)[..., 0]
-        return jnp.sum(logz - gold)
+        return jnp.sum((logz - gold) * mch)
+
+    if chunk <= 0 or chunk >= s:
+        # single pass: no recompute; fine whenever (b, s, vocab) fits HBM
+        return nll(x, targets, jnp.ones((b, s), x.dtype)) / (b * s)
+    # pad the sequence up to a chunk multiple (LM losses see seq-1 tokens,
+    # which is odd for every even seq — a divisibility requirement would
+    # make the chunked path dead code); pads are masked out of the sum
+    pad = (-s) % chunk
+    mask = jnp.ones((b, s), x.dtype)
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    n = (s + pad) // chunk
+    xc = x.reshape(b, n, chunk, x.shape[-1]).swapaxes(0, 1)
+    tc = targets.reshape(b, n, chunk).swapaxes(0, 1)
+    mc = mask.reshape(b, n, chunk).swapaxes(0, 1)
+    chunk_nll = jax.checkpoint(nll)
 
     def body(carry, xt):
-        xch, tch = xt
-        return carry + chunk_nll(xch, tch), None
+        xch, tch, mch = xt
+        return carry + chunk_nll(xch, tch, mch), None
 
-    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (xc, tc))
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (xc, tc, mc))
     return total / (b * s)
