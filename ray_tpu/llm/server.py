@@ -62,11 +62,98 @@ class LLMServer:
             stop_token_ids=stop_ids,
             seed=body.get("seed"))
 
+    def _encode_prompt(self, prompt) -> List[int]:
+        return (list(prompt) if isinstance(prompt, list)
+                and prompt and isinstance(prompt[0], int)
+                else self._tok.encode(str(prompt)))
+
+    def _sse_stream(self, tokens: List[int], params: SamplingParams,
+                    rid: str, model: str, chat: bool):
+        """Token stream -> OpenAI SSE chunks (reference gets this from
+        vLLM; the engine already streams per-request token queues)."""
+        import json as _json
+        import queue as _queue
+
+        obj = "chat.completion.chunk" if chat else "text_completion"
+        try:
+            req = self._engine.submit(tokens, params)
+        except Exception as e:  # frame submit rejections as SSE errors
+            yield ("data: " + _json.dumps(
+                {"error": {"message": f"{type(e).__name__}: {e}"}}) + "\n\n")
+            yield "data: [DONE]\n\n"
+            return
+        if chat:
+            first = {"id": rid, "object": obj, "created": int(time.time()),
+                     "model": model,
+                     "choices": [{"index": 0, "delta": {"role": "assistant"},
+                                  "finish_reason": None}]}
+            yield f"data: {_json.dumps(first)}\n\n"
+        n = 0
+        deadline = time.monotonic() + 600.0
+        while True:
+            try:
+                # bounded waits: a dead engine loop pushes no terminator,
+                # and a stream must never hang its replica pull thread
+                tok = req.out_queue.get(timeout=5.0)
+            except _queue.Empty:
+                thread = self._engine._thread
+                if ((thread is not None and not thread.is_alive()
+                     and not self._engine._stop.is_set())
+                        or time.monotonic() > deadline):
+                    yield ("data: " + _json.dumps({"error": {
+                        "message": "engine stopped mid-stream"}}) + "\n\n")
+                    break
+                continue
+            if isinstance(tok, Exception):
+                err = {"error": {"message": str(tok)}}
+                yield f"data: {_json.dumps(err)}\n\n"
+                break
+            if tok is None:
+                reason = "length" if n >= params.max_tokens else "stop"
+                delta = ({"delta": {}} if chat else {"text": ""})
+                final = {"id": rid, "object": obj,
+                         "created": int(time.time()), "model": model,
+                         "choices": [{"index": 0, **delta,
+                                      "finish_reason": reason}]}
+                yield f"data: {_json.dumps(final)}\n\n"
+                break
+            n += 1
+            piece = self._tok.decode([tok])
+            payload = ({"delta": {"content": piece}} if chat
+                       else {"text": piece})
+            chunk = {"id": rid, "object": obj, "created": int(time.time()),
+                     "model": model,
+                     "choices": [{"index": 0, **payload,
+                                  "finish_reason": None}]}
+            yield f"data: {_json.dumps(chunk)}\n\n"
+        yield "data: [DONE]\n\n"
+
+    def completions_stream(self, body: dict):
+        from ray_tpu.serve import StreamingResponse
+
+        tokens = self._encode_prompt(body.get("prompt", ""))
+        return StreamingResponse(
+            self._sse_stream(tokens, self._params_from(body),
+                             f"cmpl-{uuid.uuid4().hex[:24]}",
+                             body.get("model", self._config.model_id),
+                             chat=False),
+            content_type="text/event-stream")
+
+    def chat_stream(self, body: dict):
+        from ray_tpu.serve import StreamingResponse
+
+        prompt = self._tok.apply_chat_template(body.get("messages", []))
+        return StreamingResponse(
+            self._sse_stream(self._tok.encode(prompt),
+                             self._params_from(body),
+                             f"chatcmpl-{uuid.uuid4().hex[:24]}",
+                             body.get("model", self._config.model_id),
+                             chat=True),
+            content_type="text/event-stream")
+
     def completions(self, body: dict) -> dict:
         prompt = body.get("prompt", "")
-        tokens = (list(prompt) if isinstance(prompt, list)
-                  and prompt and isinstance(prompt[0], int)
-                  else self._tok.encode(str(prompt)))
+        tokens = self._encode_prompt(prompt)
         params = self._params_from(body)
         out = self._engine.generate(tokens, params)
         text = self._tok.decode(out)
@@ -135,8 +222,16 @@ class OpenAIRouter:
             return {"object": "list",
                     "data": [{"id": self._model_id, "object": "model"}]}
         if path.endswith("/chat/completions"):
+            if body.get("stream"):
+                # the stream marker passes through untouched: the proxy
+                # pulls SSE chunks straight from the LLMServer replica
+                return self._server.chat_stream.remote(body).result(
+                    timeout_s=300)
             return self._server.chat.remote(body).result(timeout_s=300)
         if path.endswith("/completions"):
+            if body.get("stream"):
+                return self._server.completions_stream.remote(body).result(
+                    timeout_s=300)
             return self._server.completions.remote(body).result(
                 timeout_s=300)
         return {"error": f"unknown endpoint {path}"}

@@ -20,6 +20,7 @@ from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.deployment import Application, Deployment, deployment
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve.streaming import HTTPResponse, StreamingResponse, ingress
 
 __all__ = [
     "Application",
@@ -33,6 +34,9 @@ __all__ = [
     "get_app_handle",
     "get_multiplexed_model_id",
     "multiplexed",
+    "HTTPResponse",
+    "StreamingResponse",
+    "ingress",
     "grpc_port",
     "http_port",
     "run",

@@ -22,11 +22,17 @@ from ray_tpu.serve.handle import CONTROLLER_NAME, DeploymentHandle
 
 class ProxyActor:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        from concurrent.futures import ThreadPoolExecutor
+
         self._host = host
         self._port = port
         self._routes: Dict[str, dict] = {}
         self._handles: Dict[str, DeploymentHandle] = {}
         self._version = -1
+        # streaming pulls park a thread for the full inter-chunk wait; a
+        # dedicated pool keeps them from starving request dispatch
+        self._stream_pool = ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="stream-pull")
         self._ready = threading.Event()
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
@@ -132,11 +138,89 @@ class ProxyActor:
                 return web.json_response(
                     {"error": type(e).__name__, "detail": str(e)},
                     status=500)
+            from ray_tpu.serve import streaming as streaming_mod
+
+            if isinstance(out, dict) and streaming_mod.STREAM_KEY in out:
+                return await stream_to_client(request, out)
+            if isinstance(out, dict) and streaming_mod.HTTP_KEY in out:
+                from multidict import CIMultiDict
+
+                raw = out[streaming_mod.HTTP_KEY]
+                # multidict, not dict: duplicate headers (Set-Cookie!)
+                # must survive
+                return web.Response(body=raw["body"], status=raw["status"],
+                                    headers=CIMultiDict(raw["headers"]))
             if isinstance(out, bytes):
                 return web.Response(body=out)
             if isinstance(out, str):
                 return web.Response(text=out)
             return web.json_response(out)
+
+        async def stream_to_client(request: web.Request,
+                                   marker: dict) -> web.StreamResponse:
+            """Incremental response: pull chunk batches from the replica
+            holding the generator (pinned by actor id — streams are
+            replica-local state) and write them as they arrive.  Reference:
+            proxy.py:709 streaming + replica ASGI wrapper."""
+            from ray_tpu.core.actor import ActorHandle
+            from ray_tpu.serve import streaming as streaming_mod
+
+            sid = marker[streaming_mod.STREAM_KEY]
+            replica = ActorHandle(bytes.fromhex(marker["actor_id"]),
+                                  "StreamReplica")
+
+            # One chunk per pull: a batched pull would BLOCK on a slow
+            # generator and destroy incremental delivery; round trips ride
+            # the direct actor transport (~sub-ms), so per-chunk cost is
+            # fine — producers wanting throughput yield bigger chunks.
+            # Pulls run on a DEDICATED executor: each blocks for the full
+            # inter-chunk wait, and parking them on the default pool would
+            # starve dispatch of every other request.
+            def pull():
+                return ray_tpu.get(
+                    replica.next_stream_chunks.remote(sid, 1),
+                    timeout=300)
+
+            first, done, error = await loop.run_in_executor(
+                self._stream_pool, pull)
+            if error is not None and not first:
+                # failed before producing anything: a proper HTTP error
+                # beats a 200 with a broken body
+                return web.json_response(
+                    {"error": "stream failed", "detail": error}, status=500)
+            resp = web.StreamResponse(
+                status=marker.get("status", 200),
+                headers={"Content-Type": marker.get(
+                    "content_type", "text/plain")})
+            await resp.prepare(request)
+            try:
+                chunks = first
+                while True:
+                    for c in chunks:
+                        await resp.write(c.encode() if isinstance(c, str)
+                                         else bytes(c))
+                    if done:
+                        break
+                    chunks, done, error = await loop.run_in_executor(
+                        self._stream_pool, pull)
+                    # mid-stream errors: nothing valid we can write in an
+                    # unknown framing — just close (SSE producers frame
+                    # their own errors before raising)
+                await resp.write_eof()
+            except (ConnectionResetError, ConnectionError, OSError,
+                    asyncio.CancelledError):
+                # client went away: release the replica-side stream so its
+                # load accounting doesn't linger
+                def cancel():
+                    try:
+                        ray_tpu.get(replica.cancel_stream.remote(sid),
+                                    timeout=30)
+                    except Exception:
+                        pass
+
+                await loop.run_in_executor(self._stream_pool, cancel)
+                raise
+            return resp
 
         app = web.Application()
         app.router.add_route("*", "/{tail:.*}", dispatch)

@@ -15,6 +15,21 @@ from typing import Any, Dict, Optional
 import cloudpickle
 
 
+def _drain_async_gen(agen):
+    """Adapt an async generator to a sync iterator (one loop per stream)."""
+    import asyncio
+
+    loop = asyncio.new_event_loop()
+    try:
+        while True:
+            try:
+                yield loop.run_until_complete(agen.__anext__())
+            except StopAsyncIteration:
+                return
+    finally:
+        loop.close()
+
+
 def _resolve_handles(obj, app_name: str):
     """Replace {"__serve_handle__": name} placeholders from the bound DAG
     with live DeploymentHandles (composition — reference: deployments
@@ -44,6 +59,12 @@ class ReplicaActor:
         self._lock = threading.Lock()
         self._total = 0
         self._peak = 0
+        # Live streaming responses: sid -> (iterator, per-stream lock,
+        # last-activity ts).  A stream counts as an ongoing request until
+        # exhausted (or reaped after idling: an abandoned client must not
+        # pin autoscaling load forever).
+        self._streams: Dict[str, list] = {}
+        self._stream_idle_s = 300.0
         if user_config is not None:
             self.reconfigure(user_config)
 
@@ -90,6 +111,14 @@ class ReplicaActor:
                 import asyncio
 
                 out = asyncio.run(out)
+            from ray_tpu.serve import streaming
+
+            if streaming.is_stream_result(out):
+                return self._register_stream(out)
+            if isinstance(out, streaming.HTTPResponse):
+                return {streaming.HTTP_KEY: {
+                    "status": out.status, "headers": out.headers,
+                    "body": out.body}}
             return out
         finally:
             if model_id_token is not None:
@@ -99,7 +128,90 @@ class ReplicaActor:
             with self._lock:
                 self._ongoing -= 1
 
+    def _register_stream(self, out) -> dict:
+        """Park a generator result; the proxy pulls chunks with
+        next_stream_chunks, pinned to this replica by actor id."""
+        import time
+        import uuid
+
+        import ray_tpu
+        from ray_tpu.serve import streaming
+
+        if isinstance(out, streaming.StreamingResponse):
+            gen, ctype, status = out.chunks, out.content_type, out.status
+        else:
+            gen, ctype, status = out, "text/plain", 200
+        if inspect.isasyncgen(gen):
+            gen = _drain_async_gen(gen)
+        sid = uuid.uuid4().hex[:16]
+        with self._lock:
+            self._reap_idle_streams_locked()
+            self._streams[sid] = [iter(gen), threading.Lock(),
+                                  time.monotonic()]
+            self._ongoing += 1  # the stream is still an in-flight request
+        return {streaming.STREAM_KEY: sid,
+                "actor_id": ray_tpu.get_runtime_context().get_actor_id(),
+                "content_type": ctype, "status": status}
+
+    def next_stream_chunks(self, sid: str, max_items: int = 16):
+        """Pull up to max_items chunks; returns (chunks, done, error).
+
+        ``error`` (a repr string or None) reports a generator exception;
+        the PROXY decides how to frame it for its protocol — the replica
+        never injects text into the byte stream.
+        """
+        import time
+
+        with self._lock:
+            entry = self._streams.get(sid)
+        if entry is None:
+            return [], True, None
+        it, stream_lock, _ = entry
+        chunks, done, error = [], False, None
+        entry[2] = time.monotonic()  # mark active BEFORE a blocking pull:
+        # the reaper must not collect a stream that is merely slow
+        with stream_lock:  # one puller at a time per stream
+            for _ in range(max_items):
+                try:
+                    chunks.append(next(it))
+                except StopIteration:
+                    done = True
+                    break
+                except Exception as e:  # surface mid-stream errors
+                    error = f"{type(e).__name__}: {e}"
+                    done = True
+                    break
+        entry[2] = time.monotonic()
+        if done:
+            self._finish_stream(sid)
+        return chunks, done, error
+
+    def cancel_stream(self, sid: str) -> bool:
+        """Client went away: drop the stream and its load accounting."""
+        self._finish_stream(sid)
+        return True
+
+    def _finish_stream(self, sid: str):
+        with self._lock:
+            if self._streams.pop(sid, None) is not None:
+                self._ongoing -= 1
+
+    def _reap_idle_streams_locked(self):
+        import time
+
+        now = time.monotonic()
+        for sid, entry in list(self._streams.items()):
+            if entry[1].locked():
+                continue  # an active puller is blocked on the generator
+            if now - entry[2] > self._stream_idle_s:
+                del self._streams[sid]
+                self._ongoing -= 1
+
     def queue_len(self) -> int:
+        # abandoned streams must not report phantom load forever: this is
+        # polled by the router/autoscaler, so reap here too
+        with self._lock:
+            self._reap_idle_streams_locked()
         return self._ongoing
 
     def drain_peak_load(self) -> int:

@@ -1,0 +1,144 @@
+"""Serve streaming + ASGI: generator deployments, SSE token streaming,
+raw-ASGI ingress (reference: serve/_private/proxy.py:709 streaming,
+replica.py ASGI wrapper, @serve.ingress)."""
+
+import json
+import time
+
+import pytest
+import requests
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module", autouse=True)
+def cluster(ray_cluster):
+    yield ray_cluster
+    serve.shutdown()
+
+
+def test_generator_deployment_streams(cluster):
+    @serve.deployment
+    class Streamer:
+        def __call__(self, n):
+            def gen():
+                for i in range(int(n)):
+                    yield f"chunk-{i};"
+            return serve.StreamingResponse(gen(), content_type="text/plain")
+
+    serve.run(Streamer.bind(), name="streamer", route_prefix="/stream")
+    port = serve.http_port()
+    r = requests.post(f"http://127.0.0.1:{port}/stream", json=5, timeout=60,
+                      stream=True)
+    assert r.status_code == 200
+    body = b"".join(r.iter_content(64)).decode()
+    assert body == "".join(f"chunk-{i};" for i in range(5))
+    serve.delete("streamer")
+
+
+def test_bare_generator_and_incremental_delivery(cluster):
+    @serve.deployment
+    class Slow:
+        def __call__(self, arg=None):
+            def gen():
+                for i in range(3):
+                    time.sleep(0.3)
+                    yield f"t{i}|"
+            return gen()  # bare generators stream too
+
+    serve.run(Slow.bind(), name="slowgen", route_prefix="/slow")
+    port = serve.http_port()
+    t0 = time.monotonic()
+    first_at = None
+    chunks = []
+    with requests.post(f"http://127.0.0.1:{port}/slow", timeout=60,
+                       stream=True) as r:
+        for chunk in r.iter_content(16):
+            if first_at is None:
+                first_at = time.monotonic() - t0
+            chunks.append(chunk.decode())
+    total = time.monotonic() - t0
+    assert "".join(chunks) == "t0|t1|t2|"
+    # the first chunk must arrive well before the stream completes —
+    # i.e. delivery is incremental, not buffered
+    assert first_at < total - 0.25, (first_at, total)
+    serve.delete("slowgen")
+
+
+def test_asgi_ingress(cluster):
+    async def asgi_app(scope, receive, send):
+        assert scope["type"] == "http"
+        msg = await receive()
+        body = msg.get("body", b"")
+        if scope["path"].endswith("/echo"):
+            payload = json.dumps({
+                "path": scope["path"], "method": scope["method"],
+                "echo": json.loads(body) if body else None,
+                "q": scope["query_string"].decode()}).encode()
+            status = 200
+        else:
+            payload, status = b"nope", 404
+        await send({"type": "http.response.start", "status": status,
+                    "headers": [(b"content-type", b"application/json"),
+                                (b"x-served-by", b"asgi")]})
+        await send({"type": "http.response.body", "body": payload})
+
+    App = serve.deployment(serve.ingress(asgi_app))
+    serve.run(App.bind(), name="asgiapp", route_prefix="/api")
+    port = serve.http_port()
+    r = requests.post(f"http://127.0.0.1:{port}/api/echo?who=me",
+                      json={"x": 1}, timeout=60)
+    assert r.status_code == 200
+    assert r.headers.get("x-served-by") == "asgi"
+    data = r.json()
+    assert data["echo"] == {"x": 1}
+    assert data["path"] == "/echo"
+    assert data["q"] == "who=me"
+    r2 = requests.get(f"http://127.0.0.1:{port}/api/missing", timeout=60)
+    assert r2.status_code == 404
+    serve.delete("asgiapp")
+
+
+def test_openai_sse_token_streaming(cluster):
+    """/v1/chat/completions with stream:true yields SSE chunks end to end
+    (proxy -> router -> LLMServer replica -> engine token queues)."""
+    from ray_tpu.llm.server import LLMConfig, build_openai_app
+    from ray_tpu.llm.engine import EngineConfig
+    from ray_tpu.models import llama
+
+    def loader():
+        import jax
+
+        cfg = llama.LlamaConfig.tiny(vocab_size=384)
+        return llama.init(cfg, jax.random.PRNGKey(0)), cfg
+
+    app = build_openai_app(LLMConfig(
+        model_id="tiny", model_loader=loader,
+        engine_config=EngineConfig(max_slots=2, num_pages=64, page_size=8,
+                                   max_seq_len=128,
+                                   prefill_buckets=(16, 32, 64)),
+        default_max_tokens=8))
+    serve.run(app, name="llm", route_prefix="/llm",
+              _blocking_timeout_s=240.0)
+    port = serve.http_port()
+    with requests.post(
+            f"http://127.0.0.1:{port}/llm/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "hi"}],
+                  "max_tokens": 4, "stream": True},
+            timeout=240, stream=True) as r:
+        assert r.status_code == 200
+        assert "text/event-stream" in r.headers.get("Content-Type", "")
+        events = []
+        for line in r.iter_lines():
+            if line:
+                events.append(line.decode())
+    assert events[-1] == "data: [DONE]"
+    payloads = [json.loads(e[len("data: "):]) for e in events[:-1]]
+    # role preamble + >=1 content delta + finish chunk
+    assert payloads[0]["choices"][0]["delta"].get("role") == "assistant"
+    assert any(p["choices"][0]["delta"].get("content")
+               for p in payloads[1:-1])
+    assert payloads[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+    assert all(p["object"] == "chat.completion.chunk" for p in payloads)
+    serve.delete("llm")
