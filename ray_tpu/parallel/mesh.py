@@ -11,6 +11,15 @@ shardings over ICI; there are no communicator handles to manage.
 
 Axis order is chosen so the innermost (fastest-varying over the physical
 ring) axes carry the heaviest traffic: tp innermost, then sp, then fsdp/dp.
+
+Multi-slice (DCN): the OUTERMOST axis ``dcn`` spans TPU slices.  Slices
+are connected by data-center network, not ICI, so only the lightest
+periodic traffic belongs on it — the default sharding rules put plain data
+parallelism there (a gradient all-reduce per step) while fsdp/tp/sp/ep
+collectives stay intra-slice (SURVEY §2.5: "DCN for cross-slice via JAX's
+multi-slice mesh axes").  Control-plane and object traffic between slices
+rides the host network through the schedulers' TCP transfer path — the
+host-relayed DCN story.
 """
 
 from __future__ import annotations
@@ -23,15 +32,17 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-# Canonical axis order, outermost-first.
-AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+# Canonical axis order, outermost-first.  dcn MUST stay outermost: it is
+# the only axis whose neighboring devices are not ICI-connected.
+AXIS_ORDER = ("dcn", "pp", "dp", "fsdp", "ep", "sp", "tp")
 
 
 @dataclass(frozen=True)
 class MeshConfig:
     """Sizes of each parallelism axis; -1 on at most one axis means "fill
-    with the remaining devices"."""
+    with the remaining devices".  ``dcn`` is the number of slices."""
 
+    dcn: int = 1
     dp: int = 1
     fsdp: int = -1
     tp: int = 1
@@ -40,8 +51,9 @@ class MeshConfig:
     pp: int = 1
 
     def resolved(self, num_devices: int) -> dict[str, int]:
-        sizes = {"pp": self.pp, "dp": self.dp, "fsdp": self.fsdp,
-                 "ep": self.ep, "sp": self.sp, "tp": self.tp}
+        sizes = {"dcn": self.dcn, "pp": self.pp, "dp": self.dp,
+                 "fsdp": self.fsdp, "ep": self.ep, "sp": self.sp,
+                 "tp": self.tp}
         fills = [k for k, v in sizes.items() if v == -1]
         if len(fills) > 1:
             raise ValueError(f"only one axis may be -1, got {fills}")
@@ -58,6 +70,38 @@ class MeshConfig:
         return sizes
 
 
+def _slice_ordered(devices: list, n_slices: int) -> list:
+    """Order devices so equal-size contiguous blocks are whole slices.
+
+    Real multi-slice TPU devices carry ``slice_index``; sorting by it puts
+    each slice's devices together so the outermost (dcn) reshape axis
+    crosses slice boundaries exactly.  Devices without slice_index (CPU
+    virtual meshes, single slice) keep enumeration order — contiguous
+    blocks stand in for slices, which is what the driver's virtual
+    multi-slice dryrun wants.
+    """
+    if len(devices) % n_slices != 0:
+        raise ValueError(
+            f"{len(devices)} devices not divisible into {n_slices} slices")
+    if any(getattr(d, "slice_index", None) is not None for d in devices):
+        per = len(devices) // n_slices
+        by_slice: dict = {}
+        for d in devices:
+            by_slice.setdefault(getattr(d, "slice_index", 0) or 0,
+                                []).append(d)
+        if len(by_slice) != n_slices or any(
+                len(v) != per for v in by_slice.values()):
+            # fail fast: a mismatched dcn size would put fsdp/tp/sp
+            # collectives across DCN links — silently 10-100x slower
+            raise ValueError(
+                f"dcn={n_slices} does not match the physical topology: "
+                f"{ {s: len(v) for s, v in sorted(by_slice.items())} } "
+                f"devices per slice_index")
+        return [d for s in sorted(by_slice)
+                for d in sorted(by_slice[s], key=lambda d: d.id)]
+    return list(devices)
+
+
 def create_mesh(
     config: Optional[MeshConfig] = None,
     devices: Optional[Sequence] = None,
@@ -67,11 +111,16 @@ def create_mesh(
 
     Devices are laid out in their default enumeration order, which on TPU
     follows the physical ICI torus — keeping tp as the innermost axis puts
-    tensor-parallel collectives on nearest-neighbour links.
+    tensor-parallel collectives on nearest-neighbour links.  With dcn > 1
+    devices are grouped by slice first so the outermost axis crosses
+    slice boundaries (the reference analogue is
+    mesh_utils.create_hybrid_device_mesh).
     """
     devices = list(devices if devices is not None else jax.devices())
     config = config or MeshConfig()
     sizes = config.resolved(len(devices))
+    if sizes.get("dcn", 1) > 1:
+        devices = _slice_ordered(devices, sizes["dcn"])
     shape = tuple(sizes[a] for a in axis_names)
     dev_array = np.array(devices).reshape(shape)
     return Mesh(dev_array, axis_names=tuple(axis_names))

@@ -16,9 +16,11 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # Default rules for transformer LMs.  Values are mesh axis names (or tuples
-# thereof), None = replicated.
+# thereof), None = replicated.  The dcn (multi-slice) axis carries plain
+# data parallelism: batch splits across slices over DCN while every other
+# collective stays on intra-slice ICI (SURVEY §2.5 TPU-native mapping).
 DEFAULT_RULES: dict[str, Union[str, tuple, None]] = {
-    "batch": ("dp", "fsdp"),
+    "batch": ("dcn", "dp", "fsdp"),
     "seq": "sp",           # sequence/context parallelism
     "embed": "fsdp",       # ZeRO-style param sharding
     "heads": "tp",
