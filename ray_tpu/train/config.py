@@ -32,6 +32,27 @@ class ScalingConfig:
     # Initialize jax.distributed across workers (real multi-host pods). Off
     # in single-host/virtual-device tests where process-local meshes are used.
     use_jax_distributed: bool = False
+    # Elastic lower bound (reference: v2 scaling_policy/ elastic interface):
+    # after a failure, if the full num_workers gang can no longer be placed
+    # (capacity left with a dead node), the controller rebuilds at the
+    # largest placeable size >= min_workers, re-meshes, and restores from
+    # the latest committed checkpoint.  None = fixed-size (the default).
+    min_workers: Optional[int] = None
+    # How long one attempt waits for ANY placeable size >= min_workers
+    # before counting a failure, and how often it rechecks — raise the
+    # timeout when the cluster autoscaler needs minutes to replace hosts.
+    placement_timeout_s: float = 120.0
+    placement_retry_interval_s: float = 1.0
+
+    def __post_init__(self):
+        if self.num_workers < 1:
+            raise ValueError(f"num_workers must be >= 1, got "
+                             f"{self.num_workers}")
+        if self.min_workers is not None and not (
+                1 <= self.min_workers <= self.num_workers):
+            raise ValueError(
+                f"min_workers must be in [1, num_workers={self.num_workers}]"
+                f", got {self.min_workers}")
 
     def bundle(self) -> dict:
         res = dict(self.resources_per_worker or {"CPU": 1})

@@ -100,24 +100,80 @@ class TrainController:
             # else: elastic restart from the latest committed checkpoint
 
     # -- internals ----------------------------------------------------------
+    def _start_group_elastic(self, restore, shards_factory,
+                             shards_cache: dict):
+        """Gang up at the largest placeable world size.
+
+        Scaling policy (reference: v2 scaling_policy/ + elastic failure
+        handling): every attempt first tries the full num_workers — so a
+        recovered cluster scales back up — then steps down toward
+        min_workers when the placement group cannot be reserved (capacity
+        died with a node).  The FULL size gets a few quick retries before
+        any downsizing: the previous attempt's bundles may still be
+        releasing, and a transient reservation race must not demote the
+        whole remaining run to a smaller gang.
+        """
+        from ray_tpu.exceptions import PlacementGroupUnavailableError
+
+        want = self._scaling.num_workers
+        floor = (want if self._scaling.min_workers is None
+                 else self._scaling.min_workers)
+        for n in range(want, floor - 1, -1):
+            tries = 3 if n == want else 1
+            for attempt in range(tries):
+                group = WorkerGroup(self._scaling, num_workers=n)
+                if n not in shards_cache:
+                    shards_cache[n] = shards_factory(n)
+                try:
+                    group.start(self._name, self._experiment_dir, restore,
+                                shards_cache[n], self._trial_info,
+                                self._next_report_index)
+                    return group
+                except PlacementGroupUnavailableError:
+                    group.shutdown(graceful=False)
+                    if attempt < tries - 1:
+                        time.sleep(1.0)
+                    continue  # retry / re-mesh smaller
+                except Exception:
+                    group.shutdown(graceful=False)
+                    raise
+        return None  # nothing >= floor placeable right now
+
     def _run_attempt(self) -> Optional[str]:
-        group = WorkerGroup(self._scaling)
-        n = self._scaling.num_workers
         restore = None
         latest = self._ckpt_manager.latest_checkpoint
         if latest is not None:
             restore = latest.path
-        shards = (self._dataset_factory(n)
-                  if self._dataset_factory is not None else None)
+
+        def shards_factory(n: int):
+            # re-shard datasets for the ACTUAL world size of this attempt
+            return (self._dataset_factory(n)
+                    if self._dataset_factory is not None else None)
+
+        floor = (self._scaling.num_workers
+                 if self._scaling.min_workers is None
+                 else self._scaling.min_workers)
+        deadline = time.monotonic() + self._scaling.placement_timeout_s
+        shards_cache: dict = {}
+        group = None
         try:
-            group.start(self._name, self._experiment_dir, restore, shards,
-                        self._trial_info, self._next_report_index)
+            while group is None:
+                group = self._start_group_elastic(restore, shards_factory,
+                                                  shards_cache)
+                if group is None:
+                    if time.monotonic() > deadline:
+                        return ("could not place a worker group of size "
+                                f">= {floor}")
+                    time.sleep(self._scaling.placement_retry_interval_s)
+                    # transient: bundles releasing / node death not yet
+                    # observed — capacity may return
             group.run(self._train_fn, self._config)
             return self._poll_until_done(group)
         except (ActorDiedError, ActorUnavailableError, RayTpuError) as e:
             return str(e)
         finally:
-            group.shutdown()
+            if group is not None:
+                group.shutdown()
 
     def _poll_until_done(self, group: WorkerGroup) -> Optional[str]:
         n = group.num_workers

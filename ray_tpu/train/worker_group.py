@@ -12,6 +12,7 @@ torch.distributed process groups.
 
 from __future__ import annotations
 
+import os
 import socket
 import threading
 import traceback
@@ -59,6 +60,20 @@ class TrainWorker:
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
                 num_processes=world_size, process_id=rank)
+        # Persistent compilation cache: elastic re-meshing recompiles the
+        # train step per mesh shape — cache hits make resuming at a
+        # previously-seen world size near-instant (SURVEY §7 "cached
+        # compilations per mesh shape").
+        try:
+            import jax
+
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.environ.get("RTPU_JAX_CACHE_DIR", "/tmp/jax_cache"))
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception:
+            pass
         return True
 
     def run(self, fn_blob: bytes, config: Optional[dict]) -> bool:
@@ -117,8 +132,12 @@ class TrainWorker:
 class WorkerGroup:
     """Creates/destroys the gang; fans calls out to all ranks."""
 
-    def __init__(self, scaling_config: ScalingConfig):
+    def __init__(self, scaling_config: ScalingConfig,
+                 num_workers: Optional[int] = None):
+        """num_workers overrides the config's size — the controller's
+        elastic policy passes the per-attempt world size here."""
         self._config = scaling_config
+        self._num_workers = num_workers or scaling_config.num_workers
         self._pg = None
         self._workers: list[Any] = []
 
@@ -128,7 +147,7 @@ class WorkerGroup:
 
     @property
     def num_workers(self) -> int:
-        return self._config.num_workers
+        return self._num_workers
 
     def start(self, experiment_name: str, experiment_dir: str,
               restore_checkpoint_path: Optional[str] = None,
@@ -141,13 +160,14 @@ class WorkerGroup:
         )
 
         cfg = self._config
+        n = self._num_workers
         bundle = cfg.bundle()
         self._pg = placement_group(
-            [dict(bundle) for _ in range(cfg.num_workers)],
+            [dict(bundle) for _ in range(n)],
             strategy=cfg.placement_strategy)
         actor_cls = ray_tpu.remote(TrainWorker)
         self._workers = []
-        for rank in range(cfg.num_workers):
+        for rank in range(n):
             strategy = PlacementGroupSchedulingStrategy(
                 self._pg, placement_group_bundle_index=rank)
             opts = {"scheduling_strategy": strategy,
@@ -157,7 +177,7 @@ class WorkerGroup:
             self._workers.append(actor_cls.options(**opts).remote())
 
         coordinator = (f"127.0.0.1:{_free_port()}"
-                       if cfg.use_jax_distributed and cfg.num_workers > 1
+                       if cfg.use_jax_distributed and n > 1
                        else None)
         setups = []
         for rank, w in enumerate(self._workers):
@@ -165,7 +185,7 @@ class WorkerGroup:
             if dataset_shards_per_rank is not None:
                 shards = cloudpickle.dumps(dataset_shards_per_rank[rank])
             setups.append(w.setup.remote(
-                rank, rank, cfg.num_workers, experiment_name, experiment_dir,
+                rank, rank, n, experiment_name, experiment_dir,
                 restore_checkpoint_path, coordinator, shards, trial_info,
                 start_report_index))
         ray_tpu.get(setups)

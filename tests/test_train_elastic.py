@@ -1,0 +1,146 @@
+"""Elastic training: node death mid-run -> re-gang at a smaller world
+size, re-mesh, resume from the last committed checkpoint (reference:
+train/v2/_internal/execution/failure_handling/ + scaling_policy/)."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.train import (
+    Checkpoint,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+@pytest.fixture
+def elastic_cluster():
+    import ray_tpu.api as api
+    from ray_tpu._private import worker as worker_mod
+
+    prev_ctx = worker_mod._global_worker
+    prev_node = api._global_node
+    worker_mod.set_global_worker(None)
+    api._global_node = None
+
+    c = Cluster(head_node_args={
+        "resources": {"CPU": 2.0}, "min_workers": 1,
+        "object_store_memory": 1 << 27})
+    ray_tpu.init(_existing_node=c.head_node)
+    extra = c.add_node(resources={"CPU": 2.0}, min_workers=1,
+                       object_store_memory=1 << 27)
+    c.wait_for_nodes()
+    try:
+        yield c, extra
+    finally:
+        api._global_node = None
+        worker_mod.set_global_worker(None)
+        c.shutdown()
+        worker_mod.set_global_worker(prev_ctx)
+        api._global_node = prev_node
+
+
+def test_node_death_resumes_at_smaller_world_size(elastic_cluster, tmp_path):
+    cluster, extra = elastic_cluster
+
+    def train_fn(config):
+        import tempfile
+
+        ctx = train.get_context()
+        ckpt = train.get_checkpoint()
+        start = 0
+        if ckpt is not None:
+            with ckpt.as_directory() as d:
+                start = int(open(os.path.join(d, "step")).read()) + 1
+        for i in range(start, 8):
+            time.sleep(0.25)  # slow enough for the kill to land mid-run
+            with tempfile.TemporaryDirectory() as d:
+                open(os.path.join(d, "step"), "w").write(str(i))
+                train.report(
+                    {"step": i, "world": ctx.get_world_size()},
+                    checkpoint=Checkpoint.from_directory(d))
+
+    seen = []
+    killed = {"done": False}
+
+    def on_report(index, metrics, ckpt):
+        seen.append(dict(metrics))
+        if metrics["step"] >= 2 and not killed["done"]:
+            killed["done"] = True
+            # kill the node carrying part of the gang: capacity 4 -> 2
+            cluster.remove_node(extra)
+
+    result = JaxTrainer(
+        train_fn,
+        train_loop_config={},
+        scaling_config=ScalingConfig(
+            num_workers=4, min_workers=2,
+            resources_per_worker={"CPU": 1},
+            placement_strategy="PACK"),
+        run_config=RunConfig(
+            name="t_elastic_node", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=3)),
+        callbacks=[on_report],
+    ).fit()
+
+    assert result.error is None, result.error
+    assert killed["done"]
+    assert result.metrics["step"] == 7  # ran to completion
+    worlds = {m["world"] for m in seen}
+    assert 4 in worlds, worlds  # started with the full gang
+    # after the node died the gang re-formed SMALLER (2 CPUs left)
+    assert any(w < 4 for w in worlds), worlds
+    # resumed from the checkpoint, not from zero: step sequence is
+    # non-decreasing with at most one step of replay at the boundary
+    steps = [m["step"] for m in seen]
+    assert steps[-1] == 7
+    for a, b in zip(steps, steps[1:]):
+        assert b >= a - 1  # never rewinds past the committed checkpoint
+
+
+def test_elastic_scales_back_up(elastic_cluster, tmp_path):
+    """Capacity returning lets the next attempt re-form at full size."""
+    cluster, extra = elastic_cluster
+
+    def train_fn(config):
+        import tempfile
+
+        ctx = train.get_context()
+        ckpt = train.get_checkpoint()
+        start = 0
+        if ckpt is not None:
+            with ckpt.as_directory() as d:
+                start = int(open(os.path.join(d, "step")).read()) + 1
+        for i in range(start, 4):
+            time.sleep(0.1)
+            with tempfile.TemporaryDirectory() as d:
+                open(os.path.join(d, "step"), "w").write(str(i))
+                train.report(
+                    {"step": i, "world": ctx.get_world_size()},
+                    checkpoint=Checkpoint.from_directory(d))
+        if config and config.get("crash_marker"):
+            if not os.path.exists(config["crash_marker"]):
+                open(config["crash_marker"], "w").close()
+                raise RuntimeError("injected crash after capacity returned")
+
+    marker = str(tmp_path / "crashed")
+    result = JaxTrainer(
+        train_fn,
+        train_loop_config={"crash_marker": marker},
+        scaling_config=ScalingConfig(
+            num_workers=4, min_workers=2,
+            resources_per_worker={"CPU": 1},
+            placement_strategy="PACK"),
+        run_config=RunConfig(
+            name="t_elastic_up", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=2)),
+    ).fit()
+    assert result.error is None, result.error
+    # both attempts had full capacity: every report shows world=4
+    assert result.metrics["world"] == 4
