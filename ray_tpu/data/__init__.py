@@ -120,6 +120,27 @@ def read_numpy(paths, *, override_num_blocks: Optional[int] = None) -> Dataset:
     return _read("ReadNumpy", _ds.numpy_tasks(paths, _par(override_num_blocks)))
 
 
+def read_tfrecords(paths, *, raw_bytes: bool = False,
+                   override_num_blocks: Optional[int] = None) -> Dataset:
+    """Rows from tf.train.Example records (reference: read_tfrecords)."""
+    return _read("ReadTFRecords", _ds.tfrecord_tasks(
+        paths, _par(override_num_blocks), raw_bytes=raw_bytes))
+
+
+def read_webdataset(paths, *,
+                    override_num_blocks: Optional[int] = None) -> Dataset:
+    """Samples from webdataset tar shards (reference: read_webdataset)."""
+    return _read("ReadWebDataset", _ds.webdataset_tasks(
+        paths, _par(override_num_blocks)))
+
+
+def read_sql(sql: str, connection_factory, *,
+             fetch_size: int = 4096) -> Dataset:
+    """Rows from any DB-API 2.0 query (reference: read_sql)."""
+    return _read("ReadSQL", _ds.sql_tasks(
+        sql, connection_factory, fetch_size=fetch_size))
+
+
 __all__ = [
     "Block",
     "BlockMetadata",
@@ -148,5 +169,8 @@ __all__ = [
     "read_json",
     "read_numpy",
     "read_parquet",
+    "read_sql",
+    "read_tfrecords",
+    "read_webdataset",
     "read_text",
 ]

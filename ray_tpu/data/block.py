@@ -56,7 +56,14 @@ def from_rows(rows: Iterable[Dict[str, Any]]) -> Block:
         return pa.table({})
     if not isinstance(rows[0], dict):
         rows = [{VALUE_COL: r} for r in rows]
-    cols: Dict[str, List[Any]] = {k: [] for k in rows[0]}
+    # Union of ALL rows' keys (insertion-ordered): sparse rows (tfrecord
+    # features, webdataset extensions) must not silently drop columns that
+    # the first row happens to lack; absent values become nulls.
+    cols: Dict[str, List[Any]] = {}
+    for r in rows:
+        for k in r:
+            if k not in cols:
+                cols[k] = []
     for r in rows:
         for k in cols:
             cols[k].append(r.get(k))

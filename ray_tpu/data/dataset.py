@@ -223,6 +223,18 @@ class Dataset:
         return Dataset(self._plan.with_op(
             L.Zip(name="Zip", other=other._plan)))
 
+    def join(self, other: "Dataset", on, *, how: str = "inner",
+             num_partitions: Optional[int] = None) -> "Dataset":
+        """Distributed hash join on key column(s) (reference:
+        dataset join via _internal/execution/operators/join.py).
+
+        how: "inner" | "left" | "right" | "outer".
+        """
+        keys = (on,) if isinstance(on, str) else tuple(on)
+        return Dataset(self._plan.with_op(L.Join(
+            name=f"Join[{','.join(keys)}]", other=other._plan, on=keys,
+            how=how, num_partitions=num_partitions)))
+
     # global aggregations (reference dataset.py sum/min/max/mean/std)
     def _scalar(self, col: str):
         rows = self.take_all()

@@ -275,3 +275,149 @@ def make_write_fn(path: str, fmt: str, write_kwargs: Optional[dict] = None):
             yield pa.table({"path": [out], "num_rows": [b.num_rows]})
 
     return write_blocks
+
+
+# -- tfrecords ---------------------------------------------------------------
+
+def _iter_tfrecord_frames(path: str) -> Iterator[bytes]:
+    """TFRecord wire framing: u64 length | u32 masked-crc(len) | payload |
+    u32 masked-crc(payload).  CRCs are not verified (the reference's reader
+    delegates verification to tf.data as well)."""
+    import struct
+
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(12)
+            if len(header) < 12:
+                return
+            (length,) = struct.unpack("<Q", header[:8])
+            payload = f.read(length)
+            if len(payload) < length:
+                return  # truncated trailing record
+            f.read(4)  # payload crc
+            yield payload
+
+
+def _example_to_row(payload: bytes) -> Dict[str, Any]:
+    """Decode a tf.train.Example into a row of LISTS (unwrapping happens
+    per column over the whole chunk — see _unwrap_singletons — so a
+    variable-length feature can never be a scalar in one row and a list
+    in another)."""
+    import tensorflow as tf  # baked in; decode only
+
+    ex = tf.train.Example.FromString(payload)
+    row: Dict[str, Any] = {}
+    for name, feat in ex.features.feature.items():
+        kind = feat.WhichOneof("kind")
+        if kind == "bytes_list":
+            row[name] = list(feat.bytes_list.value)
+        elif kind == "int64_list":
+            row[name] = list(feat.int64_list.value)
+        elif kind == "float_list":
+            row[name] = list(feat.float_list.value)
+        else:
+            row[name] = []
+    return row
+
+
+def _unwrap_singletons(rows: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Per COLUMN: if every present value is a one-element list, unwrap to
+    scalars (reference tfrecords datasource semantics)."""
+    unwrap = set()
+    seen: Dict[str, bool] = {}
+    for r in rows:
+        for k, v in r.items():
+            ok = isinstance(v, list) and len(v) == 1
+            seen[k] = seen.get(k, True) and ok
+    unwrap = {k for k, ok in seen.items() if ok}
+    if not unwrap:
+        return rows
+    return [{k: (v[0] if k in unwrap else v) for k, v in r.items()}
+            for r in rows]
+
+
+def tfrecord_tasks(paths, parallelism: int,
+                   raw_bytes: bool = False) -> List[Callable]:
+    """reference: _internal/datasource/tfrecords_datasource.py — rows from
+    tf.train.Example records (raw_bytes=True skips proto decoding)."""
+    files = expand_paths(paths)
+
+    def read_file(f: str) -> Iterator[Block]:
+        rows: List[Dict[str, Any]] = []
+        for payload in _iter_tfrecord_frames(f):
+            if raw_bytes:
+                rows.append({"bytes": payload})
+            else:
+                rows.append(_example_to_row(payload))
+            if len(rows) >= 4096:
+                yield block_mod.from_rows(_unwrap_singletons(rows))
+                rows = []
+        if rows:
+            yield block_mod.from_rows(_unwrap_singletons(rows))
+
+    return _file_tasks(files, parallelism, read_file)
+
+
+# -- webdataset --------------------------------------------------------------
+
+def webdataset_tasks(paths, parallelism: int) -> List[Callable]:
+    """reference: _internal/datasource/webdataset_datasource.py — tar
+    shards of samples; files sharing a basename form one row keyed
+    "__key__", one column per extension.  .txt/.cls decode to str; other
+    payloads stay bytes."""
+    import tarfile
+
+    files = expand_paths(paths, [".tar"])
+
+    def read_file(f: str) -> Iterator[Block]:
+        samples: Dict[str, Dict[str, Any]] = {}
+        order: List[str] = []
+        with tarfile.open(f) as tar:
+            for member in tar:
+                if not member.isfile():
+                    continue
+                # key = full path up to the basename's first dot: samples
+                # with equal basenames in different tar directories are
+                # distinct (reference webdataset keying)
+                dirname, base = os.path.split(member.name)
+                stem, _, ext = base.partition(".")
+                key = os.path.join(dirname, stem) if dirname else stem
+                data = tar.extractfile(member).read()
+                if ext in ("txt", "cls"):
+                    data = data.decode("utf-8", "replace")
+                if key not in samples:
+                    samples[key] = {"__key__": key}
+                    order.append(key)
+                samples[key][ext] = data
+        rows = [samples[k] for k in order]
+        if rows:
+            yield block_mod.from_rows(rows)
+
+    return _file_tasks(files, parallelism, read_file)
+
+
+# -- sql ---------------------------------------------------------------------
+
+def sql_tasks(sql: str, connection_factory: Callable[[], Any],
+              fetch_size: int = 4096) -> List[Callable]:
+    """reference: _internal/datasource/sql_datasource.py — any DB-API 2.0
+    connection (sqlite3, psycopg2, ...).  The query runs in one read task
+    (partitioned SQL reads need a splittable predicate, which plain SQL
+    doesn't give us); rows stream out in fetch_size blocks."""
+
+    def read() -> Iterator[Block]:
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(sql)
+            names = [d[0] for d in cur.description]
+            while True:
+                chunk = cur.fetchmany(fetch_size)
+                if not chunk:
+                    break
+                yield block_mod.from_rows(
+                    [dict(zip(names, row)) for row in chunk])
+        finally:
+            conn.close()
+
+    return [read]

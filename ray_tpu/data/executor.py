@@ -202,24 +202,42 @@ class ReadOp(PhysicalOp):
 
 
 class ActorMapOp(PhysicalOp):
+    """Actor-pool map with per-op autoscaling (reference:
+    actor_pool_map_operator.py + autoscaler/default_autoscaler.py).
+
+    ``concurrency`` is a fixed pool size (int) or an elastic (min, max)
+    range: the pool grows one actor at a time whenever every actor is at
+    its in-flight cap and input is still pending — the same queue-pressure
+    signal the reference's per-op autoscaler uses.
+    """
+
     def __init__(self, name: str, udf_cls, udf_args, udf_kwargs,
                  make_fn: Callable, resources: dict, ctx: DataContext,
-                 concurrency: Optional[int]):
+                 concurrency):
         self.name = name
         self._udf_blob = cloudpickle.dumps((udf_cls, udf_args, udf_kwargs))
         self._make_fn_blob = cloudpickle.dumps(make_fn)
         self._resources = resources
         self._ctx = ctx
-        self._pool_size = concurrency or 2
+        if isinstance(concurrency, (tuple, list)):
+            self._min_pool, self._max_pool = int(concurrency[0]), int(
+                concurrency[1])
+            if not (1 <= self._min_pool <= self._max_pool):
+                raise ValueError(
+                    f"concurrency range must satisfy 1 <= min <= max, "
+                    f"got {concurrency}")
+        else:
+            self._min_pool = self._max_pool = concurrency or 2
 
     def execute(self, inp, stats):
         ctx = self._ctx
         actor_cls = ray_tpu.remote(_MapWorker).options(**self._resources)
-        actors = [
-            actor_cls.remote(self._udf_blob, self._make_fn_blob,
-                             ctx.target_max_block_size)
-            for _ in range(self._pool_size)
-        ]
+
+        def spawn():
+            return actor_cls.remote(self._udf_blob, self._make_fn_blob,
+                                    ctx.target_max_block_size)
+
+        actors = [spawn() for _ in range(self._min_pool)]
         ray_tpu.get([a.ready.remote() for a in actors],
                     timeout=ctx.wait_for_min_actors_s)
         in_flight: deque = deque()  # (ref, actor_idx), FIFO for ordering
@@ -231,7 +249,7 @@ class ActorMapOp(PhysicalOp):
             done_in = False
             while True:
                 while (not done_in
-                       and len(in_flight) < self._pool_size * cap):
+                       and len(in_flight) < len(actors) * cap):
                     bundle = next(it, None)
                     if bundle is None:
                         done_in = True
@@ -244,6 +262,21 @@ class ActorMapOp(PhysicalOp):
                     in_flight.append((ref, i))
                     load[i] += 1
                     stats.tasks += 1
+                if (not done_in and len(actors) < self._max_pool
+                        and len(in_flight) >= len(actors) * cap):
+                    # Scale only on a REAL utilization signal: the queue is
+                    # full, input is pending, AND the oldest task is still
+                    # running after a short grace — a pool keeping pace
+                    # never grows (the fill loop alone always leaves the
+                    # queue full, so queue depth by itself proves nothing).
+                    ready, _ = ray_tpu.wait(
+                        [in_flight[0][0]], num_returns=1, timeout=0.1)
+                    if not ready:
+                        actors.append(spawn())
+                        load[len(actors) - 1] = 0
+                        stats.actors_scaled_up = getattr(
+                            stats, "actors_scaled_up", 0) + 1
+                        continue
                 if not in_flight:
                     return
                 head, i = in_flight.popleft()
@@ -379,6 +412,10 @@ def plan_physical(plan: "L.LogicalPlan", ctx: DataContext
         elif isinstance(op, L.Union):
             flush_chain()
             ops.append(UnionOp(op.others, ctx))
+        elif isinstance(op, L.Join):
+            flush_chain()
+            ops.append(JoinOp(op.other, op.on, op.how, op.num_partitions,
+                              ctx))
         elif isinstance(op, L.Zip):
             flush_chain()
             ops.append(ZipOp(op.other, ctx))
@@ -401,6 +438,90 @@ class UnionOp(PhysicalOp):
             for bundle in execute_streaming(plan, self._ctx):
                 stats.rows += sum(m.num_rows for _, m in bundle)
                 yield bundle
+
+
+class JoinOp(PhysicalOp):
+    """Distributed hash join (reference: operators/join.py over the hash
+    shuffle): both sides hash-partition by the key columns; one reduce
+    task per partition runs the pyarrow join."""
+
+    _HOW = {"inner": "inner", "left": "left outer",
+            "right": "right outer", "outer": "full outer"}
+
+    def __init__(self, other_plan, on, how, num_partitions, ctx):
+        self.name = f"Join[{','.join(on)}]"
+        self._other = other_plan
+        self._on = tuple(on)
+        if how not in self._HOW:
+            raise ValueError(
+                f"how must be one of {sorted(self._HOW)}, got {how!r}")
+        self._how = self._HOW[how]
+        self._num_partitions = num_partitions
+        self._ctx = ctx
+
+    def execute(self, inp, stats):
+        from ray_tpu.data.shuffle import hash_partition_submit
+
+        left: List[RefBundle] = [p for b in inp for p in b]
+        right: List[RefBundle] = [
+            p for b in execute_streaming(self._other, self._ctx) for p in b]
+        if not left or not right:
+            # A zero-BLOCK side carries no schema to join against.  Joins
+            # that discard unmatched rows of the surviving side are simply
+            # empty; joins that keep them yield the surviving side's rows
+            # unchanged (the missing side's columns cannot be synthesized
+            # without a schema).
+            keep_left = self._how in ("left outer", "full outer")
+            keep_right = self._how in ("right outer", "full outer")
+            survivors = (left if (not right and keep_left)
+                         else right if (not left and keep_right) else [])
+            for p in survivors:
+                yield [p]
+            return
+        n = self._num_partitions or max(
+            1, min(8, max(len(left), len(right), 1)))
+        lparts = hash_partition_submit(left, self._on, n, "JoinMapLeft")
+        rparts = hash_partition_submit(right, self._on, n, "JoinMapRight")
+
+        on, how = self._on, self._how
+        max_block = self._ctx.target_max_block_size
+
+        def join_task(lrefs, rrefs):
+            import pyarrow as _pa
+
+            # schema-less empties (a filtered-to-nothing upstream block)
+            # must not poison the concat schema
+            lts = [b for b in ray_tpu.get(list(lrefs))
+                   if b is not None and b.num_columns > 0]
+            rts = [b for b in ray_tpu.get(list(rrefs))
+                   if b is not None and b.num_columns > 0]
+            if not lts and not rts:
+                return _put_blocks([_pa.table({})], max_block)
+            if not rts or not lts:
+                # one side has no schema in this partition: joins keeping
+                # the surviving side pass its rows through (the missing
+                # side's columns cannot be synthesized); others are empty
+                surv = block_mod.concat(lts or rts)
+                keep = (how in ("left outer", "full outer") if lts
+                        else how in ("right outer", "full outer"))
+                return _put_blocks(
+                    [surv if keep else surv.slice(0, 0)], max_block)
+            lt = block_mod.concat(lts)
+            rt = block_mod.concat(rts)
+            joined = lt.join(rt, keys=list(on), join_type=how)
+            return _put_blocks([joined], max_block)
+
+        task = ray_tpu.remote(join_task).options(name="JoinReduce")
+        futs = [task.remote([pl[j] for pl in lparts],
+                            [pr[j] for pr in rparts]) for j in range(n)]
+        t0 = time.perf_counter()
+        for f in futs:
+            bundle = ray_tpu.get(f)
+            stats.tasks += 1
+            for _, meta in bundle:
+                stats.rows += meta.num_rows
+            yield bundle
+        stats.wall_s += time.perf_counter() - t0
 
 
 class ZipOp(PhysicalOp):

@@ -78,6 +78,17 @@ class Zip(LogicalOp):
 
 
 @dataclass
+class Join(LogicalOp):
+    """Hash join with another plan (reference:
+    _internal/execution/operators/join.py + hash_shuffle.py)."""
+
+    other: Any = None  # LogicalPlan
+    on: tuple = ()  # join key column(s)
+    how: str = "inner"  # inner | left | right | outer
+    num_partitions: Optional[int] = None
+
+
+@dataclass
 class Limit(LogicalOp):
     limit: int = 0
 

@@ -123,6 +123,25 @@ def random_shuffle_fn(seed: Optional[int] = None,
     return bulk
 
 
+def hash_partition_submit(bundles, keys: Tuple[str, ...], n_parts: int,
+                          name: str) -> List[List[Any]]:
+    """Hash-partition every bundle's block by key columns; returns
+    parts[i][j] = ref of input i's piece for partition j (the map half of
+    a hash shuffle — reference: operators/hash_shuffle.py)."""
+    import zlib
+
+    def map_fn(b: Block) -> List[Block]:
+        if b.num_rows == 0:
+            return [b] * n_parts
+        cols = [b.column(k).to_pylist() for k in keys]
+        hashed = np.asarray(
+            [zlib.crc32(repr(vals).encode()) % n_parts
+             for vals in zip(*cols)], dtype=np.int64)
+        return [b.filter(pa.array(hashed == j)) for j in range(n_parts)]
+
+    return _map_submit(bundles, map_fn, name)
+
+
 def _sample_boundaries(bundles, key: str, n_parts: int) -> List[Any]:
     """Sample input blocks to pick range-partition boundaries (reference:
     sort_task_spec.py SortTaskSpec.sample_boundaries)."""

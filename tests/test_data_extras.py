@@ -94,3 +94,132 @@ def test_marwil_prefers_high_return_actions(cluster):
         algo.train()
     picked = [algo.compute_single_action(o) for o in obs[:200]]
     assert np.mean(picked) > 0.8, np.mean(picked)
+
+
+def test_join_inner_and_left(ray_cluster):
+    import ray_tpu.data as rdata
+
+    left = rdata.from_items(
+        [{"k": i, "a": i * 10} for i in range(8)])
+    right = rdata.from_items(
+        [{"k": i, "b": i * 100} for i in range(4, 12)])
+    inner = left.join(right, on="k").take_all()
+    assert sorted(r["k"] for r in inner) == [4, 5, 6, 7]
+    assert all(r["b"] == r["k"] * 100 and r["a"] == r["k"] * 10
+               for r in inner)
+
+    left_j = sorted(left.join(right, on="k", how="left").take_all(),
+                    key=lambda r: r["k"])
+    assert [r["k"] for r in left_j] == list(range(8))
+    assert left_j[0]["b"] is None  # unmatched left rows keep nulls
+    assert left_j[7]["b"] == 700
+
+
+def test_join_multi_partition_consistency(ray_cluster):
+    import ray_tpu.data as rdata
+
+    n = 200
+    left = rdata.range(n).map_batches(
+        lambda b: {"k": b["id"] % 17, "v": b["id"]})
+    right = rdata.from_items([{"k": i, "w": -i} for i in range(17)])
+    out = left.join(right, on="k", num_partitions=5).take_all()
+    assert len(out) == n
+    assert all(r["w"] == -(r["v"] % 17) for r in out)
+
+
+def test_actor_pool_autoscaling(ray_cluster):
+    import ray_tpu.data as rdata
+
+    class Slowish:
+        def __call__(self, batch):
+            import time
+
+            time.sleep(0.4)
+            return batch
+
+    ds = rdata.range(64, override_num_blocks=16).map_batches(
+        Slowish, concurrency=(1, 3), batch_size=4)
+    assert ds.count() == 64
+    # the slow UDF must have triggered at least one scale-up (pool 1 -> N)
+    scaled = sum(getattr(s, "actors_scaled_up", 0)
+                 for s in ds._last_stats.ops)
+    assert scaled >= 1, [vars(s) for s in ds._last_stats.ops]
+
+
+def test_join_with_empty_side(ray_cluster):
+    import ray_tpu.data as rdata
+
+    left = rdata.from_items([{"k": i, "a": i} for i in range(4)])
+    empty = left.filter(lambda r: r["k"] > 100)
+    assert left.join(empty, on="k").count() == 0  # inner: empty
+    kept = left.join(empty, on="k", how="left").take_all()
+    assert sorted(r["k"] for r in kept) == [0, 1, 2, 3]
+
+
+def test_read_tfrecords(ray_cluster, tmp_path):
+    import tensorflow as tf
+
+    import ray_tpu.data as rdata
+
+    path = str(tmp_path / "data.tfrecord")
+    with tf.io.TFRecordWriter(path) as w:
+        for i in range(10):
+            ex = tf.train.Example(features=tf.train.Features(feature={
+                "idx": tf.train.Feature(
+                    int64_list=tf.train.Int64List(value=[i])),
+                "name": tf.train.Feature(
+                    bytes_list=tf.train.BytesList(
+                        value=[f"row{i}".encode()])),
+                "vec": tf.train.Feature(
+                    float_list=tf.train.FloatList(value=[i * 1.0, 2.0])),
+            }))
+            w.write(ex.SerializeToString())
+    rows = sorted(rdata.read_tfrecords(path).take_all(),
+                  key=lambda r: r["idx"])
+    assert len(rows) == 10
+    assert rows[3]["idx"] == 3
+    assert bytes(rows[3]["name"]) == b"row3"
+    assert list(rows[3]["vec"]) == [3.0, 2.0]
+
+
+def test_read_webdataset(ray_cluster, tmp_path):
+    import io
+    import tarfile
+
+    import ray_tpu.data as rdata
+
+    shard = str(tmp_path / "shard-000.tar")
+    with tarfile.open(shard, "w") as tar:
+        for i in range(5):
+            for ext, payload in (("txt", f"caption {i}".encode()),
+                                 ("bin", bytes([i] * 4))):
+                data = io.BytesIO(payload)
+                info = tarfile.TarInfo(f"sample{i:04d}.{ext}")
+                info.size = len(payload)
+                tar.addfile(info, data)
+    rows = sorted(rdata.read_webdataset(shard).take_all(),
+                  key=lambda r: r["__key__"])
+    assert len(rows) == 5
+    assert rows[2]["__key__"] == "sample0002"
+    assert rows[2]["txt"] == "caption 2"
+    assert bytes(rows[2]["bin"]) == bytes([2] * 4)
+
+
+def test_read_sql(ray_cluster, tmp_path):
+    import sqlite3
+
+    import ray_tpu.data as rdata
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE items (id INTEGER, name TEXT)")
+    conn.executemany("INSERT INTO items VALUES (?, ?)",
+                     [(i, f"item{i}") for i in range(20)])
+    conn.commit()
+    conn.close()
+
+    ds = rdata.read_sql("SELECT id, name FROM items WHERE id < 15",
+                        lambda: sqlite3.connect(db))
+    rows = sorted(ds.take_all(), key=lambda r: r["id"])
+    assert len(rows) == 15
+    assert rows[7] == {"id": 7, "name": "item7"}
