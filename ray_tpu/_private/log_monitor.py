@@ -1,0 +1,108 @@
+"""Per-node worker-log monitor: tail worker stdout/stderr to the driver.
+
+Counterpart of the reference's log monitor
+(/root/reference/python/ray/_private/log_monitor.py): every worker process
+writes its stdout/stderr to files under the session's ``logs/`` dir; this
+monitor tails them and forwards new lines — prefixed with the producing
+worker — through the scheduler to the driver, which prints them.  The
+driver therefore sees ``print()`` output from tasks and actors on EVERY
+node, exactly like the reference's ``(pid=..., ip=...)`` lines.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List
+
+POLL_S = 0.25
+MAX_LINE = 8192
+MAX_BATCH = 200  # lines per emit: bounds message size under log floods
+
+
+class LogMonitor:
+    """Tails every ``*.out``/``*.err`` file in ``logs_dir``.
+
+    ``emit(lines)`` receives prefixed, newline-free strings.  Files are
+    discovered continuously (workers spawn at any time); offsets persist
+    per file so nothing is re-emitted.
+    """
+
+    def __init__(self, logs_dir: str, emit: Callable[[List[str]], None]):
+        self._dir = logs_dir
+        self._emit = emit
+        self._offsets: Dict[str, int] = {}
+        self._partial: Dict[str, bytes] = {}
+        self._partial_since: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="log-monitor", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:
+                pass  # a transient fs error must not kill the tail
+            self._stop.wait(POLL_S)
+
+    def poll_once(self):
+        if not os.path.isdir(self._dir):
+            return
+        now = time.monotonic()
+        batch: List[str] = []
+        for name in sorted(os.listdir(self._dir)):
+            if not (name.endswith(".out") or name.endswith(".err")):
+                continue
+            path = os.path.join(self._dir, name)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            off = self._offsets.get(name, 0)
+            if size <= off:
+                # A stale newline-less tail is a worker's dying words (C
+                # aborts don't end in \n): flush it after a quiescence
+                # window rather than holding it forever.
+                if (name in self._partial
+                        and now - self._partial_since.get(name, now)
+                        > 4 * POLL_S):
+                    tail_text = self._partial.pop(name).decode(
+                        "utf-8", "replace")
+                    self._partial_since.pop(name, None)
+                    batch.append(self._prefix(name, tail_text))
+                continue
+            try:
+                with open(path, "rb") as f:
+                    f.seek(off)
+                    data = self._partial.pop(name, b"") + f.read(
+                        size - off)
+            except OSError:
+                continue
+            self._offsets[name] = size
+            *lines, tail = data.split(b"\n")
+            if tail:
+                self._partial[name] = tail[-MAX_LINE:]
+                self._partial_since[name] = now
+            else:
+                self._partial_since.pop(name, None)
+            for raw in lines:
+                text = raw[-MAX_LINE:].decode("utf-8", "replace")
+                if text.strip():
+                    batch.append(self._prefix(name, text))
+                if len(batch) >= MAX_BATCH:
+                    self._emit(batch)
+                    batch = []
+        if batch:
+            self._emit(batch)
+
+    @staticmethod
+    def _prefix(name: str, text: str) -> str:
+        tag = name.rsplit(".", 1)[0]  # worker-<id8>
+        stream = "" if name.endswith(".out") else " stderr"
+        return f"({tag}{stream}) {text}"

@@ -157,6 +157,18 @@ class Scheduler:
             max_workers=max_workers,
             worker_env=worker_env,
         )
+        # Worker log streaming (reference: _private/log_monitor.py tailing
+        # to the driver): this node's monitor forwards new worker-output
+        # lines to the driver's sink — directly on the head, via a peer
+        # message from worker nodes.  RTPU_LOG_TO_DRIVER=0 disables.
+        self.log_sink = None  # set by the attached driver (head only)
+        self._log_monitor = None
+        self._early_logs: deque[str] = deque(maxlen=1000)
+        if os.environ.get("RTPU_LOG_TO_DRIVER", "1") != "0":
+            from ray_tpu._private.log_monitor import LogMonitor
+
+            self._log_monitor = LogMonitor(self._pool.logs_dir,
+                                           self._forward_worker_logs)
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="sched-accept", daemon=True
         )
@@ -486,6 +498,8 @@ class Scheduler:
         with self._lock:
             self._shutdown = True
             self._wake.notify_all()
+        if self._log_monitor is not None:
+            self._log_monitor.stop()
         self._pool.shutdown_all()
         try:
             self._listener.close()
@@ -547,6 +561,14 @@ class Scheduler:
                 # a worker sealed an object into this node's store: record
                 # the location so other nodes can pull it
                 self.note_sealed(msg["oid"])
+            elif t == "worker_logs":
+                # a worker node's monitor forwarding its workers' output
+                sink = self.log_sink
+                if sink is not None:
+                    try:
+                        sink(msg["lines"])
+                    except Exception:
+                        pass
             elif t == "submit_spilled":
                 self.submit_spilled(msg["spec"])
             elif t == "spilled_done":
@@ -740,6 +762,39 @@ class Scheduler:
         if method == "store_stats":
             return self._store.stats()
         raise ValueError(f"unknown rpc method {method!r}")
+
+    def _forward_worker_logs(self, lines: list[str]):
+        """Route this node's worker output toward the driver.
+
+        Lines produced before a delivery target exists (driver not yet
+        attached; head not yet in the cluster view) buffer in a bounded
+        deque and flush ahead of the next delivered batch — worker
+        STARTUP output must not be lost to the attach race.  Only the
+        log-monitor thread touches the buffer.
+        """
+        buf = self._early_logs
+        sink = self.log_sink
+        if sink is not None:  # head node with an attached driver
+            try:
+                if buf:
+                    sink(list(buf))
+                    buf.clear()
+                sink(lines)
+            except Exception:
+                pass
+            return
+        if not self.is_head:
+            head = next((n for n in self._cluster_nodes.values()
+                         if n.is_head and n.alive), None)
+            if head is not None:
+                if buf and self._links.send(
+                        head.node_id,
+                        {"t": "worker_logs", "lines": list(buf)}):
+                    buf.clear()
+                if self._links.send(head.node_id,
+                                    {"t": "worker_logs", "lines": lines}):
+                    return
+        buf.extend(lines)  # no target yet: hold (bounded) for later
 
     # -- object transfer passthrough (see _private/object_transfer.py) ------
     def note_sealed(self, oid: bytes):

@@ -69,6 +69,10 @@ class WorkerPool:
         self.worker_env = worker_env or {}
         self.workers: dict[bytes, WorkerState] = {}
 
+    @property
+    def logs_dir(self) -> str:
+        return os.path.join(os.path.dirname(self.store_socket), "logs")
+
     def spawn_worker(self) -> WorkerState:
         worker_id = os.urandom(8)
         env = dict(os.environ)
@@ -78,15 +82,28 @@ class WorkerPool:
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
         env["RAY_TPU_WORKER_ID"] = worker_id.hex()
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_main",
-             "--scheduler-socket", self.scheduler_addr,
-             "--store-socket", self.store_socket,
-             "--shm-name", self.shm_name,
-             "--store-capacity", str(self.store_capacity),
-             "--worker-id", worker_id.hex()],
-            env=env,
-        )
+        # Worker stdout/stderr go to per-worker session log files tailed to
+        # the driver by the log monitor (reference: worker .out/.err files
+        # under /tmp/ray/session_*/logs + log_monitor.py).  Unbuffered so
+        # print() lines reach the driver promptly, not at flush time.
+        env["PYTHONUNBUFFERED"] = "1"
+        os.makedirs(self.logs_dir, exist_ok=True)
+        tag = f"worker-{worker_id.hex()[:8]}"
+        out = open(os.path.join(self.logs_dir, tag + ".out"), "ab")
+        err = open(os.path.join(self.logs_dir, tag + ".err"), "ab")
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker_main",
+                 "--scheduler-socket", self.scheduler_addr,
+                 "--store-socket", self.store_socket,
+                 "--shm-name", self.shm_name,
+                 "--store-capacity", str(self.store_capacity),
+                 "--worker-id", worker_id.hex()],
+                env=env, stdout=out, stderr=err,
+            )
+        finally:
+            out.close()  # the child holds its own descriptors now
+            err.close()
         w = WorkerState(worker_id=worker_id, proc=proc)
         self.workers[worker_id] = w
         return w

@@ -130,6 +130,17 @@ def _attach_driver(node: Node):
         seal_notify_fn=scheduler.note_sealed,
     )
     ctx.init_direct(driver_rpc)
+    # Worker print()/stderr lines from every node surface on the driver's
+    # stdout, prefixed with the producing worker (reference: log monitor ->
+    # GCS pubsub -> driver).  RTPU_LOG_TO_DRIVER=0 disables.
+    if os.environ.get("RTPU_LOG_TO_DRIVER", "1") != "0":
+        import sys as _sys
+
+        def _print_worker_lines(lines):
+            for line in lines:
+                print(line, file=_sys.stdout, flush=True)
+
+        scheduler.log_sink = _print_worker_lines
     worker_mod.set_global_worker(ctx)
     return ctx
 

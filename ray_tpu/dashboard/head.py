@@ -20,6 +20,7 @@ from ray_tpu._private import protocol
 _INDEX_HTML = """<!doctype html><title>ray_tpu dashboard</title>
 <h1>ray_tpu dashboard</h1>
 <ul>
+<li><a href="/status">/status (live cluster page)</a></li>
 <li><a href="/api/nodes">/api/nodes</a></li>
 <li><a href="/api/actors">/api/actors</a></li>
 <li><a href="/api/placement_groups">/api/placement_groups</a></li>
@@ -28,6 +29,32 @@ _INDEX_HTML = """<!doctype html><title>ray_tpu dashboard</title>
 <li><a href="/api/cluster_status">/api/cluster_status</a></li>
 <li><a href="/metrics">/metrics (Prometheus)</a></li>
 </ul>"""
+
+_STATUS_CSS = """<style>
+body{font-family:system-ui,sans-serif;margin:2em;color:#222}
+table{border-collapse:collapse;margin:0 0 1.5em}
+th,td{border:1px solid #ccc;padding:4px 10px;text-align:left;font-size:14px}
+th{background:#f0f0f0}
+h2{margin-bottom:.3em}
+.dead{color:#b00}.alive{color:#080}
+</style>"""
+
+
+class _Raw(str):
+    """A cell whose HTML is intentional (everything else gets escaped)."""
+
+
+def _table(headers: list, rows: list) -> str:
+    import html as _html
+
+    def cell(c):
+        return c if isinstance(c, _Raw) else _html.escape(str(c))
+
+    head = "".join(f"<th>{_html.escape(str(h))}</th>" for h in headers)
+    body = "".join(
+        "<tr>" + "".join(f"<td>{cell(c)}</td>" for c in row) + "</tr>"
+        for row in rows)
+    return f"<table><tr>{head}</tr>{body}</table>"
 
 
 def _node_rpc(sock: str, method: str, params: Optional[dict] = None):
@@ -172,6 +199,71 @@ class DashboardHead:
     def _cluster_status(self):
         return _node_rpc(self._head_sock, "cluster_state")
 
+    def _status_html(self) -> str:
+        """One server-rendered, self-refreshing cluster status page
+        (reference: the dashboard SPA's cluster view, rendered without the
+        40k-LoC React client)."""
+        nodes = self._nodes()
+        totals: dict = {}
+        avail: dict = {}
+        for n in nodes:
+            if not n["alive"]:
+                continue
+            for k, v in n["resources"].items():
+                totals[k] = totals.get(k, 0) + v
+            for k, v in n["available"].items():
+                avail[k] = avail.get(k, 0) + v
+        res_rows = [(k, f"{avail.get(k, 0):g}", f"{v:g}")
+                    for k, v in sorted(totals.items())]
+        node_rows = [(
+            n["node_id"][:12],
+            "head" if n["is_head"] else "worker",
+            _Raw(f'<span class="{"alive" if n["alive"] else "dead"}">'
+                 f'{"ALIVE" if n["alive"] else "DEAD"}</span>'),
+            " ".join(f"{k}:{n['available'].get(k, 0):g}/{v:g}"
+                     for k, v in sorted(n["resources"].items())),
+        ) for n in nodes]
+        actors = self._actors()
+        actor_rows = [(a["actor_id"][:12], a["name"] or "",
+                       a["class_name"], a["state"],
+                       (a["node_id"] or "")[:12], a["num_restarts"])
+                      for a in actors]
+        by_state: dict = {}
+        for a in actors:
+            by_state[a["state"]] = by_state.get(a["state"], 0) + 1
+        task_rows = [(name, " ".join(f"{k}={v}"
+                                     for k, v in sorted(states.items())))
+                     for name, states in
+                     sorted(self._task_summary().items())]
+        jobs = self._jobs()
+        job_rows = [(j.get("submission_id", ""), j.get("status", ""),
+                     j.get("entrypoint", "")[:80]) for j in jobs]
+        parts = [
+            "<!doctype html><title>ray_tpu status</title>",
+            '<meta http-equiv="refresh" content="5">', _STATUS_CSS,
+            "<h1>ray_tpu cluster</h1>",
+            f"<p>{sum(n['alive'] for n in nodes)}/{len(nodes)} nodes "
+            f"alive &middot; {len(actors)} actors ("
+            + " ".join(f"{k}={v}"
+                       for k, v in sorted(by_state.items()))
+            + ") &middot; auto-refreshes every 5s</p>",  # states are
+            # framework enums; every user-controlled string renders via
+            # _table, which escapes
+            "<h2>Resources</h2>",
+            _table(["resource", "available", "total"], res_rows),
+            "<h2>Nodes</h2>",
+            _table(["node", "role", "state", "resources"], node_rows),
+            "<h2>Actors</h2>",
+            _table(["actor", "name", "class", "state", "node",
+                    "restarts"], actor_rows[:200]),
+            "<h2>Tasks</h2>",
+            _table(["task", "states"], task_rows[:200]),
+        ]
+        if job_rows:
+            parts += ["<h2>Jobs</h2>",
+                      _table(["job", "status", "entrypoint"], job_rows)]
+        return "".join(parts)
+
     def _metrics_text(self):
         snaps = []
         for sock in self._sched_socks():
@@ -204,8 +296,13 @@ class DashboardHead:
             text = await loop.run_in_executor(None, self._metrics_text)
             return web.Response(text=text, content_type="text/plain")
 
+        async def status_page(request):
+            text = await loop.run_in_executor(None, self._status_html)
+            return web.Response(text=text, content_type="text/html")
+
         app = web.Application()
         app.router.add_get("/", index)
+        app.router.add_get("/status", status_page)
         app.router.add_get("/api/nodes", json_handler(self._nodes))
         app.router.add_get("/api/actors", json_handler(self._actors))
         app.router.add_get("/api/placement_groups", json_handler(self._pgs))

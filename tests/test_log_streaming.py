@@ -1,0 +1,93 @@
+"""Worker log streaming to the driver + dashboard status page
+(reference: _private/log_monitor.py; dashboard cluster view)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def fresh_cluster():
+    import ray_tpu.api as api
+    from ray_tpu._private import worker as worker_mod
+
+    prev_ctx = worker_mod._global_worker
+    prev_node = api._global_node
+    worker_mod.set_global_worker(None)
+    api._global_node = None
+    node = ray_tpu.init(num_cpus=4, min_workers=1,
+                        object_store_memory=1 << 27)
+    try:
+        yield node
+    finally:
+        api._global_node = None
+        worker_mod.set_global_worker(None)
+        node.shutdown()
+        worker_mod.set_global_worker(prev_ctx)
+        api._global_node = prev_node
+
+
+def test_worker_prints_reach_driver(fresh_cluster, capsys):
+    node = fresh_cluster
+    sink_lines = []
+    node.scheduler.log_sink = sink_lines.extend  # observable sink
+
+    @ray_tpu.remote
+    def shout(tag):
+        print(f"hello-from-task-{tag}")
+        import sys
+
+        print(f"warn-{tag}", file=sys.stderr)
+        return tag
+
+    assert ray_tpu.get([shout.remote(i) for i in range(3)]) == [0, 1, 2]
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        joined = "\n".join(sink_lines)
+        if (all(f"hello-from-task-{i}" in joined for i in range(3))
+                and "warn-0" in joined):
+            break
+        time.sleep(0.2)
+    joined = "\n".join(sink_lines)
+    assert "hello-from-task-0" in joined, sink_lines[-10:]
+    # prefixed with the producing worker, stderr marked
+    assert any(line.startswith("(worker-") and "hello-from-task-0" in line
+               for line in sink_lines)
+    assert any("stderr) warn-" in line for line in sink_lines)
+
+
+def test_actor_prints_stream_too(fresh_cluster):
+    node = fresh_cluster
+    sink_lines = []
+    node.scheduler.log_sink = sink_lines.extend
+
+    @ray_tpu.remote
+    class Chatty:
+        def speak(self, n):
+            print(f"actor-says-{n}")
+            return n
+
+    c = Chatty.remote()
+    assert ray_tpu.get(c.speak.remote(7)) == 7
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if any("actor-says-7" in line for line in sink_lines):
+            break
+        time.sleep(0.2)
+    assert any("actor-says-7" in line for line in sink_lines)
+    ray_tpu.kill(c)
+
+
+def test_dashboard_status_page(ray_cluster):
+    import requests
+
+    node = ray_cluster
+    if node.dashboard_url is None:
+        pytest.skip("dashboard not running")
+    r = requests.get(node.dashboard_url + "/status", timeout=30)
+    assert r.status_code == 200
+    assert "ray_tpu cluster" in r.text
+    assert "Resources" in r.text and "Nodes" in r.text
+    assert "CPU" in r.text
