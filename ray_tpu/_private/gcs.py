@@ -75,7 +75,22 @@ class NodeInfo:
 
 
 class Gcs:
-    def __init__(self):
+    """In-memory control-plane tables, optionally persisted to disk.
+
+    ``persist_path`` enables durability (reference: the Redis-backed
+    store client, src/ray/gcs/store_client/redis_store_client.h:111, used
+    for GCS fault tolerance): mutations snapshot the durable tables —
+    actors, named actors, placement groups, KV — to the file (debounced,
+    atomic rename), and a restarted head restores them, so registered
+    actors/PGs/function blobs survive a head-process restart.  Node and
+    object-location tables are deliberately NOT persisted: they describe
+    live processes and re-populate from heartbeats/seals, exactly like
+    the reference's reconnect-on-GCS-restart flow.
+    """
+
+    PERSIST_DEBOUNCE_S = 0.2
+
+    def __init__(self, persist_path: Optional[str] = None):
         self._lock = threading.RLock()
         self.actors: dict[bytes, ActorInfo] = {}
         self.named_actors: dict[str, bytes] = {}
@@ -91,6 +106,78 @@ class Gcs:
         self.lost_objects: set[bytes] = set()
         # pg_id -> {bundles, strategy, assignment: [node_id per bundle]}
         self.placement_groups: dict[bytes, dict] = {}
+        self._persist_path = persist_path
+        self._persist_timer: Optional[threading.Timer] = None
+        if persist_path and os.path.exists(persist_path):
+            self._restore()
+
+    # -- persistence --------------------------------------------------------
+    def _mutated(self):
+        """Schedule a debounced snapshot (no-op without persist_path)."""
+        if not self._persist_path:
+            return
+        with self._lock:
+            if self._persist_timer is not None:
+                return  # one pending snapshot covers this burst
+            self._persist_timer = threading.Timer(
+                self.PERSIST_DEBOUNCE_S, self._snapshot)
+            self._persist_timer.daemon = True
+            self._persist_timer.start()
+
+    def _snapshot(self):
+        import pickle
+
+        with self._lock:
+            self._persist_timer = None
+            state = {
+                "actors": dict(self.actors),
+                "named_actors": dict(self.named_actors),
+                "kv": dict(self.kv),
+                "placement_groups": {
+                    k: dict(v) for k, v in self.placement_groups.items()},
+            }
+        tmp = self._persist_path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump(state, f)
+            os.replace(tmp, self._persist_path)  # atomic swap
+        except OSError:
+            pass  # durability is best-effort; next mutation retries
+
+    def _restore(self):
+        import pickle
+
+        try:
+            with open(self._persist_path, "rb") as f:
+                state = pickle.load(f)
+        except Exception:
+            return  # torn/corrupt snapshot: start empty
+        self.actors = state.get("actors", {})
+        self.named_actors = state.get("named_actors", {})
+        self.kv = state.get("kv", {})
+        self.placement_groups = state.get("placement_groups", {})
+        # Every restored actor lived on a node that predates this head
+        # incarnation: mark restartable ones RESTARTING so the scheduler
+        # recreates them, DEAD otherwise (reference:
+        # gcs_actor_manager restart-on-GCS-recovery semantics).
+        for info in self.actors.values():
+            if info.state == DEAD:
+                continue
+            if info.max_restarts == -1 or info.num_restarts < \
+                    info.max_restarts:
+                info.state = RESTARTING
+                info.num_restarts += 1
+                info.worker_id = None
+                info.node_id = None
+                info.addr = None
+            else:
+                info.state = DEAD
+                info.death_cause = "GCS restarted; actor not restartable"
+                if info.name:  # free the name, like every DEAD transition
+                    self.named_actors.pop(info.name, None)
+        # the restore itself consumed restart budget / marked deaths: those
+        # transitions must survive ANOTHER head crash
+        self._mutated()
 
     # -- actors ------------------------------------------------------------
     def register_actor(self, info: ActorInfo):
@@ -100,6 +187,7 @@ class Gcs:
                     raise ValueError(f"actor name {info.name!r} already taken")
                 self.named_actors[info.name] = info.actor_id
             self.actors[info.actor_id] = info
+        self._mutated()
 
     def update_actor(self, actor_id: bytes, **fields):
         with self._lock:
@@ -110,6 +198,7 @@ class Gcs:
                 setattr(info, k, v)
             if info.state == DEAD and info.name:
                 self.named_actors.pop(info.name, None)
+        self._mutated()
 
     def get_actor(self, actor_id: bytes) -> Optional[ActorInfo]:
         with self._lock:
@@ -219,6 +308,7 @@ class Gcs:
             self.placement_groups[pg_id] = {
                 "bundles": bundles, "strategy": strategy,
                 "assignment": assignment}
+        self._mutated()
 
     def get_pg(self, pg_id: bytes) -> Optional[dict]:
         with self._lock:
@@ -228,6 +318,7 @@ class Gcs:
     def remove_pg(self, pg_id: bytes):
         with self._lock:
             self.placement_groups.pop(pg_id, None)
+        self._mutated()
 
     def list_pgs(self) -> dict:
         with self._lock:
@@ -238,6 +329,7 @@ class Gcs:
     def kv_put(self, namespace: str, key: bytes, value: bytes):
         with self._lock:
             self.kv[(namespace, key)] = value
+        self._mutated()
 
     def kv_get(self, namespace: str, key: bytes) -> Optional[bytes]:
         with self._lock:
@@ -246,6 +338,7 @@ class Gcs:
     def kv_del(self, namespace: str, key: bytes):
         with self._lock:
             self.kv.pop((namespace, key), None)
+        self._mutated()
 
     def kv_keys(self, namespace: str) -> list[bytes]:
         with self._lock:

@@ -73,6 +73,7 @@ class Node:
         node_id: Optional[bytes] = None,
         merge_default_resources: bool = True,
         listen_host: Optional[str] = None,
+        gcs_persist_path: Optional[str] = None,
     ):
         """listen_host: bind the node's control-plane services (GCS on the
         head, scheduler everywhere) to TCP on this interface instead of
@@ -137,7 +138,12 @@ class Node:
         else:
             sched_socket = os.path.join(self.session_dir, "sched.sock")
         if head:
-            self.gcs = Gcs()
+            # Durable control plane (reference: Redis-backed GCS fault
+            # tolerance): point RTPU_GCS_PERSIST (or gcs_persist_path) at
+            # a stable file and a restarted head restores actors/PGs/KV.
+            persist = (gcs_persist_path
+                       or os.environ.get("RTPU_GCS_PERSIST") or None)
+            self.gcs = Gcs(persist_path=persist)
             gcs_bind = (f"{self.listen_host}:0" if self.listen_host
                         else os.path.join(self.session_dir, "gcs.sock"))
             self.gcs_server = GcsServer(self.gcs, gcs_bind)
@@ -175,6 +181,9 @@ class Node:
 
             self.scheduler.job_manager = JobManager(
                 self.gcs, self.gcs_address, self.session_dir)
+            # Persisted-GCS recovery: re-create actors restored as
+            # RESTARTING (no-op on a fresh control plane).
+            self.scheduler.recover_restored_actors()
         self.dashboard = None
         self.dashboard_url = None
         if head and include_dashboard and not os.environ.get(

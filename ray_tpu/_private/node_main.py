@@ -39,6 +39,9 @@ def main():
     p.add_argument("--object-store-memory", type=int, default=None)
     p.add_argument("--node-id", default=None, help="hex node id")
     p.add_argument("--session-dir", default=None)
+    p.add_argument("--gcs-persist-path", default=None,
+                   help="head only: persist GCS tables here; a restarted "
+                        "head restores actors/PGs/KV from it")
     p.add_argument("--ready-file", default=None,
                    help="write {gcs_address, sched_address, node_id} JSON "
                         "here once the node is serving")
@@ -70,6 +73,7 @@ def main():
         node_id=bytes.fromhex(args.node_id) if args.node_id else None,
         session_dir=args.session_dir,
         listen_host=args.listen_host,
+        gcs_persist_path=args.gcs_persist_path,
         include_dashboard=False,
         merge_default_resources=not args.exact_resources,
     )

@@ -220,6 +220,20 @@ class Scheduler:
 
                 self.gcs.kv_put("actor_creation", spec.actor_id,
                                 pickle.dumps(spec))
+                # The class blob lives in the (volatile) object store;
+                # mirror it into the KV so a persisted-GCS head restart
+                # can re-create the actor (workers fall back to this copy
+                # when the store misses — _load_function).
+                try:
+                    view = self._store.get(spec.fn_id, 0)
+                    if view is not None:
+                        try:
+                            self.gcs.kv_put("fn_blob", spec.fn_id,
+                                            bytes(view))
+                        finally:
+                            self._store.release(spec.fn_id)
+                except Exception:
+                    pass
             spec.retries_left = spec.max_retries
             self._pending.append(spec)
             self._task_index[spec.task_id] = spec
@@ -326,6 +340,7 @@ class Scheduler:
                     return
                 self.gcs.update_actor(actor_id, state=gcs_mod.DEAD,
                                       death_cause="killed before placement")
+                self._cleanup_actor_kv(actor_id)
                 # Drop queued creation/method tasks for it.
                 for spec in [s for s in self._pending if s.actor_id == actor_id]:
                     self._pending.remove(spec)
@@ -562,13 +577,16 @@ class Scheduler:
                 # the location so other nodes can pull it
                 self.note_sealed(msg["oid"])
             elif t == "worker_logs":
-                # a worker node's monitor forwarding its workers' output
+                # a worker node's monitor forwarding its workers' output;
+                # pre-attach lines buffer just like head-local ones
                 sink = self.log_sink
                 if sink is not None:
                     try:
                         sink(msg["lines"])
                     except Exception:
                         pass
+                else:
+                    self._early_logs.extend(msg["lines"])
             elif t == "submit_spilled":
                 self.submit_spilled(msg["spec"])
             elif t == "spilled_done":
@@ -784,7 +802,9 @@ class Scheduler:
                 pass
             return
         if not self.is_head:
-            head = next((n for n in self._cluster_nodes.values()
+            # list() snapshot: this runs on the monitor thread while the
+            # heartbeat thread inserts into the view
+            head = next((n for n in list(self._cluster_nodes.values())
                          if n.is_head and n.alive), None)
             if head is not None:
                 if buf and self._links.send(
@@ -968,6 +988,7 @@ class Scheduler:
                 self.gcs.update_actor(
                     info.actor_id, state=gcs_mod.DEAD,
                     death_cause=f"node {node_id.hex()[:8]} died")
+                self._cleanup_actor_kv(info.actor_id)
 
     # ------------------------------------------------------------------
     # Worker lifecycle events
@@ -1039,6 +1060,7 @@ class Scheduler:
                 else:
                     self.gcs.update_actor(spec.actor_id, state=gcs_mod.DEAD,
                                           death_cause=msg.get("error"))
+                    self._cleanup_actor_kv(spec.actor_id)
                     self._release_worker_grants(worker)
                     worker.actor_id = None
                     self._actor_workers.pop(spec.actor_id, None)
@@ -1097,6 +1119,7 @@ class Scheduler:
                 else:
                     self.gcs.update_actor(dead_actor, state=gcs_mod.DEAD,
                                           death_cause="worker died")
+                    self._cleanup_actor_kv(dead_actor)
                     for spec in [s for s in self._pending
                                  if s.actor_id == dead_actor]:
                         self._pending.remove(spec)
@@ -1120,6 +1143,46 @@ class Scheduler:
                                f"worker died executing {spec.name}"))
                     self._fail_task(spec, err)
             self._wake.notify_all()
+
+    def _cleanup_actor_kv(self, actor_id: bytes):
+        """An actor is PERMANENTLY dead: drop its creation spec and, when
+        no other registered actor shares its class blob, the blob mirror —
+        otherwise every actor ever created pins its pickled class in the
+        head (and in persisted snapshots) forever."""
+        import pickle
+
+        try:
+            blob = self.gcs.kv_get("actor_creation", actor_id)
+            self.gcs.kv_del("actor_creation", actor_id)
+            if blob is None:
+                return
+            fn_id = pickle.loads(blob).fn_id
+            for other in self.gcs.kv_keys("actor_creation"):
+                other_blob = self.gcs.kv_get("actor_creation", other)
+                if other_blob is not None and \
+                        pickle.loads(other_blob).fn_id == fn_id:
+                    return  # class blob still referenced
+            self.gcs.kv_del("fn_blob", fn_id)
+        except Exception:
+            pass  # cleanup is best-effort
+
+    def recover_restored_actors(self):
+        """After a head restart with a persisted GCS: resubmit creation for
+        every actor the restore marked RESTARTING (their creation specs
+        live in the persisted KV).  Called exactly once by the head node's
+        bootstrap — reference: gcs_actor_manager.cc restart-on-recovery."""
+        if not self.is_head:
+            return
+        try:
+            actors = self.gcs.list_actors()
+        except Exception:
+            return
+        for info in actors:
+            if info.state != gcs_mod.RESTARTING or info.node_id is not None:
+                continue
+            creation = self._creation_spec_for(info.actor_id)
+            if creation is not None:
+                self.submit_spilled(creation)
 
     def _creation_spec_for(self, actor_id: bytes) -> Optional[TaskSpec]:
         """Rebuild the creation TaskSpec for restart from GCS KV."""

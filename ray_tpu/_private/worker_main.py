@@ -136,13 +136,28 @@ class WorkerRuntime:
                 # blob may live on the submitting node (spilled task):
                 # pull it into the local store, then wait
                 self.ctx.request_pull(fn_id)
-                view = self.store.get(fn_id, 60_000)
-            if view is None:
-                raise RuntimeError(f"function blob {fn_id.hex()[:12]} not found")
-            try:
-                fn = cloudpickle.loads(bytes(view))
-            finally:
-                self.store.release(fn_id)
+                view = self.store.get(fn_id, 10_000)
+            if view is not None:
+                try:
+                    blob = bytes(view)
+                finally:
+                    self.store.release(fn_id)
+            else:
+                # the persisted-GCS mirror (actor classes survive head
+                # restarts there — see scheduler.submit)
+                blob = self.ctx.rpc("kv_get", {"namespace": "fn_blob",
+                                               "key": fn_id})
+                if blob is None:
+                    # slow cross-node pull of a task blob: keep waiting
+                    view = self.store.get(fn_id, 50_000)
+                    if view is None:
+                        raise RuntimeError(
+                            f"function blob {fn_id.hex()[:12]} not found")
+                    try:
+                        blob = bytes(view)
+                    finally:
+                        self.store.release(fn_id)
+            fn = cloudpickle.loads(blob)
             self.fn_cache[fn_id] = fn
         return fn
 
