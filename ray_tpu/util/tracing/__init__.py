@@ -82,13 +82,39 @@ def export_chrome_trace(path: str, include_task_events: bool = True) -> int:
     return len(events)
 
 
-def setup_otel_exporter(endpoint: Optional[str] = None):
-    """OpenTelemetry bridge (import-gated like the reference's exporters)."""
+def export_otel_spans(tracer=None):
+    """Replay collected spans into an OpenTelemetry tracer (import-gated
+    like the reference's exporters, tracing_helper.py): each recorded span
+    becomes an OTel span with its original timestamps and attributes.
+    Returns the number of spans exported.  Without the opentelemetry
+    package use export_chrome_trace() for local inspection."""
     try:
-        import opentelemetry  # noqa: F401
+        from opentelemetry import trace as otel_trace
     except ImportError as e:
         raise ImportError(
             "opentelemetry is not in the TPU image; use "
             "export_chrome_trace() for local trace inspection") from e
-    raise NotImplementedError(
-        "wire collected_spans() into your OTel pipeline here")
+    if tracer is None:
+        provider = otel_trace.get_tracer_provider()
+        if type(provider).__name__ in ("NoOpTracerProvider",
+                                       "ProxyTracerProvider"):
+            # no SDK configured: spans would be NonRecording and silently
+            # vanish — misreporting them as exported helps nobody
+            raise RuntimeError(
+                "no OpenTelemetry TracerProvider is configured; call "
+                "opentelemetry.trace.set_tracer_provider(...) first or "
+                "pass an explicit tracer")
+        tracer = otel_trace.get_tracer("ray_tpu")
+    spans = collected_spans()
+    for s in spans:
+        start_ns = int(s["ts"] * 1e3)  # recorded in microseconds
+        end_ns = int((s["ts"] + s["dur"]) * 1e3)
+        span = tracer.start_span(s["name"], start_time=start_ns)
+        for k, v in (s.get("args") or {}).items():
+            # OTel silently drops non-primitive values (set_attribute
+            # never raises): sanitize up front so nothing vanishes
+            span.set_attribute(
+                str(k), v if isinstance(v, (bool, str, int, float))
+                else repr(v))
+        span.end(end_time=end_ns)
+    return len(spans)
