@@ -76,3 +76,57 @@ def test_optuna_gated():
     else:
         with pytest.raises(ImportError, match="TPESearcher"):
             OptunaSearch(metric="m")
+
+
+def test_custom_searcher_plugin_contract(ray_cluster, tmp_path):
+    """The Searcher plugin API contract (reference: tune/search/searcher.py):
+    a user-supplied subclass drives trial generation through Tuner —
+    suggest() is called with unique trial ids until it returns None, and
+    on_trial_complete() receives every trial's final result."""
+    from ray_tpu import train, tune
+    from ray_tpu.tune.search import Searcher
+
+    class DescendingSearcher(Searcher):
+        """Deterministic custom searcher: x = 5, 4, 3 then exhausted."""
+
+        def __init__(self):
+            self.suggested = []
+            self.completed = {}
+            self._next = 5
+
+        def suggest(self, trial_id):
+            if self._next < 3:
+                return None  # exhausted: Tuner must stop asking
+            self.suggested.append(trial_id)
+            cfg = {"x": self._next}
+            self._next -= 1
+            return cfg
+
+        def on_trial_complete(self, trial_id, result, error=False):
+            self.completed[trial_id] = (result, error)
+
+    searcher = DescendingSearcher()
+
+    def objective(config):
+        tune.report({"score": config["x"] * 10})
+
+    results = tune.Tuner(
+        objective,
+        # num_samples larger than the searcher's supply: the run must end
+        # when suggest() returns None, not hang waiting for 10 trials
+        tune_config=tune.TuneConfig(search_alg=searcher, metric="score",
+                                    mode="max", num_samples=10),
+        run_config=train.RunConfig(name="t_plugin",
+                                   storage_path=str(tmp_path)),
+    ).fit()
+    # exactly the three suggested configs ran
+    assert len(results) == 3
+    scores = sorted(r.metrics["score"] for r in results)
+    assert scores == [30, 40, 50]
+    # contract: unique trial ids; every suggested trial completed non-error
+    assert len(set(searcher.suggested)) == 3
+    assert set(searcher.completed) == set(searcher.suggested)
+    assert all(not err and res["score"] in (30, 40, 50)
+               for res, err in searcher.completed.values())
+    best = results.get_best_result()
+    assert best.metrics["score"] == 50
