@@ -22,6 +22,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ray_tpu._private import wire
 from ray_tpu._private.protocol import (
     Connection,
     authenticate_server_side,
@@ -42,6 +43,7 @@ DEAD = "DEAD"
 NODE_DEATH_TIMEOUT_S = float(os.environ.get("RTPU_NODE_DEATH_TIMEOUT_S", 5.0))
 
 
+@wire.register_struct(1)
 @dataclass
 class ActorInfo:
     actor_id: bytes
@@ -59,6 +61,7 @@ class ActorInfo:
     addr: Optional[str] = None
 
 
+@wire.register_struct(2)
 @dataclass
 class NodeInfo:
     node_id: bytes
@@ -125,8 +128,6 @@ class Gcs:
             self._persist_timer.start()
 
     def _snapshot(self):
-        import pickle
-
         with self._lock:
             self._persist_timer = None
             state = {
@@ -138,20 +139,37 @@ class Gcs:
             }
         tmp = self._persist_path + ".tmp"
         try:
+            # Wire-codec snapshot (not pickle): the same file format the
+            # native GCS daemon reads/writes, so head restarts can move
+            # between the Python and C++ control planes.
             with open(tmp, "wb") as f:
-                pickle.dump(state, f)
+                f.write(wire.encode(state))
             os.replace(tmp, self._persist_path)  # atomic swap
-        except OSError:
+        except (OSError, wire.WireError):
             pass  # durability is best-effort; next mutation retries
 
     def _restore(self):
-        import pickle
-
         try:
             with open(self._persist_path, "rb") as f:
-                state = pickle.load(f)
+                raw = f.read()
+        except OSError:
+            return
+        try:
+            state = wire.decode(raw)
+        except wire.WireError:
+            # Pre-wire-codec snapshot (pickle).  The file is local state
+            # this head wrote itself — trusted — so a one-time migration
+            # load is safe; the next snapshot rewrites it in wire format.
+            import pickle
+
+            try:
+                state = pickle.loads(raw)
+            except Exception:
+                return  # torn/corrupt snapshot: start empty
         except Exception:
-            return  # torn/corrupt snapshot: start empty
+            return
+        if not isinstance(state, dict):
+            return
         self.actors = state.get("actors", {})
         self.named_actors = state.get("named_actors", {})
         self.kv = state.get("kv", {})
@@ -383,23 +401,37 @@ class GcsServer:
                              daemon=True).start()
 
     def _serve(self, conn: Connection):
-        # TCP peers must pass the cluster-token handshake before any frame
-        # of theirs is unpickled (see protocol.py).
+        # TCP peers must pass the cluster-token handshake first; then every
+        # peer (TCP or unix) must speak the wire-codec version.  Nothing a
+        # peer sends is ever unpickled on this path.
         if not authenticate_server_side(conn, self._is_tcp):
             return
+        if conn.recv_bytes() != wire.HELLO:
+            conn.close()
+            return
+        try:
+            conn.send_bytes(wire.HELLO_OK)
+        except OSError:
+            return
         while True:
-            msg = conn.recv()
-            if msg is None:
-                return
-            method = msg.get("m")
             try:
+                data = conn.recv_frame()
+            except (OSError, ConnectionError, ValueError):
+                return  # ValueError = oversize frame: hang up on flooders
+            if data is None:
+                return
+            try:
+                method, args, kwargs = wire.decode_request(data)
                 if method not in _GCS_METHODS:
                     raise ValueError(f"unknown GCS method {method!r}")
-                result = getattr(self.gcs, method)(
-                    *msg.get("a", ()), **msg.get("k", {}))
-                conn.send({"ok": True, "r": result})
+                result = getattr(self.gcs, method)(*args, **kwargs)
+                resp = wire.encode_response(True, result)
             except Exception as e:  # noqa: BLE001 — serialize to caller
-                conn.send({"ok": False, "e": e})
+                resp = wire.encode_response(False, e)
+            try:
+                conn.send_frame(resp)
+            except (OSError, ConnectionError):
+                return
 
     def shutdown(self):
         self._shutdown = True
@@ -422,26 +454,42 @@ class GcsClient:
 
     def __init__(self, socket_path: str):
         self._socket_path = socket_path
-        self._conn = connect_addr(socket_path)
+        self._conn = self._connect()
         self._lock = threading.Lock()
 
+    def _connect(self) -> Connection:
+        conn = connect_addr(self._socket_path)
+        try:
+            conn.send_bytes(wire.HELLO)
+            if conn.recv_bytes() != wire.HELLO_OK:
+                conn.close()
+                raise ConnectionError(
+                    "GCS wire-protocol version mismatch (node and head run "
+                    "different ray_tpu versions)")
+        except OSError:
+            conn.close()
+            raise
+        return conn
+
     def _call(self, method: str, *args, **kwargs):
+        req = wire.encode_request(method, args, kwargs)
         with self._lock:
             try:
-                self._conn.send({"m": method, "a": args, "k": kwargs})
-                resp = self._conn.recv()
+                self._conn.send_frame(req)
+                data = self._conn.recv_frame()
             except OSError:
-                resp = None
-            if resp is None:
+                data = None
+            if data is None:
                 # one reconnect attempt (head may have restarted the server)
-                self._conn = connect_addr(self._socket_path)
-                self._conn.send({"m": method, "a": args, "k": kwargs})
-                resp = self._conn.recv()
-                if resp is None:
+                self._conn = self._connect()
+                self._conn.send_frame(req)
+                data = self._conn.recv_frame()
+                if data is None:
                     raise ConnectionError("GCS connection lost")
-        if not resp["ok"]:
-            raise resp["e"]
-        return resp["r"]
+        ok, payload = wire.decode_response(data)
+        if not ok:
+            raise payload
+        return payload
 
 
 def _make_proxy(name):

@@ -76,6 +76,30 @@ class Connection:
         with self._send_lock:
             self.sock.sendall(frame)
 
+    def send_frame(self, data: bytes):
+        """Send one raw frame WITH chaos injection — wire-codec RPCs."""
+        if _CHAOS_SEND and _chaos_rng.random() < _CHAOS_SEND:
+            raise ConnectionResetError("rpc chaos: injected send failure")
+        self.send_bytes(data)
+
+    def recv_frame(self, max_len: int = 1 << 28) -> bytes | None:
+        """Receive one raw frame WITH chaos injection; None on EOF.
+
+        The wire-codec counterpart of recv(): nothing is unpickled — the
+        caller decodes with wire.decode, which cannot execute code.
+        Oversize frames raise ValueError (NOT None): None means the peer
+        hung up and retrying is safe, which is false for oversize."""
+        if _CHAOS_RECV and _chaos_rng.random() < _CHAOS_RECV:
+            raise ConnectionResetError("rpc chaos: injected recv failure")
+        header = self._recv_exact(_LEN.size)
+        if header is None:
+            return None
+        (length,) = _LEN.unpack(header)
+        if length > max_len:
+            raise ValueError(
+                f"frame of {length} bytes exceeds the {max_len}-byte cap")
+        return self._recv_exact(length)
+
     def recv_bytes(self, max_len: int = 1 << 16) -> bytes | None:
         """Receive one raw frame WITHOUT unpickling; None on EOF/oversize.
 

@@ -1,0 +1,868 @@
+// Native GCS server daemon.
+//
+// Counterpart of the reference's C++ GCS (/root/reference/src/ray/gcs/
+// gcs_server/gcs_server.cc): the cluster control plane — actor registry with
+// lifecycle FSM + named-actor index, node table with liveness, per-node load
+// view, internal KV, placement-group table, object location directory, and
+// (net new vs the round-2 Python GCS) a pubsub event log with long-poll
+// subscriptions (reference: src/ray/pubsub/publisher.h:300 +
+// gcs_server/pubsub_handler.cc) so clients subscribe to actor/node/object/KV
+// changes instead of sleep-polling.
+//
+// Speaks the frame protocol of _private/protocol.py (u32-LE length prefix)
+// with wire-codec bodies (_private/wire.py / native/wire.h) — the Python
+// GcsClient works unchanged against this daemon or the Python GcsServer.
+//
+// Design: one thread, one epoll loop (the reference pins GCS handlers to a
+// single asio io_context for the same reason — lock-free tables,
+// deterministic ordering).  Long-poll subscribers park their reply inside
+// the loop; publishes and timeouts complete them.  Durable tables (actors,
+// named actors, KV, placement groups) snapshot to --persist with a debounce,
+// same file format as the Python Gcs (wire-encoded state dict), so a head
+// restart can hand the tables between implementations in either direction.
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <deque>
+#include <map>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "wire.h"
+
+using wire::Value;
+
+static double now_s() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return double(ts.tv_sec) + double(ts.tv_nsec) * 1e-9;
+}
+
+static double mono_s() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return double(ts.tv_sec) + double(ts.tv_nsec) * 1e-9;
+}
+
+// ---------------------------------------------------------------------------
+// Tables (mirror of _private/gcs.py Gcs)
+// ---------------------------------------------------------------------------
+
+static const char* kStateDead = "DEAD";
+static const char* kStateRestarting = "RESTARTING";
+
+struct Event {
+  uint64_t seq;
+  std::string channel;
+  Value payload;
+};
+
+struct Waiter {  // a parked sub_poll long-poll
+  int fd;
+  std::vector<std::string> channels;
+  uint64_t cursor;
+  double deadline_mono;  // <=0: no timeout (shouldn't happen; client sends one)
+};
+
+struct Gcs {
+  std::map<std::string, Value> actors;       // actor_id -> STRUCT(1)
+  std::map<std::string, std::string> named;  // name -> actor_id
+  std::map<std::string, Value> nodes;        // node_id -> STRUCT(2)
+  std::map<std::pair<std::string, std::string>, std::string> kv;
+  std::map<std::string, std::set<std::string>> obj_locs;
+  std::set<std::string> lost_objects;
+  std::map<std::string, Value> pgs;  // pg_id -> DICT
+  double death_timeout_s = 5.0;
+
+  // pubsub event log
+  std::deque<Event> events;
+  uint64_t next_seq = 1;
+  static constexpr size_t kRingCap = 16384;
+
+  // persistence
+  std::string persist_path;
+  bool dirty = false;
+  double snapshot_due_mono = 0;  // 0 = none pending
+  static constexpr double kDebounceS = 0.2;
+
+  void publish(const std::string& channel, Value payload) {
+    events.push_back(Event{next_seq++, channel, std::move(payload)});
+    while (events.size() > kRingCap) events.pop_front();
+  }
+
+  void mutated() {
+    if (persist_path.empty()) return;
+    dirty = true;
+    if (snapshot_due_mono == 0) snapshot_due_mono = mono_s() + kDebounceS;
+  }
+
+  void snapshot() {
+    snapshot_due_mono = 0;
+    if (persist_path.empty() || !dirty) return;
+    dirty = false;
+    Value state = Value::Dict();
+    Value va = Value::Dict();
+    for (auto& [id, info] : actors)
+      va.pairs->emplace_back(Value::Bytes(id), info);
+    state.set("actors", va);
+    Value vn = Value::Dict();
+    for (auto& [name, id] : named)
+      vn.pairs->emplace_back(Value::Str(name), Value::Bytes(id));
+    state.set("named_actors", vn);
+    Value vk = Value::Dict();
+    for (auto& [key, val] : kv) {
+      Value t = Value::Tuple();
+      t.push(Value::Str(key.first));
+      t.push(Value::Bytes(key.second));
+      vk.pairs->emplace_back(std::move(t), Value::Bytes(val));
+    }
+    state.set("kv", vk);
+    Value vp = Value::Dict();
+    for (auto& [id, pg] : pgs)
+      vp.pairs->emplace_back(Value::Bytes(id), pg);
+    state.set("placement_groups", vp);
+
+    std::string data = wire::encode(state);
+    std::string tmp = persist_path + ".tmp";
+    FILE* f = fopen(tmp.c_str(), "wb");
+    if (!f) return;  // best effort; next mutation retries
+    bool ok = fwrite(data.data(), 1, data.size(), f) == data.size();
+    ok = fclose(f) == 0 && ok;
+    if (ok)
+      rename(tmp.c_str(), persist_path.c_str());
+    else
+      dirty = true;
+  }
+
+  void restore() {
+    FILE* f = fopen(persist_path.c_str(), "rb");
+    if (!f) return;
+    std::string data;
+    char buf[1 << 16];
+    size_t n;
+    while ((n = fread(buf, 1, sizeof buf, f)) > 0) data.append(buf, n);
+    fclose(f);
+    Value state;
+    try {
+      state = wire::decode(data);
+    } catch (const wire::WireError&) {
+      return;  // torn/corrupt snapshot: start empty
+    }
+    if (state.kind != Value::DICT) return;
+    if (const Value* va = state.get("actors"); va && va->pairs)
+      for (auto& [k, v] : *va->pairs)
+        if (k.kind == Value::BYTES) actors[k.s] = v;
+    if (const Value* vn = state.get("named_actors"); vn && vn->pairs)
+      for (auto& [k, v] : *vn->pairs)
+        if (k.kind == Value::STR && v.kind == Value::BYTES) named[k.s] = v.s;
+    if (const Value* vk = state.get("kv"); vk && vk->pairs)
+      for (auto& [k, v] : *vk->pairs)
+        if (k.kind == Value::TUPLE && k.items && k.items->size() == 2 &&
+            v.kind == Value::BYTES)
+          kv[{(*k.items)[0].s, (*k.items)[1].s}] = v.s;
+    if (const Value* vp = state.get("placement_groups"); vp && vp->pairs)
+      for (auto& [k, v] : *vp->pairs)
+        if (k.kind == Value::BYTES) pgs[k.s] = v;
+
+    // Restored actors lived on nodes that predate this incarnation: mark
+    // restartable ones RESTARTING so the head scheduler recreates them,
+    // DEAD otherwise (reference: gcs_actor_manager restart-on-GCS-recovery).
+    for (auto& [id, info] : actors) {
+      const Value* st = info.get("state");
+      if (st && st->kind == Value::STR && st->s == kStateDead) continue;
+      int64_t max_r = info.get("max_restarts") ? info.get("max_restarts")->as_i() : 0;
+      int64_t num_r = info.get("num_restarts") ? info.get("num_restarts")->as_i() : 0;
+      if (max_r == -1 || num_r < max_r) {
+        info.set("state", Value::Str(kStateRestarting));
+        info.set("num_restarts", Value::Int(num_r + 1));
+        info.set("worker_id", Value::None());
+        info.set("node_id", Value::None());
+        info.set("addr", Value::None());
+      } else {
+        info.set("state", Value::Str(kStateDead));
+        info.set("death_cause",
+                 Value::Str("GCS restarted; actor not restartable"));
+        const Value* nm = info.get("name");
+        if (nm && nm->kind == Value::STR) named.erase(nm->s);
+      }
+    }
+    dirty = true;
+    snapshot();  // restart transitions must survive ANOTHER crash
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Method dispatch
+// ---------------------------------------------------------------------------
+
+static const Value* arg(const wire::Request& req, size_t i,
+                        const char* name = nullptr) {
+  if (req.args.items && i < req.args.items->size())
+    return &(*req.args.items)[i];
+  if (name) return req.kwargs.get(name);
+  return nullptr;
+}
+
+static std::string arg_bytes(const wire::Request& req, size_t i,
+                             const char* name) {
+  const Value* v = arg(req, i, name);
+  if (!v || (v->kind != Value::BYTES && v->kind != Value::STR))
+    throw wire::WireError(std::string("bad argument: ") + name);
+  return v->s;
+}
+
+static Value actor_event(const Value& info) {
+  Value ev = Value::Dict();
+  ev.set("ch", Value::Str("actors"));
+  const Value* id = info.get("actor_id");
+  ev.set("actor_id", id ? *id : Value::None());
+  const Value* st = info.get("state");
+  ev.set("state", st ? *st : Value::None());
+  const Value* ad = info.get("addr");
+  ev.set("addr", ad ? *ad : Value::None());
+  return ev;
+}
+
+// Marks a node dead; returns true on alive->dead transition.  Mirrors
+// gcs.py mark_node_dead including the object-location cleanup + LOST
+// tombstones that let owners trigger lineage re-execution.
+static bool do_mark_node_dead(Gcs& g, const std::string& node_id) {
+  auto it = g.nodes.find(node_id);
+  if (it == g.nodes.end()) return false;
+  Value& info = it->second;
+  const Value* alive = info.get("alive");
+  if (!alive || !alive->truthy()) return false;
+  info.set("alive", Value::Bool(false));
+  for (auto oit = g.obj_locs.begin(); oit != g.obj_locs.end();) {
+    oit->second.erase(node_id);
+    if (oit->second.empty()) {
+      if (g.lost_objects.size() >= 1000000)
+        g.lost_objects.erase(g.lost_objects.begin());
+      g.lost_objects.insert(oit->first);
+      Value ev = Value::Dict();
+      ev.set("ch", Value::Str("objects"));
+      ev.set("oid", Value::Bytes(oit->first));
+      ev.set("lost", Value::Bool(true));
+      g.publish("objects", std::move(ev));
+      oit = g.obj_locs.erase(oit);
+    } else {
+      ++oit;
+    }
+  }
+  Value ev = Value::Dict();
+  ev.set("ch", Value::Str("nodes"));
+  ev.set("node_id", Value::Bytes(node_id));
+  ev.set("alive", Value::Bool(false));
+  g.publish("nodes", std::move(ev));
+  return true;
+}
+
+struct PendingSub {
+  bool parked = false;
+  std::vector<std::string> channels;
+  uint64_t cursor = 0;
+  double deadline_mono = 0;
+};
+
+// Builds the sub_poll reply for a cursor; returns false if nothing to send
+// yet (caller may park).
+static bool sub_reply(Gcs& g, const std::vector<std::string>& channels,
+                      uint64_t cursor, Value* out) {
+  uint64_t oldest = g.events.empty() ? g.next_seq : g.events.front().seq;
+  Value reply = Value::Dict();
+  if (cursor < oldest) {
+    // events the subscriber hasn't seen were evicted from the ring:
+    // signal a gap so it re-reads table state instead of trusting events
+    reply.set("cursor", Value::Int(int64_t(g.next_seq)));
+    reply.set("events", Value::List());
+    reply.set("gap", Value::Bool(true));
+    *out = std::move(reply);
+    return true;
+  }
+  Value evs = Value::List();
+  uint64_t next_cursor = cursor;
+  for (const Event& e : g.events) {
+    if (e.seq < cursor) continue;
+    next_cursor = e.seq + 1;
+    bool match = channels.empty();
+    for (const std::string& ch : channels)
+      if (e.channel == ch) { match = true; break; }
+    if (match) evs.push(e.payload);
+  }
+  if (evs.items->empty()) return false;
+  reply.set("cursor", Value::Int(int64_t(next_cursor)));
+  reply.set("events", std::move(evs));
+  reply.set("gap", Value::Bool(false));
+  *out = std::move(reply);
+  return true;
+}
+
+// Dispatch one request.  Returns the response frame body; sets *park when
+// the request is a long-poll that must wait (no frame is sent yet).
+static std::string dispatch(Gcs& g, const wire::Request& req,
+                            PendingSub* park) {
+  const std::string& m = req.method;
+  Value r = Value::None();
+  try {
+    if (m == "kv_put") {
+      std::string ns = arg_bytes(req, 0, "namespace");
+      std::string key = arg_bytes(req, 1, "key");
+      g.kv[{ns, key}] = arg_bytes(req, 2, "value");
+      Value ev = Value::Dict();
+      ev.set("ch", Value::Str("kv:" + ns));
+      ev.set("key", Value::Bytes(key));
+      g.publish("kv:" + ns, std::move(ev));
+      g.mutated();
+    } else if (m == "kv_get") {
+      auto it = g.kv.find({arg_bytes(req, 0, "namespace"),
+                           arg_bytes(req, 1, "key")});
+      if (it != g.kv.end()) r = Value::Bytes(it->second);
+    } else if (m == "kv_del") {
+      g.kv.erase({arg_bytes(req, 0, "namespace"), arg_bytes(req, 1, "key")});
+      g.mutated();
+    } else if (m == "kv_keys") {
+      std::string ns = arg_bytes(req, 0, "namespace");
+      r = Value::List();
+      for (auto& [key, _] : g.kv)
+        if (key.first == ns) r.push(Value::Bytes(key.second));
+    } else if (m == "register_actor") {
+      const Value* info = arg(req, 0, "info");
+      if (!info || info->kind != Value::STRUCT)
+        throw wire::WireError("register_actor needs ActorInfo");
+      Value copy = *info;
+      copy.pairs = std::make_shared<wire::ValuePairs>(*info->pairs);
+      const Value* aid = copy.get("actor_id");
+      if (!aid || aid->kind != Value::BYTES)
+        throw wire::WireError("register_actor: missing actor_id");
+      const Value* nm = copy.get("name");
+      if (nm && nm->kind == Value::STR && !nm->s.empty()) {
+        if (g.named.count(nm->s))
+          return wire::encode_response(
+              false, Value::Error("ValueError", "actor name '" + nm->s +
+                                                    "' already taken"));
+        g.named[nm->s] = aid->s;
+      }
+      g.actors[aid->s] = copy;
+      g.publish("actors", actor_event(copy));
+      g.mutated();
+    } else if (m == "update_actor") {
+      std::string id = arg_bytes(req, 0, "actor_id");
+      auto it = g.actors.find(id);
+      if (it != g.actors.end()) {
+        Value& info = it->second;
+        // fields arrive as kwargs (plus any positional dict is ignored —
+        // the Python surface is update_actor(actor_id, **fields))
+        if (req.kwargs.pairs)
+          for (auto& [k, v] : *req.kwargs.pairs)
+            if (k.kind == Value::STR) info.set(k.s, v);
+        const Value* st = info.get("state");
+        if (st && st->kind == Value::STR && st->s == kStateDead) {
+          const Value* nm = info.get("name");
+          if (nm && nm->kind == Value::STR) {
+            auto nit = g.named.find(nm->s);
+            if (nit != g.named.end() && nit->second == id)
+              g.named.erase(nit);
+          }
+        }
+        g.publish("actors", actor_event(info));
+        g.mutated();
+      }
+    } else if (m == "get_actor") {
+      auto it = g.actors.find(arg_bytes(req, 0, "actor_id"));
+      if (it != g.actors.end()) r = it->second;
+    } else if (m == "get_actor_by_name") {
+      const Value* nm = arg(req, 0, "name");
+      if (nm && nm->kind == Value::STR) {
+        auto nit = g.named.find(nm->s);
+        if (nit != g.named.end()) {
+          auto it = g.actors.find(nit->second);
+          if (it != g.actors.end()) r = it->second;
+        }
+      }
+    } else if (m == "list_actors") {
+      r = Value::List();
+      for (auto& [_, info] : g.actors) r.push(info);
+    } else if (m == "register_node") {
+      const Value* info = arg(req, 0, "info");
+      if (!info || info->kind != Value::STRUCT)
+        throw wire::WireError("register_node needs NodeInfo");
+      Value copy = *info;
+      copy.pairs = std::make_shared<wire::ValuePairs>(*info->pairs);
+      const Value* nid = copy.get("node_id");
+      if (!nid || nid->kind != Value::BYTES)
+        throw wire::WireError("register_node: missing node_id");
+      const Value* res = copy.get("resources");
+      copy.set("available", res ? *res : Value::Dict());
+      if (!copy.get("ts")) copy.set("ts", Value::Float(now_s()));
+      g.nodes[nid->s] = copy;
+      Value ev = Value::Dict();
+      ev.set("ch", Value::Str("nodes"));
+      ev.set("node_id", Value::Bytes(nid->s));
+      ev.set("alive", Value::Bool(true));
+      g.publish("nodes", std::move(ev));
+    } else if (m == "list_nodes") {
+      r = Value::List();
+      for (auto& [_, info] : g.nodes) r.push(info);
+    } else if (m == "get_node") {
+      auto it = g.nodes.find(arg_bytes(req, 0, "node_id"));
+      if (it != g.nodes.end()) r = it->second;
+    } else if (m == "heartbeat") {
+      auto it = g.nodes.find(arg_bytes(req, 0, "node_id"));
+      if (it != g.nodes.end()) {
+        Value& info = it->second;
+        const Value* alive = info.get("alive");
+        if (alive && alive->truthy()) {
+          info.set("ts", Value::Float(now_s()));
+          const Value* av = arg(req, 1, "available");
+          if (av) info.set("available", *av);
+          const Value* q = arg(req, 2, "queued");
+          if (q) info.set("queued", *q);
+        }
+      }
+    } else if (m == "mark_node_dead") {
+      r = Value::Bool(do_mark_node_dead(g, arg_bytes(req, 0, "node_id")));
+    } else if (m == "check_node_health") {
+      double now = now_s();
+      std::vector<std::string> stale;
+      for (auto& [id, info] : g.nodes) {
+        const Value* alive = info.get("alive");
+        const Value* is_head = info.get("is_head");
+        const Value* ts = info.get("ts");
+        if (alive && alive->truthy() && !(is_head && is_head->truthy()) &&
+            ts && now - ts->as_f() > g.death_timeout_s)
+          stale.push_back(id);
+      }
+      r = Value::List();
+      for (const std::string& id : stale)
+        if (do_mark_node_dead(g, id)) r.push(Value::Bytes(id));
+    } else if (m == "add_object_location") {
+      std::string oid = arg_bytes(req, 0, "oid");
+      g.obj_locs[oid].insert(arg_bytes(req, 1, "node_id"));
+      g.lost_objects.erase(oid);
+      Value ev = Value::Dict();
+      ev.set("ch", Value::Str("objects"));
+      ev.set("oid", Value::Bytes(oid));
+      ev.set("lost", Value::Bool(false));
+      g.publish("objects", std::move(ev));
+    } else if (m == "remove_object_location") {
+      std::string oid = arg_bytes(req, 0, "oid");
+      auto it = g.obj_locs.find(oid);
+      if (it != g.obj_locs.end()) {
+        it->second.erase(arg_bytes(req, 1, "node_id"));
+        if (it->second.empty()) g.obj_locs.erase(it);
+      }
+    } else if (m == "get_object_locations") {
+      r = Value::List();
+      auto it = g.obj_locs.find(arg_bytes(req, 0, "oid"));
+      if (it != g.obj_locs.end())
+        for (const std::string& nid : it->second) r.push(Value::Bytes(nid));
+    } else if (m == "all_object_locations") {
+      r = Value::Dict();
+      for (auto& [oid, locs] : g.obj_locs) {
+        Value l = Value::List();
+        for (const std::string& nid : locs) l.push(Value::Bytes(nid));
+        r.pairs->emplace_back(Value::Bytes(oid), std::move(l));
+      }
+    } else if (m == "object_lost") {
+      r = Value::Bool(g.lost_objects.count(arg_bytes(req, 0, "oid")) > 0);
+    } else if (m == "clear_object_lost") {
+      g.lost_objects.erase(arg_bytes(req, 0, "oid"));
+    } else if (m == "register_pg") {
+      Value pg = Value::Dict();
+      const Value* bundles = arg(req, 1, "bundles");
+      const Value* strategy = arg(req, 2, "strategy");
+      const Value* assignment = arg(req, 3, "assignment");
+      pg.set("bundles", bundles ? *bundles : Value::List());
+      pg.set("strategy", strategy ? *strategy : Value::Str("PACK"));
+      pg.set("assignment", assignment ? *assignment : Value::List());
+      g.pgs[arg_bytes(req, 0, "pg_id")] = std::move(pg);
+      g.mutated();
+    } else if (m == "get_pg") {
+      auto it = g.pgs.find(arg_bytes(req, 0, "pg_id"));
+      if (it != g.pgs.end()) r = it->second;
+    } else if (m == "remove_pg") {
+      g.pgs.erase(arg_bytes(req, 0, "pg_id"));
+      g.mutated();
+    } else if (m == "list_pgs") {
+      r = Value::Dict();
+      for (auto& [id, pg] : g.pgs)
+        r.pairs->emplace_back(Value::Bytes(id), pg);
+    } else if (m == "sub_poll") {
+      // sub_poll(channels, cursor, timeout_ms) -> {cursor, events, gap}
+      const Value* chv = arg(req, 0, "channels");
+      std::vector<std::string> channels;
+      if (chv && chv->items)
+        for (const Value& c : *chv->items)
+          if (c.kind == Value::STR) channels.push_back(c.s);
+      const Value* cur = arg(req, 1, "cursor");
+      int64_t cursor = cur ? cur->as_i() : -1;
+      const Value* tmo = arg(req, 2, "timeout_ms");
+      int64_t timeout_ms = tmo ? tmo->as_i() : 0;
+      if (cursor < 0) {  // tail: hand back the current end of the log
+        Value reply = Value::Dict();
+        reply.set("cursor", Value::Int(int64_t(g.next_seq)));
+        reply.set("events", Value::List());
+        reply.set("gap", Value::Bool(false));
+        return wire::encode_response(true, reply);
+      }
+      Value reply;
+      if (sub_reply(g, channels, uint64_t(cursor), &reply))
+        return wire::encode_response(true, reply);
+      if (timeout_ms > 0) {  // park until publish or timeout
+        park->parked = true;
+        park->channels = std::move(channels);
+        park->cursor = uint64_t(cursor);
+        park->deadline_mono = mono_s() + double(timeout_ms) / 1000.0;
+        return std::string();
+      }
+      reply = Value::Dict();
+      reply.set("cursor", Value::Int(cursor));
+      reply.set("events", Value::List());
+      reply.set("gap", Value::Bool(false));
+      return wire::encode_response(true, reply);
+    } else {
+      return wire::encode_response(
+          false,
+          Value::Error("ValueError", "unknown GCS method '" + m + "'"));
+    }
+  } catch (const wire::WireError& e) {
+    return wire::encode_response(false,
+                                 Value::Error("ValueError", e.what()));
+  }
+  return wire::encode_response(true, r);
+}
+
+// ---------------------------------------------------------------------------
+// Event loop: epoll, nonblocking conns, length-prefixed frames
+// ---------------------------------------------------------------------------
+
+struct Conn {
+  int fd;
+  bool is_tcp;
+  enum Phase { AUTH, HELLO, READY } phase;
+  std::string in;    // read accumulation
+  std::string out;   // pending writes
+  PendingSub sub;    // parked long-poll (at most one per conn)
+  bool closing = false;
+};
+
+static constexpr size_t kMaxFrame = 1u << 28;
+
+static void set_nonblock(int fd) {
+  fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+}
+
+struct Server {
+  Gcs gcs;
+  int epfd = -1;
+  int listen_fd = -1;
+  bool listen_tcp = false;
+  std::string token;  // TCP peers must present this before frame 1
+  std::map<int, Conn> conns;
+
+  void add_frame(Conn& c, const std::string& body) {
+    uint32_t n = uint32_t(body.size());
+    char hdr[4];
+    memcpy(hdr, &n, 4);
+    c.out.append(hdr, 4);
+    c.out.append(body);
+  }
+
+  void want_write(Conn& c) {
+    struct epoll_event ev;
+    ev.events = EPOLLIN | (c.out.empty() ? 0 : EPOLLOUT);
+    ev.data.fd = c.fd;
+    epoll_ctl(epfd, EPOLL_CTL_MOD, c.fd, &ev);
+  }
+
+  void close_conn(int fd) {
+    epoll_ctl(epfd, EPOLL_CTL_DEL, fd, nullptr);
+    close(fd);
+    conns.erase(fd);
+  }
+
+  void flush(Conn& c) {
+    while (!c.out.empty()) {
+      ssize_t n = write(c.fd, c.out.data(), c.out.size());
+      if (n > 0) {
+        c.out.erase(0, size_t(n));
+      } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        break;
+      } else {
+        c.closing = true;
+        return;
+      }
+    }
+    if (c.closing && c.out.empty()) return;
+    want_write(c);
+  }
+
+  // Completes parked long-polls that now have matching events (called
+  // after every dispatch that may have published).
+  void wake_subscribers() {
+    for (auto& [fd, c] : conns) {
+      if (!c.sub.parked) continue;
+      Value reply;
+      if (sub_reply(gcs, c.sub.channels, c.sub.cursor, &reply)) {
+        c.sub.parked = false;
+        add_frame(c, wire::encode_response(true, reply));
+        flush(c);
+      }
+    }
+  }
+
+  void expire_subscribers(double now_mono) {
+    for (auto& [fd, c] : conns) {
+      if (!c.sub.parked || c.sub.deadline_mono > now_mono) continue;
+      c.sub.parked = false;
+      Value reply = Value::Dict();
+      reply.set("cursor", Value::Int(int64_t(c.sub.cursor)));
+      reply.set("events", Value::List());
+      reply.set("gap", Value::Bool(false));
+      add_frame(c, wire::encode_response(true, reply));
+      flush(c);
+    }
+  }
+
+  // Pulls complete frames out of c.in; returns false when the connection
+  // must close.
+  bool on_readable(Conn& c) {
+    char buf[1 << 16];
+    for (;;) {
+      ssize_t n = read(c.fd, buf, sizeof buf);
+      if (n > 0) {
+        c.in.append(buf, size_t(n));
+        if (c.in.size() > kMaxFrame + 4) return false;  // flooding
+      } else if (n == 0) {
+        return false;  // clean EOF
+      } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        break;
+      } else {
+        return false;
+      }
+    }
+    for (;;) {
+      if (c.in.size() < 4) return true;
+      uint32_t len;
+      memcpy(&len, c.in.data(), 4);
+      if (len > kMaxFrame) return false;
+      if (c.in.size() < 4 + size_t(len)) return true;
+      std::string body = c.in.substr(4, len);
+      c.in.erase(0, 4 + size_t(len));
+      if (!on_frame(c, body)) return false;
+    }
+  }
+
+  bool on_frame(Conn& c, const std::string& body) {
+    switch (c.phase) {
+      case Conn::AUTH:
+        // constant-time-ish compare (reference: token-authenticated TCP
+        // control plane; see protocol.py authenticate_server_side)
+        if (body.size() != token.size() ||
+            CRYPTO_memcmp(body, token) != 0) {
+          add_frame(c, "NO");
+          flush(c);
+          return false;
+        }
+        add_frame(c, "OK");
+        c.phase = Conn::HELLO;
+        flush(c);
+        return true;
+      case Conn::HELLO:
+        if (body != wire::kHello) return false;  // version mismatch: hang up
+        add_frame(c, wire::kHelloOk);
+        c.phase = Conn::READY;
+        flush(c);
+        return true;
+      case Conn::READY: {
+        wire::Request req;
+        try {
+          req = wire::decode_request(body);
+        } catch (const wire::WireError& e) {
+          add_frame(c, wire::encode_response(
+                           false, Value::Error("ValueError", e.what())));
+          flush(c);
+          return true;  // framing is intact; keep serving
+        }
+        PendingSub park;
+        std::string resp = dispatch(gcs, req, &park);
+        if (park.parked) {
+          c.sub = std::move(park);
+          return true;
+        }
+        add_frame(c, resp);
+        flush(c);
+        wake_subscribers();
+        return true;
+      }
+    }
+    return false;
+  }
+
+  static int CRYPTO_memcmp(const std::string& a, const std::string& b) {
+    unsigned char d = 0;
+    for (size_t i = 0; i < a.size(); ++i)
+      d |= (unsigned char)(a[i]) ^ (unsigned char)(b[i]);
+    return d;
+  }
+
+  void accept_all() {
+    for (;;) {
+      int fd = accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) return;
+      set_nonblock(fd);
+      if (listen_tcp) {
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+      }
+      Conn c;
+      c.fd = fd;
+      c.is_tcp = listen_tcp;
+      c.phase = listen_tcp ? Conn::AUTH : Conn::HELLO;
+      conns.emplace(fd, std::move(c));
+      struct epoll_event ev;
+      ev.events = EPOLLIN;
+      ev.data.fd = fd;
+      epoll_ctl(epfd, EPOLL_CTL_ADD, fd, &ev);
+    }
+  }
+
+  int run() {
+    struct epoll_event evs[64];
+    for (;;) {
+      // epoll timeout = nearest of (snapshot debounce, sub deadlines)
+      double now = mono_s();
+      double next = now + 1.0;
+      if (gcs.snapshot_due_mono > 0 && gcs.snapshot_due_mono < next)
+        next = gcs.snapshot_due_mono;
+      for (auto& [fd, c] : conns)
+        if (c.sub.parked && c.sub.deadline_mono < next)
+          next = c.sub.deadline_mono;
+      int timeout_ms = int((next - now) * 1000.0);
+      if (timeout_ms < 0) timeout_ms = 0;
+      int n = epoll_wait(epfd, evs, 64, timeout_ms);
+      now = mono_s();
+      if (gcs.snapshot_due_mono > 0 && now >= gcs.snapshot_due_mono)
+        gcs.snapshot();
+      expire_subscribers(now);
+      for (int i = 0; i < n; ++i) {
+        int fd = evs[i].data.fd;
+        if (fd == listen_fd) {
+          accept_all();
+          continue;
+        }
+        auto it = conns.find(fd);
+        if (it == conns.end()) continue;
+        Conn& c = it->second;
+        bool ok = true;
+        if (evs[i].events & (EPOLLHUP | EPOLLERR))
+          ok = false;
+        else {
+          if (evs[i].events & EPOLLIN) ok = on_readable(c);
+          if (ok && (evs[i].events & EPOLLOUT)) flush(c);
+          if (c.closing) ok = false;
+        }
+        if (!ok) close_conn(fd);
+      }
+    }
+  }
+};
+
+int main(int argc, char** argv) {
+  std::string bind_addr, advertise_file, persist;
+  double death_timeout = 5.0;
+  for (int i = 1; i < argc - 1; ++i) {
+    std::string a = argv[i];
+    if (a == "--bind") bind_addr = argv[++i];
+    else if (a == "--advertise-file") advertise_file = argv[++i];
+    else if (a == "--persist") persist = argv[++i];
+    else if (a == "--death-timeout-s") death_timeout = atof(argv[++i]);
+  }
+  if (bind_addr.empty()) {
+    fprintf(stderr, "usage: gcs_server --bind <unix path|host:port> "
+                    "[--advertise-file F] [--persist F] "
+                    "[--death-timeout-s S]\n");
+    return 2;
+  }
+  signal(SIGPIPE, SIG_IGN);
+
+  Server srv;
+  srv.gcs.death_timeout_s = death_timeout;
+  srv.gcs.persist_path = persist;
+  if (!persist.empty()) srv.gcs.restore();
+  const char* tok = getenv("RTPU_CLUSTER_TOKEN");
+  srv.token = tok ? tok : "";
+
+  // TCP address = has a ':' and doesn't start with '/' or '.'
+  size_t colon = bind_addr.rfind(':');
+  srv.listen_tcp = bind_addr[0] != '/' && bind_addr[0] != '.' &&
+                   colon != std::string::npos;
+  std::string advertised = bind_addr;
+  if (srv.listen_tcp) {
+    std::string host = bind_addr.substr(0, colon);
+    int port = atoi(bind_addr.c_str() + colon + 1);
+    srv.listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(srv.listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    struct sockaddr_in sa;
+    memset(&sa, 0, sizeof sa);
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons(uint16_t(port));
+    if (host.empty() || host == "0.0.0.0")
+      sa.sin_addr.s_addr = INADDR_ANY;
+    else if (inet_pton(AF_INET, host.c_str(), &sa.sin_addr) != 1)
+      sa.sin_addr.s_addr = INADDR_ANY;
+    if (bind(srv.listen_fd, (struct sockaddr*)&sa, sizeof sa) != 0 ||
+        listen(srv.listen_fd, 512) != 0) {
+      perror("bind/listen");
+      return 1;
+    }
+    socklen_t slen = sizeof sa;
+    getsockname(srv.listen_fd, (struct sockaddr*)&sa, &slen);
+    advertised = host + ":" + std::to_string(ntohs(sa.sin_port));
+  } else {
+    srv.listen_fd = socket(AF_UNIX, SOCK_STREAM, 0);
+    struct sockaddr_un sa;
+    memset(&sa, 0, sizeof sa);
+    sa.sun_family = AF_UNIX;
+    strncpy(sa.sun_path, bind_addr.c_str(), sizeof sa.sun_path - 1);
+    unlink(bind_addr.c_str());
+    if (bind(srv.listen_fd, (struct sockaddr*)&sa, sizeof sa) != 0 ||
+        listen(srv.listen_fd, 512) != 0) {
+      perror("bind/listen");
+      return 1;
+    }
+  }
+  set_nonblock(srv.listen_fd);
+  srv.epfd = epoll_create1(0);
+  struct epoll_event ev;
+  ev.events = EPOLLIN;
+  ev.data.fd = srv.listen_fd;
+  epoll_ctl(srv.epfd, EPOLL_CTL_ADD, srv.listen_fd, &ev);
+
+  if (!advertise_file.empty()) {
+    std::string tmp = advertise_file + ".tmp";
+    FILE* f = fopen(tmp.c_str(), "w");
+    if (f) {
+      fprintf(f, "%s\n", advertised.c_str());
+      fclose(f);
+      rename(tmp.c_str(), advertise_file.c_str());
+    }
+  }
+  return srv.run();
+}
