@@ -64,10 +64,17 @@ class PeerLinks:
     def one_shot_rpc(self, sched_addr: str, method: str, params: dict):
         """Request/response against a peer scheduler over a fresh
         connection (the cached peer conns are one-way fire-and-forget)."""
+        if protocol.chaos_should_fail(method, "req"):
+            raise ConnectionResetError(
+                f"rpc chaos: injected {method} request failure")
         conn = protocol.connect_addr(sched_addr, timeout=5.0)
         try:
             conn.send({"t": "rpc", "method": method, "params": params})
             resp = conn.recv()
+            if resp is not None and protocol.chaos_should_fail(
+                    method, "resp"):
+                raise ConnectionResetError(
+                    f"rpc chaos: injected {method} response failure")
         finally:
             conn.close()
         if resp is None or not resp.get("ok"):

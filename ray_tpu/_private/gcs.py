@@ -19,6 +19,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -93,8 +94,15 @@ class Gcs:
 
     PERSIST_DEBOUNCE_S = 0.2
 
+    EVENT_RING = 16384
+
     def __init__(self, persist_path: Optional[str] = None):
         self._lock = threading.RLock()
+        # pubsub event log (reference: gcs_server/pubsub_handler.cc —
+        # long-poll subscriptions over a bounded ring of change events)
+        self._events: "deque[tuple[int, str, dict]]" = deque()
+        self._next_seq = 1
+        self._events_cond = threading.Condition(self._lock)
         self.actors: dict[bytes, ActorInfo] = {}
         self.named_actors: dict[str, bytes] = {}
         self.nodes: dict[bytes, NodeInfo] = {}
@@ -197,7 +205,55 @@ class Gcs:
         # transitions must survive ANOTHER head crash
         self._mutated()
 
+    # -- pubsub ------------------------------------------------------------
+    def _publish(self, channel: str, payload: dict):
+        """Append a change event (caller holds the lock)."""
+        self._events.append((self._next_seq, channel, payload))
+        self._next_seq += 1
+        while len(self._events) > self.EVENT_RING:
+            self._events.popleft()
+        self._events_cond.notify_all()
+
+    def sub_poll(self, channels: list, cursor: int,
+                 timeout_ms: int = 0) -> dict:
+        """Long-poll for events on the given channels since ``cursor``.
+
+        cursor < 0 tails the log (returns the current end, no events).  A
+        subscriber that fell behind the ring gets ``gap=True`` and must
+        re-read table state.  Counterpart of the reference's
+        PubsubLongPolling (src/ray/protobuf/core_worker.proto) — blocking
+        here is fine: every subscriber holds a dedicated connection/thread.
+        """
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        chans = set(channels or ())
+        with self._lock:
+            if cursor < 0:
+                return {"cursor": self._next_seq, "events": [], "gap": False}
+            while True:
+                oldest = self._events[0][0] if self._events else self._next_seq
+                if cursor < oldest:
+                    return {"cursor": self._next_seq, "events": [],
+                            "gap": True}
+                events = [p for (s, ch, p) in self._events
+                          if s >= cursor and (not chans or ch in chans)]
+                if events:
+                    return {"cursor": self._next_seq, "events": events,
+                            "gap": False}
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    # nothing matched in the whole scanned range: advance
+                    # the cursor to the end, or unrelated-channel churn
+                    # would eventually evict the stale position and turn
+                    # every later poll into a spurious gap
+                    return {"cursor": self._next_seq, "events": [],
+                            "gap": False}
+                self._events_cond.wait(remaining)
+
     # -- actors ------------------------------------------------------------
+    def _actor_event(self, info: ActorInfo) -> dict:
+        return {"ch": "actors", "actor_id": info.actor_id,
+                "state": info.state, "addr": info.addr}
+
     def register_actor(self, info: ActorInfo):
         with self._lock:
             if info.name:
@@ -205,6 +261,7 @@ class Gcs:
                     raise ValueError(f"actor name {info.name!r} already taken")
                 self.named_actors[info.name] = info.actor_id
             self.actors[info.actor_id] = info
+            self._publish("actors", self._actor_event(info))
         self._mutated()
 
     def update_actor(self, actor_id: bytes, **fields):
@@ -216,6 +273,7 @@ class Gcs:
                 setattr(info, k, v)
             if info.state == DEAD and info.name:
                 self.named_actors.pop(info.name, None)
+            self._publish("actors", self._actor_event(info))
         self._mutated()
 
     def get_actor(self, actor_id: bytes) -> Optional[ActorInfo]:
@@ -236,6 +294,8 @@ class Gcs:
         with self._lock:
             info.available = dict(info.resources)
             self.nodes[info.node_id] = info
+            self._publish("nodes", {"ch": "nodes", "node_id": info.node_id,
+                                    "alive": True})
 
     def list_nodes(self) -> list[NodeInfo]:
         with self._lock:
@@ -275,6 +335,10 @@ class Gcs:
                         # ones with live waiters)
                         self.lost_objects.pop()
                     self.lost_objects.add(oid)
+                    self._publish("objects", {"ch": "objects", "oid": oid,
+                                              "lost": True})
+            self._publish("nodes", {"ch": "nodes", "node_id": node_id,
+                                    "alive": False})
         return True
 
     def check_node_health(self) -> list[bytes]:
@@ -291,6 +355,8 @@ class Gcs:
         with self._lock:
             self.object_locations.setdefault(oid, set()).add(node_id)
             self.lost_objects.discard(oid)  # re-created (reconstruction)
+            self._publish("objects", {"ch": "objects", "oid": oid,
+                                      "lost": False})
 
     def object_lost(self, oid: bytes) -> bool:
         with self._lock:
@@ -347,6 +413,8 @@ class Gcs:
     def kv_put(self, namespace: str, key: bytes, value: bytes):
         with self._lock:
             self.kv[(namespace, key)] = value
+            self._publish(f"kv:{namespace}",
+                          {"ch": f"kv:{namespace}", "key": key})
         self._mutated()
 
     def kv_get(self, namespace: str, key: bytes) -> Optional[bytes]:
@@ -378,6 +446,7 @@ _GCS_METHODS = frozenset({
     "object_lost", "clear_object_lost",
     "register_pg", "get_pg", "remove_pg", "list_pgs",
     "kv_put", "kv_get", "kv_del", "kv_keys",
+    "check_node_health", "sub_poll",
 })
 
 
@@ -472,11 +541,19 @@ class GcsClient:
         return conn
 
     def _call(self, method: str, *args, **kwargs):
+        from ray_tpu._private.protocol import chaos_should_fail
+
         req = wire.encode_request(method, args, kwargs)
         with self._lock:
             try:
+                if chaos_should_fail(method, "req"):
+                    raise ConnectionResetError(
+                        f"rpc chaos: injected {method} request failure")
                 self._conn.send_frame(req)
                 data = self._conn.recv_frame()
+                if data is not None and chaos_should_fail(method, "resp"):
+                    raise ConnectionResetError(
+                        f"rpc chaos: injected {method} response failure")
             except OSError:
                 data = None
             if data is None:
@@ -502,3 +579,31 @@ def _make_proxy(name):
 
 for _m in _GCS_METHODS:
     setattr(GcsClient, _m, _make_proxy(_m))
+
+
+class GcsSubscriber:
+    """Dedicated long-poll subscription to GCS change events.
+
+    Replaces sleep-polling of GCS tables (reference: the long-poll
+    subscriber in src/ray/pubsub/subscriber.h:216).  Holds its own
+    connection — a parked long-poll must not block other RPCs.
+
+    ``poll`` returns (events, gap): ``gap=True`` means the subscriber fell
+    behind the server's event ring and must re-read table state before
+    trusting events again.
+    """
+
+    def __init__(self, gcs_address: str, channels: list):
+        self._client = GcsClient(gcs_address)
+        self._channels = list(channels)
+        self._cursor = -1
+
+    def poll(self, timeout_s: float = 10.0) -> tuple[list, bool]:
+        if self._cursor < 0:
+            self._cursor = self._client.sub_poll(
+                self._channels, -1, 0)["cursor"]
+            return [], True  # first poll: caller reads current state
+        r = self._client.sub_poll(self._channels, self._cursor,
+                                  int(timeout_s * 1000))
+        self._cursor = r["cursor"]
+        return r["events"], bool(r["gap"])

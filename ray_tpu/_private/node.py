@@ -137,17 +137,29 @@ class Node:
             sched_socket = f"{self.listen_host}:0"  # kernel-assigned port
         else:
             sched_socket = os.path.join(self.session_dir, "sched.sock")
+        self._gcs_proc = None
         if head:
             # Durable control plane (reference: Redis-backed GCS fault
             # tolerance): point RTPU_GCS_PERSIST (or gcs_persist_path) at
             # a stable file and a restarted head restores actors/PGs/KV.
             persist = (gcs_persist_path
                        or os.environ.get("RTPU_GCS_PERSIST") or None)
-            self.gcs = Gcs(persist_path=persist)
             gcs_bind = (f"{self.listen_host}:0" if self.listen_host
                         else os.path.join(self.session_dir, "gcs.sock"))
-            self.gcs_server = GcsServer(self.gcs, gcs_bind)
-            self.gcs_address = self.gcs_server.socket_path
+            if os.environ.get("RTPU_PYTHON_GCS"):
+                # Fallback: in-process Python GCS (debugging / platforms
+                # without the native toolchain).
+                self.gcs = Gcs(persist_path=persist)
+                self.gcs_server = GcsServer(self.gcs, gcs_bind)
+                self.gcs_address = self.gcs_server.socket_path
+            else:
+                # Default: the native C++ GCS daemon (reference: the
+                # gcs_server process spawned by services.py:1442).  The
+                # head talks to it through GcsClient like every other
+                # node — one control plane, no in-process special case.
+                self.gcs_address = self._spawn_native_gcs(gcs_bind, persist)
+                self.gcs = GcsClient(self.gcs_address)
+                self.gcs_server = None
         else:
             if gcs_address is None:
                 raise ValueError("worker nodes need gcs_address "
@@ -155,12 +167,14 @@ class Node:
             self.gcs = GcsClient(gcs_address)
             self.gcs_server = None
             self.gcs_address = gcs_address
+        self._sync_cluster_flags()
         self.scheduler = Scheduler(
             socket_path=sched_socket,
             store_socket=self.store_server.socket_path,
             shm_name=shm_name,
             store_capacity=capacity,
             gcs=self.gcs,
+            gcs_address=self.gcs_address,
             node_resources=merged,
             min_workers=min_workers,
             max_workers=max_workers or max(4, int(merged.get("CPU", 4)) * 2),
@@ -199,6 +213,67 @@ class Node:
             except Exception:
                 self.dashboard = None  # aiohttp missing / port exhaustion
 
+    def _sync_cluster_flags(self):
+        """Flag propagation (reference: ray.init _system_config serialized
+        to every raylet; SURVEY §5 config/flag system).  The head publishes
+        its explicitly-set registry flags to the GCS; joining nodes adopt
+        them into the environment (local settings win), so worker processes
+        cluster-wide see one effective config.  `rtpu status` dumps it."""
+        from ray_tpu._private import flags, wire
+
+        try:
+            if self.is_head:
+                self.gcs.kv_put("config", b"flags",
+                                wire.encode(flags.explicit()))
+            else:
+                blob = self.gcs.kv_get("config", b"flags")
+                if blob:
+                    for k, v in wire.decode(blob).items():
+                        if k in flags.FLAGS:
+                            os.environ.setdefault(k, v)
+        except Exception:
+            pass  # config sync is best-effort; defaults still apply
+
+    def _spawn_native_gcs(self, bind: str, persist: Optional[str]) -> str:
+        """Start the C++ GCS daemon; returns its connectable address."""
+        import subprocess
+
+        from ray_tpu._private.gcs import NODE_DEATH_TIMEOUT_S
+        from ray_tpu._private.protocol import advertised_host, is_tcp_addr
+        from ray_tpu.native.build import binary_path
+
+        adv = os.path.join(self.session_dir, "gcs.advertise")
+        cmd = [binary_path("gcs_server"), "--bind", bind,
+               "--advertise-file", adv,
+               "--death-timeout-s", str(NODE_DEATH_TIMEOUT_S),
+               "--parent-pid", str(os.getpid())]
+        if persist:
+            cmd += ["--persist", persist]
+        log = open(os.path.join(self.session_dir, "gcs_server.err"), "ab")
+        try:
+            self._gcs_proc = subprocess.Popen(
+                cmd, stdout=log, stderr=log, close_fds=True)
+        finally:
+            log.close()
+        deadline = time.time() + 15.0
+        while time.time() < deadline:
+            if os.path.exists(adv):
+                addr = open(adv).read().strip()
+                if addr:
+                    if is_tcp_addr(addr):
+                        # daemon reports its bound port; rewrite a wildcard
+                        # bind host into something peers can dial
+                        host, _, port = addr.rpartition(":")
+                        addr = f"{advertised_host(host)}:{port}"
+                    return addr
+            if self._gcs_proc.poll() is not None:
+                raise RuntimeError(
+                    "native GCS daemon exited at startup (see "
+                    f"{self.session_dir}/gcs_server.err); set "
+                    "RTPU_PYTHON_GCS=1 to fall back to the Python GCS")
+            time.sleep(0.02)
+        raise RuntimeError("native GCS daemon did not come up in 15s")
+
     def new_store_client(self) -> StoreClient:
         return StoreClient(
             self.store_server.socket_path,
@@ -212,7 +287,7 @@ class Node:
             jm.shutdown()
         if self.dashboard is not None:
             self.dashboard.shutdown()
-        if self.gcs_server is None:
+        if not self.is_head:
             # Attached (non-head) node leaving gracefully: tell the GCS now
             # instead of making peers wait out the heartbeat timeout.
             try:
@@ -223,6 +298,12 @@ class Node:
         self.store_server.shutdown()
         if self.gcs_server is not None:
             self.gcs_server.shutdown()
+        if self._gcs_proc is not None:
+            self._gcs_proc.terminate()
+            try:
+                self._gcs_proc.wait(timeout=5)
+            except Exception:
+                self._gcs_proc.kill()
 
 
 def _default_store_capacity() -> int:

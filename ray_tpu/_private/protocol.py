@@ -25,19 +25,67 @@ import threading
 _LEN = struct.Struct("<I")
 
 
-def _chaos_rates() -> tuple[float, float]:
-    spec = os.environ.get("RTPU_TESTING_RPC_FAILURE", "")
-    if not spec:
-        return (0.0, 0.0)
-    try:
-        send_s, _, recv_s = spec.partition(":")
-        return (float(send_s or 0) / 100.0, float(recv_s or 0) / 100.0)
-    except ValueError:
-        return (0.0, 0.0)
+def _parse_chaos() -> tuple[float, float, dict]:
+    """Parse RTPU_TESTING_RPC_FAILURE.
+
+    Two forms, combinable comma-separated (reference:
+    RAY_testing_rpc_failure, src/ray/rpc/rpc_chaos.h:23 — per-method scoped
+    failures with max counts):
+
+      "<send%>:<recv%>"                   — global, every frame
+      "<method>=<max>:<req%>:<resp%>"     — scoped to one RPC method; at
+                                            most <max> failures are ever
+                                            injected for it ("*" matches
+                                            any method; max -1 = unlimited)
+    """
+    glob_send = glob_recv = 0.0
+    methods: dict = {}
+    for part in os.environ.get("RTPU_TESTING_RPC_FAILURE", "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            if "=" in part:
+                name, _, rest = part.partition("=")
+                max_s, req_s, resp_s = (rest.split(":") + ["0", "0"])[:3]
+                methods[name] = [int(max_s or 0), float(req_s or 0) / 100.0,
+                                 float(resp_s or 0) / 100.0]
+            else:
+                send_s, _, recv_s = part.partition(":")
+                glob_send = float(send_s or 0) / 100.0
+                glob_recv = float(recv_s or 0) / 100.0
+        except ValueError:
+            continue
+    return glob_send, glob_recv, methods
 
 
-_CHAOS_SEND, _CHAOS_RECV = _chaos_rates()
+_CHAOS_SEND, _CHAOS_RECV, _CHAOS_METHODS = _parse_chaos()
 _chaos_rng = random.Random(os.environ.get("RTPU_TESTING_RPC_SEED"))
+_chaos_lock = threading.Lock()
+
+
+def chaos_should_fail(method: str, direction: str) -> bool:
+    """Method-aware injection point (direction: "req" | "resp").
+
+    Called by method-aware RPC layers (GcsClient, worker/scheduler rpc)
+    around each call; the frame-level global rates stay in Connection.
+    Each scoped entry injects at most its max_failures failures total in
+    this process, which is what lets a test say "drop the first 2 lease
+    responses" and then observe recovery.
+    """
+    entry = _CHAOS_METHODS.get(method) or _CHAOS_METHODS.get("*")
+    if entry is None:
+        return False
+    with _chaos_lock:
+        remaining, req_p, resp_p = entry
+        if remaining == 0:
+            return False
+        p = req_p if direction == "req" else resp_p
+        if p and _chaos_rng.random() < p:
+            if remaining > 0:
+                entry[0] = remaining - 1
+            return True
+    return False
 
 
 class Connection:
@@ -49,6 +97,12 @@ class Connection:
 
     def send(self, msg: dict):
         if _CHAOS_SEND and _chaos_rng.random() < _CHAOS_SEND:
+            # An injected "reset" must BE a reset: close the socket so the
+            # peer observes EOF and runs its death/repair path.  Raising
+            # without closing would simulate a dropped frame on a healthy
+            # connection — a failure mode lease-less dispatch paths cannot
+            # detect (the task would hang in in_flight forever).
+            self.close()
             raise ConnectionResetError("rpc chaos: injected send failure")
         data = pickle.dumps(msg, protocol=5)
         frame = _LEN.pack(len(data)) + data
@@ -79,6 +133,7 @@ class Connection:
     def send_frame(self, data: bytes):
         """Send one raw frame WITH chaos injection — wire-codec RPCs."""
         if _CHAOS_SEND and _chaos_rng.random() < _CHAOS_SEND:
+            self.close()  # a reset, not a silent drop (see send())
             raise ConnectionResetError("rpc chaos: injected send failure")
         self.send_bytes(data)
 
@@ -128,6 +183,14 @@ class Connection:
         return buf
 
     def close(self):
+        # shutdown() before close(): close() alone does NOT wake a thread
+        # blocked in recv() on this socket (the fd just leaks out from
+        # under it) — shutdown() delivers EOF to blocked readers, so
+        # reader loops run their death/repair paths promptly.
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self.sock.close()
         except OSError:

@@ -98,11 +98,13 @@ class Scheduler:
         worker_env: Optional[dict] = None,
         node_id: Optional[bytes] = None,
         is_head: bool = True,
+        gcs_address: Optional[str] = None,
     ):
         self.store_socket = store_socket
         self.shm_name = shm_name
         self.store_capacity = store_capacity
         self.gcs = gcs
+        self.gcs_address = gcs_address
         self.node_id = node_id or os.urandom(16)
         self.is_head = is_head
         self.total_resources = dict(node_resources)
@@ -139,6 +141,24 @@ class Scheduler:
         self._task_events: dict[bytes, dict] = {}
         self._task_events_cap = int(
             os.environ.get("RTPU_TASK_EVENTS_CAP", 20000))
+        # Event-driven pull retries (armed by trigger_pull; drained by the
+        # "objects" pubsub watcher thread, started on first use).
+        self._wanted_oids: set[bytes] = set()
+        self._wanted_lock = threading.Lock()
+        self._objwatch_started = False
+        # OOM kills: worker_id -> provenance dict, consulted by the
+        # worker-death handler so exhausted retries surface
+        # OutOfMemoryError instead of a generic crash.
+        self._oom_kills: dict[bytes, dict] = {}
+        self._memory_monitor = None
+        threshold = float(
+            os.environ.get("RTPU_MEMORY_MONITOR_THRESHOLD", 0.95))
+        if threshold > 0:
+            from ray_tpu._private.memory_monitor import MemoryMonitor
+
+            self._memory_monitor = MemoryMonitor(
+                threshold, self._handle_memory_pressure)
+            self._memory_monitor.start()
 
         self._store = StoreClient(store_socket, shm_name, store_capacity)
         self._listener, self.socket_path = listener_addr(socket_path)
@@ -147,6 +167,10 @@ class Scheduler:
         self._transfer = ObjectTransfer(
             self._store, gcs, self.node_id, self._lookup_node,
             lambda: self._shutdown)
+        if gcs_address:
+            # workers subscribe to GCS pubsub directly (event-driven waits)
+            worker_env = dict(worker_env or {},
+                              RTPU_GCS_ADDRESS=gcs_address)
         self._pool = WorkerPool(
             scheduler_addr=self.socket_path,
             store_socket=store_socket,
@@ -513,6 +537,8 @@ class Scheduler:
         with self._lock:
             self._shutdown = True
             self._wake.notify_all()
+        if self._memory_monitor is not None:
+            self._memory_monitor.shutdown()
         if self._log_monitor is not None:
             self._log_monitor.stop()
         self._pool.shutdown_all()
@@ -546,80 +572,89 @@ class Scheduler:
         if not authenticate_server_side(conn, self._is_tcp):
             return
         worker: Optional[WorkerState] = None
-        while True:
-            msg = conn.recv()
-            if msg is None:
-                break
-            t = msg["t"]
-            if t == "register":
-                worker_id = bytes.fromhex(msg["worker_id"])
-                with self._lock:
-                    worker = self._workers.get(worker_id)
-                    if worker is None:  # late registration after shutdown
-                        conn.close()
-                        return
-                    worker.conn = conn
-                    worker.server_addr = msg.get("server_addr")
-                    worker.idle = True
-                    self._wake.notify_all()
-            elif t == "done":
-                self._on_task_done(worker, msg)
-            elif t == "submit":
+        # The try/finally is load-bearing: a raising handler (injected RPC
+        # chaos in a GCS call, a malformed frame) must still run
+        # _on_worker_death, or the worker's in-flight tasks are never
+        # retried and their callers hang.
+        try:
+            while True:
                 try:
-                    self.submit(msg["spec"])
-                except ValueError as e:
-                    self._fail_task(msg["spec"], e)
-            elif t == "actor_exit":
-                with self._lock:
-                    self.gcs.update_actor(msg["actor_id"], max_restarts=0)
-            elif t == "sealed":
-                # a worker sealed an object into this node's store: record
-                # the location so other nodes can pull it
-                self.note_sealed(msg["oid"])
-            elif t == "worker_logs":
-                # a worker node's monitor forwarding its workers' output;
-                # pre-attach lines buffer just like head-local ones
-                sink = self.log_sink
-                if sink is not None:
+                    msg = conn.recv()
+                except (OSError, ConnectionError):
+                    break
+                if msg is None:
+                    break
+                t = msg["t"]
+                if t == "register":
+                    worker_id = bytes.fromhex(msg["worker_id"])
+                    with self._lock:
+                        worker = self._workers.get(worker_id)
+                        if worker is None:  # late registration after shutdown
+                            conn.close()
+                            return
+                        worker.conn = conn
+                        worker.server_addr = msg.get("server_addr")
+                        worker.idle = True
+                        self._wake.notify_all()
+                elif t == "done":
+                    self._on_task_done(worker, msg)
+                elif t == "submit":
                     try:
-                        sink(msg["lines"])
-                    except Exception:
-                        pass
-                else:
-                    self._early_logs.extend(msg["lines"])
-            elif t == "submit_spilled":
-                self.submit_spilled(msg["spec"])
-            elif t == "spilled_done":
-                with self._lock:
-                    self._forwarded.pop(msg["task_id"], None)
-            elif t == "spill_moved":
-                # a relay moved our forwarded spec to another node: track
-                # the node actually executing it for death recovery
-                with self._lock:
-                    fwd = self._forwarded.get(msg["task_id"])
-                    if fwd is not None:
-                        self._forwarded[msg["task_id"]] = (msg["node"], fwd[1])
-            elif t == "kill_actor":
-                self.kill_actor(msg["actor_id"], msg.get("no_restart", True))
-            elif t == "cancel":
-                self.cancel(msg["task_id"], msg.get("force", False))
-            elif t == "blocked":
-                if worker is not None:
-                    self._on_worker_blocked(worker)
-            elif t == "unblocked":
-                if worker is not None:
-                    self._on_worker_unblocked(worker)
-            elif t == "rpc":
-                try:
-                    result = self._handle_rpc(msg["method"], msg.get("params", {}))
-                    conn.send({"ok": True, "result": result})
-                except Exception as e:
+                        self.submit(msg["spec"])
+                    except ValueError as e:
+                        self._fail_task(msg["spec"], e)
+                elif t == "actor_exit":
+                    with self._lock:
+                        self.gcs.update_actor(msg["actor_id"], max_restarts=0)
+                elif t == "sealed":
+                    # a worker sealed an object into this node's store: record
+                    # the location so other nodes can pull it
+                    self.note_sealed(msg["oid"])
+                elif t == "worker_logs":
+                    # a worker node's monitor forwarding its workers' output;
+                    # pre-attach lines buffer just like head-local ones
+                    sink = self.log_sink
+                    if sink is not None:
+                        try:
+                            sink(msg["lines"])
+                        except Exception:
+                            pass
+                    else:
+                        self._early_logs.extend(msg["lines"])
+                elif t == "submit_spilled":
+                    self.submit_spilled(msg["spec"])
+                elif t == "spilled_done":
+                    with self._lock:
+                        self._forwarded.pop(msg["task_id"], None)
+                elif t == "spill_moved":
+                    # a relay moved our forwarded spec to another node: track
+                    # the node actually executing it for death recovery
+                    with self._lock:
+                        fwd = self._forwarded.get(msg["task_id"])
+                        if fwd is not None:
+                            self._forwarded[msg["task_id"]] = (msg["node"], fwd[1])
+                elif t == "kill_actor":
+                    self.kill_actor(msg["actor_id"], msg.get("no_restart", True))
+                elif t == "cancel":
+                    self.cancel(msg["task_id"], msg.get("force", False))
+                elif t == "blocked":
+                    if worker is not None:
+                        self._on_worker_blocked(worker)
+                elif t == "unblocked":
+                    if worker is not None:
+                        self._on_worker_unblocked(worker)
+                elif t == "rpc":
                     try:
-                        conn.send({"ok": False, "error": repr(e)})
-                    except OSError:
-                        break  # caller hung up mid-rpc (e.g. process exit)
-        if worker is not None:
-            self._on_worker_death(worker)
+                        result = self._handle_rpc(msg["method"], msg.get("params", {}))
+                        conn.send({"ok": True, "result": result})
+                    except Exception as e:
+                        try:
+                            conn.send({"ok": False, "error": repr(e)})
+                        except OSError:
+                            break  # caller hung up mid-rpc (e.g. process exit)
+        finally:
+            if worker is not None:
+                self._on_worker_death(worker)
 
     def _handle_rpc(self, method: str, params: dict):
         """Request/response control-plane calls from workers (one-shot conns)."""
@@ -821,7 +856,67 @@ class Scheduler:
         self._transfer.note_sealed(oid)
 
     def trigger_pull(self, oid: bytes) -> bool:
+        """Start a pull; if no remote copy exists yet, arm an event-driven
+        retry — the GCS "objects" pubsub channel re-triggers the pull the
+        moment a location is published anywhere in the cluster, so a
+        cross-node get is bounded by the transfer, not a poll interval."""
+        if not self._store.contains(oid):
+            self._watch_object(oid)
         return self._transfer.trigger_pull(oid)
+
+    _WANTED_CAP = 10000
+
+    def _watch_object(self, oid: bytes):
+        if self.gcs_address is None:
+            return
+        with self._wanted_lock:
+            if len(self._wanted_oids) < self._WANTED_CAP:
+                self._wanted_oids.add(oid)
+            if not self._objwatch_started:
+                self._objwatch_started = True
+                threading.Thread(target=self._object_events_loop,
+                                 name="sched-objwatch", daemon=True).start()
+
+    def _object_events_loop(self):
+        """Subscribe to object-location events; re-trigger wanted pulls.
+        (Reference: the pull manager reacting to ownership-pubsub location
+        updates, src/ray/object_manager/pull_manager.cc.)"""
+        from ray_tpu._private.gcs import GcsSubscriber
+
+        sub = None
+        while not self._shutdown:
+            try:
+                if sub is None:
+                    sub = GcsSubscriber(self.gcs_address, ["objects"])
+                events, gap = sub.poll(timeout_s=5.0)
+            except Exception:
+                sub = None
+                if self._shutdown:
+                    return
+                time.sleep(0.5)
+                continue
+            with self._wanted_lock:
+                if gap:
+                    # events may have been missed (ring overrun, fresh
+                    # subscription): re-try every armed pull but KEEP the
+                    # arm — a pull that finds no location yet must stay
+                    # watched for the real event
+                    hit = list(self._wanted_oids)
+                    disarm = False
+                else:
+                    hit = [e["oid"] for e in events
+                           if not e.get("lost")
+                           and e.get("oid") in self._wanted_oids]
+                    disarm = True  # a location exists; the pull proceeds
+                if disarm:
+                    for oid in hit:
+                        self._wanted_oids.discard(oid)
+            for oid in hit:
+                if self._store.contains(oid):
+                    with self._wanted_lock:
+                        self._wanted_oids.discard(oid)
+                else:
+                    self._transfer.trigger_pull(oid)
 
     def free_object(self, oid: bytes) -> bool:
         """Delete every copy of an object cluster-wide and clear its
@@ -1072,6 +1167,33 @@ class Scheduler:
             self._wake.notify_all()
         self._notify_origin(spec)
 
+    def _handle_memory_pressure(self, used: int, total: int,
+                                threshold: float) -> bool:
+        """Kill ONE worker chosen by the retriable-FIFO policy (reference:
+        raylet worker_killing_policy_retriable_fifo.cc) instead of letting
+        the kernel OOM-kill the scheduler or store daemon.  Returns True
+        if a kill happened; the normal worker-death path then requeues the
+        victim's retriable tasks."""
+        from ray_tpu._private.memory_monitor import choose_victim, process_rss
+
+        with self._lock:
+            victim = choose_victim(self._workers.values())
+            if victim is None:
+                return False
+            rss = process_rss(victim.proc.pid)
+            self._oom_kills[victim.worker_id] = {
+                "rss": rss, "used": used, "total": total,
+                "threshold": threshold,
+            }
+        if _DEBUG_SCHED:
+            _dbg(f"OOM kill worker {victim.worker_id.hex()[:8]} "
+                 f"rss={rss} node={used}/{total}")
+        try:
+            victim.proc.kill()  # SIGKILL: a thrashing worker may not react
+        except OSError:
+            return False
+        return True
+
     def _on_worker_death(self, worker: WorkerState):
         with self._lock:
             if not worker.alive:
@@ -1099,34 +1221,44 @@ class Scheduler:
 
             dead_actor = worker.actor_id
             if dead_actor is not None:
-                self._actor_workers.pop(dead_actor, None)
-                info = self.gcs.get_actor(dead_actor)
-                restarts_ok = (
-                    info is not None
-                    and info.state != gcs_mod.DEAD
-                    and (info.max_restarts == -1
-                         or info.num_restarts < info.max_restarts)
-                )
-                if restarts_ok:
-                    self.gcs.update_actor(dead_actor,
-                                          state=gcs_mod.RESTARTING,
-                                          num_restarts=info.num_restarts + 1,
-                                          worker_id=None, addr=None)
-                    creation = self._creation_spec_for(dead_actor)
-                    if creation is not None:
-                        self._pending.appendleft(creation)
-                        self._task_index[creation.task_id] = creation
-                else:
-                    self.gcs.update_actor(dead_actor, state=gcs_mod.DEAD,
-                                          death_cause="worker died")
-                    self._cleanup_actor_kv(dead_actor)
-                    for spec in [s for s in self._pending
-                                 if s.actor_id == dead_actor]:
-                        self._pending.remove(spec)
-                        self._fail_task(spec, ActorDiedError(
-                            "The actor died unexpectedly before finishing "
-                            "this task."))
+                # Guarded: a transient GCS failure (injected chaos, head
+                # mid-restart) during actor-death bookkeeping must not
+                # abort this handler — the in-flight requeue below is what
+                # keeps the rest of the worker's tasks alive.  The node
+                # heartbeat reconcile re-drives actor state on the next
+                # tick if these GCS writes were lost.
+                try:
+                    self._actor_workers.pop(dead_actor, None)
+                    info = self.gcs.get_actor(dead_actor)
+                    restarts_ok = (
+                        info is not None
+                        and info.state != gcs_mod.DEAD
+                        and (info.max_restarts == -1
+                             or info.num_restarts < info.max_restarts)
+                    )
+                    if restarts_ok:
+                        self.gcs.update_actor(dead_actor,
+                                              state=gcs_mod.RESTARTING,
+                                              num_restarts=info.num_restarts + 1,
+                                              worker_id=None, addr=None)
+                        creation = self._creation_spec_for(dead_actor)
+                        if creation is not None:
+                            self._pending.appendleft(creation)
+                            self._task_index[creation.task_id] = creation
+                    else:
+                        self.gcs.update_actor(dead_actor, state=gcs_mod.DEAD,
+                                              death_cause="worker died")
+                        self._cleanup_actor_kv(dead_actor)
+                        for spec in [s for s in self._pending
+                                     if s.actor_id == dead_actor]:
+                            self._pending.remove(spec)
+                            self._fail_task(spec, ActorDiedError(
+                                "The actor died unexpectedly before "
+                                "finishing this task."))
+                except (OSError, ConnectionError):
+                    pass
 
+            oom = self._oom_kills.pop(worker.worker_id, None)
             for spec in in_flight:
                 if spec.task_id in self._cancelled:
                     self._cancelled.discard(spec.task_id)
@@ -1136,6 +1268,16 @@ class Scheduler:
                     spec.retries_left -= 1
                     self._pending.appendleft(spec)
                     self._task_index[spec.task_id] = spec
+                elif oom is not None and spec.kind != ACTOR_METHOD:
+                    from ray_tpu.exceptions import OutOfMemoryError
+
+                    self._fail_task(spec, OutOfMemoryError(
+                        f"task {spec.name} was killed by the node memory "
+                        f"monitor: worker rss={oom['rss'] >> 20}MB, node "
+                        f"memory {oom['used'] >> 20}/{oom['total'] >> 20}MB "
+                        f"exceeded the {oom['threshold']:.0%} threshold; "
+                        f"reduce per-task memory or raise "
+                        f"RTPU_MEMORY_MONITOR_THRESHOLD"))
                 else:
                     err = (ActorDiedError("actor died while executing method")
                            if spec.kind == ACTOR_METHOD

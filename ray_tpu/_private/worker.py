@@ -38,6 +38,7 @@ class WorkerContext:
         node=None,
         block_notify_fn: Optional[Callable] = None,
         seal_notify_fn: Optional[Callable] = None,
+        gcs_address: Optional[str] = None,
     ):
         self.mode = mode
         self.store = store
@@ -45,6 +46,9 @@ class WorkerContext:
         self.rpc = rpc_fn
         self.worker_id = worker_id
         self.node = node
+        # GCS endpoint for pubsub subscriptions (event-driven waits); falls
+        # back to RPC polling through the scheduler when absent.
+        self.gcs_address = gcs_address
         # Called with the oid after each local seal so the scheduler can
         # publish the object's location to the GCS directory (multi-node
         # pulls); None in single-purpose contexts that never share objects.
@@ -332,6 +336,13 @@ class WorkerContext:
                 return self._get_object_inner(ref, oid, remaining)
             except ObjectEvictedError:
                 if self._maybe_reconstruct(oid):
+                    # The local store still holds the EVICTED tombstone the
+                    # reconstruct's own delete left behind; the re-executed
+                    # task's seal is what clears it (shm_store.cc: creation
+                    # erases the tombstone).  WAIT it out — retrying the
+                    # get immediately would see the tombstone and burn the
+                    # whole reconstruction budget in microseconds.
+                    self._await_recreation(oid, deadline)
                     continue
                 raise ObjectLostError(
                     f"object {ref} was evicted from the object store before "
@@ -342,15 +353,41 @@ class WorkerContext:
                 lost = (getattr(e, "oid", b"")
                         or self._lost_upstream_oid(e))
                 if lost == oid and self._maybe_reconstruct(oid):
+                    self._await_recreation(oid, deadline)
                     continue
                 if (lost and lost != oid
                         and self._maybe_reconstruct(lost)
                         and self._maybe_reconstruct(oid)):
-                    continue  # chain rebuilt: upstream + this task re-run
+                    # chain rebuilt: upstream + this task re-run; wait out
+                    # this task's delete-tombstone before re-reading
+                    self._await_recreation(oid, deadline)
+                    continue
                 raise
         raise ObjectLostError(
             f"object {ref} could not be reconstructed (kept getting lost "
             f"across {8} attempts)", oid=oid)
+
+    def _await_recreation(self, oid: bytes, deadline: Optional[float],
+                          max_wait_s: float = 30.0):
+        """Block until a just-reconstructed object's local EVICTED
+        tombstone clears (its re-executed producer sealed a fresh copy
+        somewhere — locally that shows as creation erasing the tombstone,
+        remotely as the tombstone simply never being rewritten).  Bounded
+        by the caller's deadline and max_wait_s; returns either way — the
+        caller's next get attempt decides what the state means."""
+        stop = time.monotonic() + max_wait_s
+        if deadline is not None:
+            stop = min(stop, deadline)
+        while time.monotonic() < stop:
+            try:
+                view = self.store.get(oid, 0)
+            except ObjectEvictedError:
+                time.sleep(0.02)
+                continue
+            if view is not None:
+                self.store.release(oid)
+            return  # sealed locally, or tombstone gone (pullable/pending)
+        return
 
     def _get_from_memstore(self, entry, timeout: Optional[float]):
         """Resolve a memory-store entry: wait for the direct reply (condvar

@@ -55,6 +55,7 @@ class WorkerRuntime:
                 {"t": "blocked" if blocked else "unblocked"}),
             seal_notify_fn=lambda oid: self.conn.send(
                 {"t": "sealed", "oid": oid}),
+            gcs_address=os.environ.get("RTPU_GCS_ADDRESS") or None,
         )
         set_global_worker(self.ctx)
         # Direct-call server: callers push actor methods straight to this
@@ -74,10 +75,17 @@ class WorkerRuntime:
         self.ctx.init_direct(self._rpc)
 
     def _rpc(self, method: str, params: dict):
+        if protocol.chaos_should_fail(method, "req"):
+            raise ConnectionResetError(
+                f"rpc chaos: injected {method} request failure")
         conn = protocol.connect_addr(self.scheduler_socket)
         try:
             conn.send({"t": "rpc", "method": method, "params": params})
             resp = conn.recv()
+            if resp is not None and protocol.chaos_should_fail(
+                    method, "resp"):
+                raise ConnectionResetError(
+                    f"rpc chaos: injected {method} response failure")
         finally:
             conn.close()
         if resp is None or not resp.get("ok"):
@@ -132,31 +140,37 @@ class WorkerRuntime:
         fn = self.fn_cache.get(fn_id)
         if fn is None:
             view = self.store.get(fn_id, 0)
+            blob = None
             if view is None:
-                # blob may live on the submitting node (spilled task):
-                # pull it into the local store, then wait
-                self.ctx.request_pull(fn_id)
-                view = self.store.get(fn_id, 10_000)
+                # Cheap first stop: the persisted-GCS mirror (actor classes
+                # survive head restarts there — see scheduler.submit).  On
+                # a restored control plane no store anywhere holds the
+                # blob, so probing the KV BEFORE the pull wait is what
+                # makes actor recovery prompt.
+                try:
+                    blob = self.ctx.rpc("kv_get", {"namespace": "fn_blob",
+                                                   "key": fn_id})
+                except Exception:
+                    blob = None
+            if view is None and blob is None:
+                # Blob lives in some node's store (spilled task): pull it.
+                # RE-REQUEST while waiting — a single pull request can be
+                # lost (injected RPC chaos, a peer mid-restart) and must
+                # not stall the task for the whole wait window.
+                import time as _time
+
+                deadline = _time.monotonic() + 60.0
+                while view is None and _time.monotonic() < deadline:
+                    self.ctx.request_pull(fn_id)
+                    view = self.store.get(fn_id, 2_000)
             if view is not None:
                 try:
                     blob = bytes(view)
                 finally:
                     self.store.release(fn_id)
-            else:
-                # the persisted-GCS mirror (actor classes survive head
-                # restarts there — see scheduler.submit)
-                blob = self.ctx.rpc("kv_get", {"namespace": "fn_blob",
-                                               "key": fn_id})
-                if blob is None:
-                    # slow cross-node pull of a task blob: keep waiting
-                    view = self.store.get(fn_id, 50_000)
-                    if view is None:
-                        raise RuntimeError(
-                            f"function blob {fn_id.hex()[:12]} not found")
-                    try:
-                        blob = bytes(view)
-                    finally:
-                        self.store.release(fn_id)
+            elif blob is None:
+                raise RuntimeError(
+                    f"function blob {fn_id.hex()[:12]} not found")
             fn = cloudpickle.loads(blob)
             self.fn_cache[fn_id] = fn
         return fn

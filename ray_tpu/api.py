@@ -128,6 +128,7 @@ def _attach_driver(node: Node):
         worker_id=os.urandom(8),  # so runtime-context ids are non-empty
         node=node,
         seal_notify_fn=scheduler.note_sealed,
+        gcs_address=node.gcs_address,
     )
     ctx.init_direct(driver_rpc)
     # Worker print()/stderr lines from every node surface on the driver's

@@ -23,6 +23,14 @@ class WorkerCrashedError(RayTpuError):
     """The worker executing the task died unexpectedly."""
 
 
+class OutOfMemoryError(WorkerCrashedError):
+    """The worker was killed by the node memory monitor (reference:
+    ray.exceptions.OutOfMemoryError raised by the raylet's worker-killing
+    policy under memory pressure).  The message carries provenance: the
+    worker's RSS at kill time and the node usage that tripped the
+    threshold."""
+
+
 class ActorDiedError(RayTpuError):
     """The actor owning this method call has died."""
 

@@ -138,8 +138,9 @@ def rope(x, positions, theta):
 
 
 def _attention(q, k, v, attn_impl, mesh, rules=None):
-    """Dispatch dense flash vs sequence-parallel (ring/ulysses) attention."""
-    if attn_impl in ("ring", "ulysses"):
+    """Dispatch dense flash vs sequence-parallel attention
+    (ring / zigzag-balanced ring / ulysses)."""
+    if attn_impl in ("ring", "zigzag", "ulysses"):
         from ray_tpu.ops.ring_attention import sequence_parallel_attention
 
         if mesh is None:

@@ -28,6 +28,22 @@ _BINARIES = {
         "libs": ["-lrt"],
         "suffix": ".so",
     },
+    "gcs_server": {
+        "sources": ["gcs_server.cc"],
+        "headers": ["wire.h"],
+        "flags": ["-O2", "-std=c++17", "-pthread"],
+        "libs": [],
+    },
+    # CPython extension module (direct-call transport core).  Compiled
+    # against this interpreter's headers; symbols resolve at import time,
+    # so no -lpython is needed on Linux.
+    "_rtpu_core": {
+        "sources": ["core_worker.cc"],
+        "flags": ["-O2", "-std=c++17", "-pthread", "-shared", "-fPIC"],
+        "libs": [],
+        "suffix": ".so",
+        "python_ext": True,
+    },
 }
 
 
@@ -42,7 +58,8 @@ def _source_hash(sources: list[str]) -> str:
 def binary_path(name: str) -> str:
     """Return the path to a built native binary, compiling it if needed."""
     spec = _BINARIES[name]
-    tag = _source_hash(spec["sources"])
+    # headers participate in the cache key but not the compile line
+    tag = _source_hash(spec["sources"] + spec.get("headers", []))
     out = os.path.join(_BUILD_DIR,
                        f"{name}-{tag}{spec.get('suffix', '')}")
     if os.path.exists(out):
@@ -50,7 +67,23 @@ def binary_path(name: str) -> str:
     os.makedirs(_BUILD_DIR, exist_ok=True)
     srcs = [os.path.join(_NATIVE_DIR, s) for s in spec["sources"]]
     tmp = out + f".tmp.{os.getpid()}"
-    cmd = ["g++", *spec["flags"], *srcs, "-o", tmp, *spec["libs"]]
+    flags = list(spec["flags"])
+    if spec.get("python_ext"):
+        import sysconfig
+
+        flags.append(f"-I{sysconfig.get_paths()['include']}")
+    cmd = ["g++", *flags, *srcs, "-o", tmp, *spec["libs"]]
     subprocess.run(cmd, check=True, capture_output=True)
     os.replace(tmp, out)  # atomic: concurrent builders race benignly
     return out
+
+
+def load_extension(name: str):
+    """Import a compiled CPython extension module by build name."""
+    import importlib.util
+
+    path = binary_path(name)
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

@@ -47,6 +47,9 @@
 
 using wire::Value;
 
+static volatile sig_atomic_t g_stop = 0;
+static void on_stop_signal(int) { g_stop = 1; }
+
 static double now_s() {
   struct timespec ts;
   clock_gettime(CLOCK_REALTIME, &ts);
@@ -530,8 +533,11 @@ static std::string dispatch(Gcs& g, const wire::Request& req,
         park->deadline_mono = mono_s() + double(timeout_ms) / 1000.0;
         return std::string();
       }
+      // nothing matched in the scanned range: advance to the end, or
+      // unrelated-channel churn would evict the stale position and turn
+      // every later poll into a spurious gap
       reply = Value::Dict();
-      reply.set("cursor", Value::Int(cursor));
+      reply.set("cursor", Value::Int(int64_t(g.next_seq)));
       reply.set("events", Value::List());
       reply.set("gap", Value::Bool(false));
       return wire::encode_response(true, reply);
@@ -631,7 +637,10 @@ struct Server {
       if (!c.sub.parked || c.sub.deadline_mono > now_mono) continue;
       c.sub.parked = false;
       Value reply = Value::Dict();
-      reply.set("cursor", Value::Int(int64_t(c.sub.cursor)));
+      // every event < next_seq was scanned (wake_subscribers runs after
+      // each publish): none matched, so the cursor can safely advance —
+      // leaving it behind would rot into spurious gaps under churn
+      reply.set("cursor", Value::Int(int64_t(gcs.next_seq)));
       reply.set("events", Value::List());
       reply.set("gap", Value::Bool(false));
       add_frame(c, wire::encode_response(true, reply));
@@ -742,11 +751,26 @@ struct Server {
     }
   }
 
+  pid_t parent_pid = 0;  // exit when the spawning head process dies
+  double next_parent_check = 0;
+
   int run() {
     struct epoll_event evs[64];
     for (;;) {
+      if (g_stop) {  // SIGTERM/SIGINT: flush durable state, then exit
+        gcs.dirty = gcs.dirty || !gcs.persist_path.empty();
+        gcs.snapshot();
+        return 0;
+      }
       // epoll timeout = nearest of (snapshot debounce, sub deadlines)
       double now = mono_s();
+      if (parent_pid > 0 && now >= next_parent_check) {
+        next_parent_check = now + 1.0;
+        if (kill(parent_pid, 0) != 0 && errno == ESRCH) {
+          gcs.snapshot();  // flush durable state before orphan exit
+          return 0;
+        }
+      }
       double next = now + 1.0;
       if (gcs.snapshot_due_mono > 0 && gcs.snapshot_due_mono < next)
         next = gcs.snapshot_due_mono;
@@ -756,6 +780,7 @@ struct Server {
       int timeout_ms = int((next - now) * 1000.0);
       if (timeout_ms < 0) timeout_ms = 0;
       int n = epoll_wait(epfd, evs, 64, timeout_ms);
+      if (n < 0 && errno == EINTR) continue;  // signal: loop re-checks g_stop
       now = mono_s();
       if (gcs.snapshot_due_mono > 0 && now >= gcs.snapshot_due_mono)
         gcs.snapshot();
@@ -786,12 +811,14 @@ struct Server {
 int main(int argc, char** argv) {
   std::string bind_addr, advertise_file, persist;
   double death_timeout = 5.0;
+  int parent_pid_arg = 0;
   for (int i = 1; i < argc - 1; ++i) {
     std::string a = argv[i];
     if (a == "--bind") bind_addr = argv[++i];
     else if (a == "--advertise-file") advertise_file = argv[++i];
     else if (a == "--persist") persist = argv[++i];
     else if (a == "--death-timeout-s") death_timeout = atof(argv[++i]);
+    else if (a == "--parent-pid") parent_pid_arg = atoi(argv[++i]);
   }
   if (bind_addr.empty()) {
     fprintf(stderr, "usage: gcs_server --bind <unix path|host:port> "
@@ -800,8 +827,14 @@ int main(int argc, char** argv) {
     return 2;
   }
   signal(SIGPIPE, SIG_IGN);
+  struct sigaction sa_stop;
+  memset(&sa_stop, 0, sizeof sa_stop);
+  sa_stop.sa_handler = on_stop_signal;  // no SA_RESTART: epoll must EINTR
+  sigaction(SIGTERM, &sa_stop, nullptr);
+  sigaction(SIGINT, &sa_stop, nullptr);
 
   Server srv;
+  srv.parent_pid = parent_pid_arg;
   srv.gcs.death_timeout_s = death_timeout;
   srv.gcs.persist_path = persist;
   if (!persist.empty()) srv.gcs.restore();

@@ -36,6 +36,44 @@ from ray_tpu.ops.attention import NEG_INF, flash_attention, repeat_kv_heads
 from ray_tpu.parallel.sharding import to_partition_spec
 
 
+def _shard_positions(idx, s_loc: int, sp: int, layout: str):
+    """Global sequence positions held by ring shard ``idx``.
+
+    contiguous: shard i holds [i*s_loc, (i+1)*s_loc).
+    zigzag: shard i holds the PAIR of chunks (i, 2*sp-1-i), each of size
+    s_loc/2 — the standard fix for causal ring imbalance: every shard owns
+    one early chunk and one late chunk, so the unmasked area each shard
+    computes per ring step is near-uniform (spread <= 1 block instead of
+    sp-1; see tests/test_ring_attention.py balance test).
+    """
+    if layout == "zigzag":
+        c = s_loc // 2
+        lo = idx * c + jnp.arange(c)
+        hi = (2 * sp - 1 - idx) * c + jnp.arange(c)
+        return jnp.concatenate([lo, hi])
+    return idx * s_loc + jnp.arange(s_loc)
+
+
+def zigzag_permutation(seq: int, sp: int):
+    """Index arrays mapping contiguous -> zigzag layout and back.
+
+    zigzag layout order: shard 0's chunks (0, 2sp-1), shard 1's (1, 2sp-2),
+    ...  ``perm`` gathers a contiguous-layout sequence axis into zigzag
+    order (``x_zig = x[:, perm]``); ``inv`` undoes it.
+    """
+    import numpy as np
+
+    c = seq // (2 * sp)
+    order = []
+    for i in range(sp):
+        order.append(np.arange(i * c, (i + 1) * c))
+        order.append(np.arange((2 * sp - 1 - i) * c, (2 * sp - i) * c))
+    perm = np.concatenate(order)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(seq)
+    return perm, inv
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -44,17 +82,17 @@ def ring_attention(
     *,
     causal: bool = True,
     sm_scale: Optional[float] = None,
+    layout: str = "contiguous",  # contiguous | zigzag
 ) -> jax.Array:
     """Ring attention over the ``axis_name`` device ring.
 
     Must be called inside ``shard_map``.  Local shapes: q/k/v
     (batch, seq_local, heads, head_dim) — k/v may have fewer (GQA) heads.
-    Global sequence = seq_local * ring size; shard i holds positions
-    [i*seq_local, (i+1)*seq_local).
-
-    Note: with plain contiguous sharding and ``causal=True`` the ring is
-    load-imbalanced (shard 0 masks most steps); zigzag re-indexing is a
-    future optimization — correctness here is exact either way.
+    Global sequence = seq_local * ring size.  ``layout`` names how global
+    positions map onto shards (see _shard_positions): "zigzag" balances
+    causal work across the ring and is what sequence_parallel_attention's
+    ``impl="zigzag"`` uses; correctness is exact for both layouts (masks
+    compare true global positions).
     """
     sp = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -63,7 +101,7 @@ def ring_attention(
         sm_scale = 1.0 / math.sqrt(d)
 
     qf = q.astype(jnp.float32) * sm_scale
-    rows = idx * s_loc + jnp.arange(s_loc)  # global q positions
+    rows = _shard_positions(idx, s_loc, sp, layout)  # global q positions
 
     # KV rotates "upward": device i sends to i+1, so after t steps device i
     # holds the shard originally at (i - t) mod sp.  GQA K/V rotate in their
@@ -77,7 +115,7 @@ def ring_attention(
         k_rep, v_rep = repeat_kv_heads(k_cur, v_cur, h)
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_rep.astype(jnp.float32))
         if causal:
-            cols = src * s_loc + jnp.arange(s_loc)
+            cols = _shard_positions(src, s_loc, sp, layout)
             mask = rows[:, None] >= cols[None, :]
             s = jnp.where(mask[None, None], s, NEG_INF)
         m_cur = jnp.max(s, axis=-1)  # (b, h, q)
@@ -175,6 +213,14 @@ def sequence_parallel_attention(
     kv_heads, head_dim).  Batch/heads follow the logical sharding rules
     (batch over dp+fsdp, heads over tp); sequence is sharded over ``sp``.
     Falls back to plain flash attention when the sp axis has size 1.
+
+    impl="zigzag": causal-balanced ring.  Inputs arrive in natural
+    (contiguous) sequence order; a global zigzag gather re-shards them so
+    every ring shard holds one early + one late chunk, the balanced ring
+    runs, and the inverse gather restores natural order.  Trainers that
+    keep activations in zigzag layout end-to-end (permute once at the
+    embedding, with zigzag position ids for RoPE) can call ring_attention
+    with layout="zigzag" directly and skip both gathers.
     """
     if mesh.shape.get(sp_axis, 1) == 1:
         return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
@@ -183,16 +229,29 @@ def sequence_parallel_attention(
     kv_spec = to_partition_spec(("batch", "seq", "kv_heads", "head_dim"),
                                 rules)
 
+    if impl == "zigzag":
+        sp = mesh.shape[sp_axis]
+        seq = q.shape[1]
+        if seq % (2 * sp) != 0:
+            raise ValueError(
+                f"zigzag needs seq ({seq}) % 2*sp ({2 * sp}) == 0")
+        perm, inv = zigzag_permutation(seq, sp)
+        q, k, v = (jnp.take(x, perm, axis=1) for x in (q, k, v))
+
     def local(ql, kl, vl):
         if impl == "ulysses":
             return ulysses_attention(ql, kl, vl, sp_axis, causal=causal,
                                      sm_scale=sm_scale)
-        return ring_attention(ql, kl, vl, sp_axis, causal=causal,
-                              sm_scale=sm_scale)
+        return ring_attention(
+            ql, kl, vl, sp_axis, causal=causal, sm_scale=sm_scale,
+            layout="zigzag" if impl == "zigzag" else "contiguous")
 
-    return jax.shard_map(
+    out = jax.shard_map(
         local, mesh=mesh,
         in_specs=(q_spec, kv_spec, kv_spec),
         out_specs=q_spec,
         check_vma=False,
     )(q, k, v)
+    if impl == "zigzag":
+        out = jnp.take(out, inv, axis=1)
+    return out

@@ -107,11 +107,17 @@ class EnvRunner:
         }
 
     def sample_transitions(self, params, num_steps: int,
-                           epsilon: float = 0.0) -> Dict[str, np.ndarray]:
-        """Off-policy collection (DQN): epsilon-greedy over Q = logits head.
+                           epsilon: float = 0.0,
+                           policy: str = "greedy") -> Dict[str, np.ndarray]:
+        """Off-policy collection: flat transition tuples for replay buffers.
 
-        Returns flat transition tuples ({obs, actions, rewards, next_obs,
-        dones}, each [num_steps * n_envs, ...]) ready for a replay buffer.
+        policy="greedy": epsilon-greedy over Q = logits head (DQN).
+        policy="softmax": sample from the Boltzmann policy over the logits
+        head (discrete SAC — exploration comes from the learned entropy,
+        not epsilon).
+
+        Returns {obs, actions, rewards, next_obs, dones}, each
+        [num_steps * n_envs, ...].
         """
         n = len(self._envs)
         rng = np.random.default_rng(self._seed * 77003 + self._steps)
@@ -119,10 +125,18 @@ class EnvRunner:
         for _ in range(num_steps):
             obs = np.stack(self._obs).astype(np.float32)
             q, _ = module_mod.forward(params, obs)
-            action = np.asarray(np.argmax(np.asarray(q), axis=-1))
-            explore = rng.random(n) < epsilon
-            action = np.where(
-                explore, rng.integers(0, q.shape[-1], size=n), action)
+            q = np.asarray(q)
+            if policy == "softmax":
+                z = q - q.max(axis=-1, keepdims=True)
+                p = np.exp(z)
+                p /= p.sum(axis=-1, keepdims=True)
+                action = np.array([rng.choice(q.shape[-1], p=p[i])
+                                   for i in range(n)])
+            else:
+                action = np.asarray(np.argmax(q, axis=-1))
+                explore = rng.random(n) < epsilon
+                action = np.where(
+                    explore, rng.integers(0, q.shape[-1], size=n), action)
             for i, env in enumerate(self._envs):
                 nobs, r, term, trunc, _ = env.step(int(action[i]))
                 self._ep_return[i] += float(r)

@@ -1,0 +1,270 @@
+"""MARWIL: offline RL via advantage-weighted behavior cloning.
+
+Counterpart of /root/reference/rllib/algorithms/marwil/ (MARWILConfig, the
+torch learner's exp(beta * A / c) * -logp loss with the moving advantage
+normalizer, plus rllib/offline/ for dataset input).  beta=0 degrades to
+plain behavior cloning — the reference's BC algorithm subclasses MARWIL the
+same way.  TPU-shaping: the update (MC-return advantages precomputed on
+host once; per-batch value MSE + weighted -logp + adam) is one jitted
+function over fixed [batch] shapes.
+
+Offline data is a list of episode dicts {obs, actions, rewards} (numpy) —
+produced by ``collect_episodes`` (any policy callable), loaded from JSONL
+via ``episodes_from_jsonl``, or converted from a ray_tpu.data Dataset of
+transition rows via ``episodes_from_dataset``.
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, List, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib import module as module_mod
+
+
+# ---------------------------------------------------------------------------
+# Offline data helpers (reference: rllib/offline/offline_data.py)
+# ---------------------------------------------------------------------------
+
+def collect_episodes(env_maker: Union[str, Callable],
+                     policy: Callable[[np.ndarray], int],
+                     n_episodes: int, seed: int = 0,
+                     max_steps: int = 500) -> List[Dict[str, np.ndarray]]:
+    """Roll a behavior policy (any obs -> action callable) into episodes."""
+    import gymnasium as gym
+
+    env = gym.make(env_maker) if isinstance(env_maker, str) else env_maker()
+    episodes = []
+    for ep in range(n_episodes):
+        obs, _ = env.reset(seed=seed + ep)
+        O, A, R = [], [], []
+        for _ in range(max_steps):
+            a = int(policy(np.asarray(obs, np.float32)))
+            O.append(np.asarray(obs, np.float32))
+            A.append(a)
+            obs, r, term, trunc, _ = env.step(a)
+            R.append(float(r))
+            if term or trunc:
+                break
+        episodes.append({"obs": np.stack(O),
+                         "actions": np.asarray(A, np.int32),
+                         "rewards": np.asarray(R, np.float32)})
+    return episodes
+
+
+def episodes_from_jsonl(path: str) -> List[Dict[str, np.ndarray]]:
+    """One JSON object per line: {"obs": [[...]], "actions": [...],
+    "rewards": [...]} (the reference's SampleBatch JSON shape, minimally)."""
+    episodes = []
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            row = json.loads(line)
+            episodes.append({
+                "obs": np.asarray(row["obs"], np.float32),
+                "actions": np.asarray(row["actions"], np.int32),
+                "rewards": np.asarray(row["rewards"], np.float32),
+            })
+    return episodes
+
+
+def episodes_from_dataset(ds) -> List[Dict[str, np.ndarray]]:
+    """ray_tpu.data Dataset of {"episode_id", "obs", "action", "reward"}
+    rows -> episode dicts (offline pipelines write transition rows)."""
+    by_ep: Dict[Any, list] = {}
+    for row in ds.iter_rows():
+        by_ep.setdefault(row["episode_id"], []).append(row)
+    episodes = []
+    for rows in by_ep.values():
+        episodes.append({
+            "obs": np.stack([np.asarray(r["obs"], np.float32)
+                             for r in rows]),
+            "actions": np.asarray([r["action"] for r in rows], np.int32),
+            "rewards": np.asarray([r["reward"] for r in rows], np.float32),
+        })
+    return episodes
+
+
+# ---------------------------------------------------------------------------
+# Algorithm
+# ---------------------------------------------------------------------------
+
+@dataclass
+class MARWILConfig:
+    """Reference: rllib/algorithms/marwil/marwil.py MARWILConfig."""
+
+    env: Union[str, Callable] = "CartPole-v1"
+    episodes: List[Dict[str, np.ndarray]] = None  # offline input (required)
+    beta: float = 1.0          # 0 => plain behavior cloning
+    vf_coeff: float = 1.0
+    lr: float = 5e-4
+    grad_clip: float = 10.0
+    gamma: float = 0.99
+    train_batch_size: int = 256
+    num_updates_per_iter: int = 32
+    max_weight: float = 20.0   # exp-weight clip (reference clips at 20)
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "MARWIL":
+        if not self.episodes:
+            raise ValueError("MARWIL is offline: config.episodes required")
+        return MARWIL(self)
+
+
+@partial(jax.jit, static_argnames=("beta", "vf_coeff", "lr", "grad_clip",
+                                   "max_weight"))
+def _marwil_update(params, opt_state, ws, batch, *, beta: float,
+                   vf_coeff: float, lr: float, grad_clip: float,
+                   max_weight: float):
+    import optax
+
+    tx = optax.chain(optax.clip_by_global_norm(grad_clip), optax.adam(lr))
+
+    def loss_fn(p):
+        logits, value = module_mod.forward(p, batch["obs"])
+        logp = jax.nn.log_softmax(logits)[
+            jnp.arange(logits.shape[0]), batch["actions"].astype(jnp.int32)]
+        adv = batch["returns"] - value
+        vf_loss = jnp.mean(adv ** 2)
+        # moving normalizer c^2 <- c^2 + 1e-8 * (E[adv^2] - c^2); weights
+        # use the PRE-update normalizer, like the reference learner
+        adv_sg = jax.lax.stop_gradient(adv)
+        new_ws = ws + 1e-8 * (jnp.mean(adv_sg ** 2) - ws)
+        weight = jnp.exp(beta * adv_sg / jnp.sqrt(ws + 1e-8))
+        weight = jnp.minimum(weight, max_weight)
+        pi_loss = -jnp.mean(weight * logp)
+        return pi_loss + vf_coeff * vf_loss, (pi_loss, vf_loss, new_ws)
+
+    (loss, (pi_loss, vf_loss, new_ws)), grads = jax.value_and_grad(
+        loss_fn, has_aux=True)(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, new_ws, loss, pi_loss, vf_loss
+
+
+class MARWIL:
+    """Tune-compatible trainable over a fixed offline dataset."""
+
+    def __init__(self, config: MARWILConfig):
+        import optax
+
+        self.config = config
+        # flatten episodes into transition arrays with MC returns
+        obs, actions, returns = [], [], []
+        for ep in config.episodes:
+            R = np.zeros(len(ep["rewards"]), np.float32)
+            acc = 0.0
+            for t in range(len(ep["rewards"]) - 1, -1, -1):
+                acc = ep["rewards"][t] + config.gamma * acc
+                R[t] = acc
+            obs.append(ep["obs"])
+            actions.append(ep["actions"])
+            returns.append(R)
+        self._obs = np.concatenate(obs).astype(np.float32)
+        self._actions = np.concatenate(actions).astype(np.int32)
+        self._returns = np.concatenate(returns).astype(np.float32)
+        # Standardize the value-regression targets: raw discounted returns
+        # reach ~1/(1-gamma) and their squared error would dominate the
+        # SHARED torso's gradients, crushing the policy head (the torch
+        # reference survives via grad clipping + small lr; with a tanh
+        # torso the scale must be fixed at the source).  Advantages are
+        # computed in the same standardized space, which also puts the
+        # exp(beta * adv) weights on a sane scale from step one.
+        mu, sd = float(self._returns.mean()), float(self._returns.std())
+        self._returns = (self._returns - mu) / (sd if sd > 1e-6 else 1.0)
+        obs_dim = self._obs.shape[1]
+        n_actions = int(self._actions.max()) + 1
+        if isinstance(config.env, str) or callable(config.env):
+            # prefer the env's action space when available (eval needs it)
+            try:
+                import gymnasium as gym
+
+                env = (gym.make(config.env)
+                       if isinstance(config.env, str) else config.env())
+                n_actions = int(env.action_space.n)
+                env.close()
+            except Exception:
+                pass
+        mcfg = module_mod.MLPConfig(obs_dim=obs_dim, n_actions=n_actions,
+                                    hidden=config.hidden)
+        self.params = module_mod.init_mlp(
+            mcfg, jax.random.PRNGKey(config.seed))
+        tx = optax.chain(optax.clip_by_global_norm(config.grad_clip),
+                         optax.adam(config.lr))
+        self.opt_state = tx.init(self.params)
+        self.ws = jnp.asarray(1.0)  # advantage moving normalizer c^2
+        self._rng = np.random.default_rng(config.seed)
+        self._iter = 0
+
+    def train(self) -> Dict[str, Any]:
+        c = self.config
+        t0 = time.perf_counter()
+        losses, pi_losses, vf_losses = [], [], []
+        n = len(self._obs)
+        for _ in range(c.num_updates_per_iter):
+            idx = self._rng.integers(0, n, size=min(c.train_batch_size, n))
+            batch = {"obs": jnp.asarray(self._obs[idx]),
+                     "actions": jnp.asarray(self._actions[idx]),
+                     "returns": jnp.asarray(self._returns[idx])}
+            (self.params, self.opt_state, self.ws, loss, pi_loss,
+             vf_loss) = _marwil_update(
+                self.params, self.opt_state, self.ws, batch, beta=c.beta,
+                vf_coeff=c.vf_coeff, lr=c.lr, grad_clip=c.grad_clip,
+                max_weight=c.max_weight)
+            losses.append(float(loss))
+            pi_losses.append(float(pi_loss))
+            vf_losses.append(float(vf_loss))
+        self._iter += 1
+        return {
+            "training_iteration": self._iter,
+            "loss": float(np.mean(losses)),
+            "pi_loss": float(np.mean(pi_losses)),
+            "vf_loss": float(np.mean(vf_losses)),
+            "num_transitions": n,
+            "time_this_iter_s": time.perf_counter() - t0,
+        }
+
+    def evaluate(self, n_episodes: int = 5, seed: int = 123) -> float:
+        """Greedy rollouts in the real env; returns mean episode return."""
+        import gymnasium as gym
+
+        c = self.config
+        env = gym.make(c.env) if isinstance(c.env, str) else c.env()
+        total = []
+        for ep in range(n_episodes):
+            obs, _ = env.reset(seed=seed + ep)
+            ret, done = 0.0, False
+            while not done:
+                a = int(np.asarray(module_mod.greedy_action(
+                    self.params, np.asarray(obs, np.float32)[None]))[0])
+                obs, r, term, trunc, _ = env.step(a)
+                ret += float(r)
+                done = term or trunc
+            total.append(ret)
+        env.close()
+        return float(np.mean(total))
+
+    # -- checkpointing ------------------------------------------------------
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            pickle.dump({"params": self.params, "opt_state": self.opt_state,
+                         "ws": self.ws, "iter": self._iter}, f)
+
+    def restore(self, path: str) -> None:
+        with open(path, "rb") as f:
+            st = pickle.load(f)
+        self.params, self.opt_state = st["params"], st["opt_state"]
+        self.ws, self._iter = st["ws"], st["iter"]
+
+    def stop(self) -> None:
+        pass

@@ -1,0 +1,257 @@
+"""SAC (discrete): twin soft-Q + entropy-regularized policy, one jitted update.
+
+Counterpart of /root/reference/rllib/algorithms/sac/ (SACConfig, the torch
+learner's twin-Q/policy/alpha losses, target network polyak sync) in its
+discrete-action form (soft Q over action enumeration instead of a
+reparameterized Gaussian — the standard discrete-SAC formulation).
+TPU-shaping, same stance as dqn.py: the entire update — twin-Q targets with
+policy-expectation bootstrapping, policy KL-to-Boltzmann loss, automatic
+temperature tuning, polyak averaging, three adam chains — is ONE jitted
+function over fixed [batch] shapes.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib import module as module_mod
+from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.replay_buffers import ReplayBuffer
+
+
+@dataclass
+class SACConfig:
+    """Reference: rllib/algorithms/sac/sac.py SACConfig.training() args."""
+
+    env: Union[str, Callable] = "CartPole-v1"
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 2
+    rollout_fragment_length: int = 32
+    buffer_size: int = 50_000
+    learning_starts: int = 500
+    train_batch_size: int = 64
+    num_updates_per_iter: int = 16
+    gamma: float = 0.99
+    actor_lr: float = 3e-4
+    critic_lr: float = 3e-4
+    alpha_lr: float = 3e-4
+    tau: float = 0.01              # polyak target smoothing
+    initial_alpha: float = 0.2
+    # target entropy as a fraction of max entropy log(A) (reference uses
+    # the heuristic 0.98 * (-log(1/A)) for discrete SAC)
+    target_entropy_scale: float = 0.7
+    grad_clip: float = 10.0
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+def _init_q(cfg: module_mod.MLPConfig, key):
+    """Twin Q networks: independent torsos + heads (reference: SAC's twin
+    Q-function trick to damp overestimation)."""
+    k1, k2 = jax.random.split(key)
+    return {"q1": module_mod.init_mlp(cfg, k1),
+            "q2": module_mod.init_mlp(cfg, k2)}
+
+
+def _q_forward(qp, obs):
+    q1, _ = module_mod.forward(qp["q1"], obs)
+    q2, _ = module_mod.forward(qp["q2"], obs)
+    return q1, q2
+
+
+@partial(jax.jit, static_argnames=("gamma", "tau", "actor_lr", "critic_lr",
+                                   "alpha_lr", "grad_clip",
+                                   "target_entropy"))
+def _sac_update(pi_params, q_params, q_target, log_alpha,
+                pi_opt, q_opt, a_opt, batch, *,
+                gamma: float, tau: float, actor_lr: float, critic_lr: float,
+                alpha_lr: float, grad_clip: float, target_entropy: float):
+    import optax
+
+    pi_tx = optax.chain(optax.clip_by_global_norm(grad_clip),
+                        optax.adam(actor_lr))
+    q_tx = optax.chain(optax.clip_by_global_norm(grad_clip),
+                       optax.adam(critic_lr))
+    a_tx = optax.adam(alpha_lr)
+    alpha = jnp.exp(log_alpha)
+
+    # -- critic: y = r + gamma (1-d) E_{a'~pi}[min Q_t(s',a') - a log pi] --
+    logits_next, _ = module_mod.forward(pi_params, batch["next_obs"])
+    pi_next = jax.nn.softmax(logits_next)
+    logp_next = jax.nn.log_softmax(logits_next)
+    q1_t, q2_t = _q_forward(q_target, batch["next_obs"])
+    v_next = jnp.sum(pi_next * (jnp.minimum(q1_t, q2_t)
+                                - alpha * logp_next), axis=-1)
+    y = batch["rewards"] + gamma * (1.0 - batch["dones"]) \
+        * jax.lax.stop_gradient(v_next)
+    a_idx = batch["actions"][:, None].astype(jnp.int32)
+
+    def q_loss_fn(qp):
+        q1, q2 = _q_forward(qp, batch["obs"])
+        q1_sel = jnp.take_along_axis(q1, a_idx, axis=1)[:, 0]
+        q2_sel = jnp.take_along_axis(q2, a_idx, axis=1)[:, 0]
+        return jnp.mean((q1_sel - y) ** 2) + jnp.mean((q2_sel - y) ** 2)
+
+    q_loss, q_grads = jax.value_and_grad(q_loss_fn)(q_params)
+    q_updates, q_opt = q_tx.update(q_grads, q_opt, q_params)
+    q_params = optax.apply_updates(q_params, q_updates)
+
+    # -- actor: E_{s}[ E_{a~pi}[ alpha log pi(a|s) - min Q(s,a) ] ] --------
+    q1, q2 = _q_forward(q_params, batch["obs"])
+    q_min = jax.lax.stop_gradient(jnp.minimum(q1, q2))
+
+    def pi_loss_fn(pp):
+        logits, _ = module_mod.forward(pp, batch["obs"])
+        pi = jax.nn.softmax(logits)
+        logp = jax.nn.log_softmax(logits)
+        loss = jnp.mean(jnp.sum(pi * (alpha * logp - q_min), axis=-1))
+        entropy = -jnp.mean(jnp.sum(pi * logp, axis=-1))
+        return loss, entropy
+
+    (pi_loss, entropy), pi_grads = jax.value_and_grad(
+        pi_loss_fn, has_aux=True)(pi_params)
+    pi_updates, pi_opt = pi_tx.update(pi_grads, pi_opt, pi_params)
+    pi_params = optax.apply_updates(pi_params, pi_updates)
+
+    # -- temperature: drive entropy toward the target ----------------------
+    def alpha_loss_fn(la):
+        return jnp.exp(la) * jax.lax.stop_gradient(entropy - target_entropy)
+
+    a_loss, a_grad = jax.value_and_grad(alpha_loss_fn)(log_alpha)
+    a_updates, a_opt = a_tx.update(a_grad, a_opt, log_alpha)
+    log_alpha = optax.apply_updates(log_alpha, a_updates)
+
+    # -- polyak target sync -------------------------------------------------
+    q_target = jax.tree.map(lambda t, s: (1.0 - tau) * t + tau * s,
+                            q_target, q_params)
+    return (pi_params, q_params, q_target, log_alpha, pi_opt, q_opt, a_opt,
+            q_loss, pi_loss, entropy)
+
+
+class SAC:
+    """Tune-compatible trainable: train() -> result dict."""
+
+    def __init__(self, config: SACConfig):
+        import optax
+
+        self.config = config
+        RunnerActor = ray_tpu.remote(EnvRunner)
+        self._runners = [
+            RunnerActor.remote(config.env, config.num_envs_per_runner,
+                               seed=config.seed + 1000 * i)
+            for i in range(config.num_env_runners)
+        ]
+        spec = ray_tpu.get(self._runners[0].env_spec.remote())
+        mcfg = module_mod.MLPConfig(
+            obs_dim=spec["obs_dim"], n_actions=spec["n_actions"],
+            hidden=config.hidden)
+        key = jax.random.PRNGKey(config.seed)
+        kp, kq = jax.random.split(key)
+        self.pi_params = module_mod.init_mlp(mcfg, kp)
+        self.q_params = _init_q(mcfg, kq)
+        self.q_target = jax.tree.map(jnp.copy, self.q_params)
+        self.log_alpha = jnp.asarray(float(np.log(config.initial_alpha)))
+        self.target_entropy = float(
+            config.target_entropy_scale * np.log(spec["n_actions"]))
+        pi_tx = optax.chain(optax.clip_by_global_norm(config.grad_clip),
+                            optax.adam(config.actor_lr))
+        q_tx = optax.chain(optax.clip_by_global_norm(config.grad_clip),
+                           optax.adam(config.critic_lr))
+        self.pi_opt = pi_tx.init(self.pi_params)
+        self.q_opt = q_tx.init(self.q_params)
+        self.a_opt = optax.adam(config.alpha_lr).init(self.log_alpha)
+        self.buffer = ReplayBuffer(config.buffer_size, seed=config.seed)
+        self._env_steps = 0
+        self._iter = 0
+
+    def train(self) -> Dict[str, Any]:
+        c = self.config
+        t0 = time.perf_counter()
+        # on-policy-ish exploration: sample from the softmax policy
+        batches = ray_tpu.get([
+            r.sample_transitions.remote(self.pi_params,
+                                        c.rollout_fragment_length,
+                                        0.0, "softmax")
+            for r in self._runners
+        ])
+        for b in batches:
+            self.buffer.add(b)
+            self._env_steps += len(b["rewards"])
+
+        q_losses, pi_losses, entropies = [], [], []
+        n_updates = 0
+        if len(self.buffer) >= max(c.learning_starts, c.train_batch_size):
+            for _ in range(c.num_updates_per_iter):
+                s = self.buffer.sample(c.train_batch_size)
+                batch = {k: jnp.asarray(s[k])
+                         for k in ("obs", "actions", "rewards", "next_obs",
+                                   "dones")}
+                (self.pi_params, self.q_params, self.q_target,
+                 self.log_alpha, self.pi_opt, self.q_opt, self.a_opt,
+                 q_loss, pi_loss, entropy) = _sac_update(
+                    self.pi_params, self.q_params, self.q_target,
+                    self.log_alpha, self.pi_opt, self.q_opt, self.a_opt,
+                    batch, gamma=c.gamma, tau=c.tau, actor_lr=c.actor_lr,
+                    critic_lr=c.critic_lr, alpha_lr=c.alpha_lr,
+                    grad_clip=c.grad_clip,
+                    target_entropy=self.target_entropy)
+                q_losses.append(float(q_loss))
+                pi_losses.append(float(pi_loss))
+                entropies.append(float(entropy))
+                n_updates += 1
+
+        metrics = ray_tpu.get(
+            [r.get_metrics.remote() for r in self._runners])
+        returns = [x for m in metrics for x in m["episode_returns"]]
+        self._iter += 1
+        return {
+            "training_iteration": self._iter,
+            "env_steps_sampled": self._env_steps,
+            "num_updates": n_updates,
+            "alpha": float(jnp.exp(self.log_alpha)),
+            "entropy": float(np.mean(entropies)) if entropies else None,
+            "q_loss": float(np.mean(q_losses)) if q_losses else None,
+            "pi_loss": float(np.mean(pi_losses)) if pi_losses else None,
+            "episode_return_mean": (float(np.mean(returns))
+                                    if returns else None),
+            "buffer_size": len(self.buffer),
+            "time_this_iter_s": time.perf_counter() - t0,
+        }
+
+    # -- checkpointing (Tune/Checkpointable parity) ------------------------
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            pickle.dump({
+                "pi_params": self.pi_params, "q_params": self.q_params,
+                "q_target": self.q_target, "log_alpha": self.log_alpha,
+                "pi_opt": self.pi_opt, "q_opt": self.q_opt,
+                "a_opt": self.a_opt, "env_steps": self._env_steps,
+                "iter": self._iter}, f)
+
+    def restore(self, path: str) -> None:
+        with open(path, "rb") as f:
+            st = pickle.load(f)
+        self.pi_params, self.q_params = st["pi_params"], st["q_params"]
+        self.q_target, self.log_alpha = st["q_target"], st["log_alpha"]
+        self.pi_opt, self.q_opt, self.a_opt = (st["pi_opt"], st["q_opt"],
+                                               st["a_opt"])
+        self._env_steps, self._iter = st["env_steps"], st["iter"]
+
+    def stop(self) -> None:
+        for r in self._runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

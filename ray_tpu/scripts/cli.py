@@ -76,6 +76,17 @@ def cmd_status(args):
     st = _rpc(sock, "cluster_state")
     print(f"Pending tasks (head): {st['pending_tasks']}; "
           f"workers: {st['num_workers']} ({st['num_idle']} idle)")
+    # Effective config (reference: RayConfig dump): non-default flags
+    # first, then a count of defaults, from the central registry.
+    from ray_tpu._private import flags as flags_mod
+
+    rows = flags_mod.describe()
+    set_rows = [r for r in rows if r["set"]]
+    print(f"Config: {len(set_rows)} flags set, "
+          f"{len(rows) - len(set_rows)} at defaults "
+          f"(_private/flags.py registry)")
+    for r in set_rows:
+        print(f"  {r['name']}={r['value']!r}")
 
 
 def cmd_memory(args):

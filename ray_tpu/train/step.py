@@ -67,7 +67,7 @@ def make_train_step(
         loss_kwargs = {}
         if attn_impl is not None:
             loss_kwargs["attn_impl"] = attn_impl
-        if attn_impl in ("ring", "ulysses"):
+        if attn_impl in ("ring", "zigzag", "ulysses"):
             loss_kwargs.update(mesh=mesh, rules=rules)
         loss = lambda p, b: model.loss_fn(p, b, cfg, **loss_kwargs)  # noqa: E731
     else:

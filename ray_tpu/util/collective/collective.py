@@ -117,6 +117,32 @@ def _kv_del(key: str):
 
 def _wait_kv(key: str, timeout: float) -> bytes:
     deadline = time.monotonic() + timeout
+    w = _ctx()
+    if w.gcs_address:
+        # Event-driven wait: subscribe to the collective KV channel and
+        # sleep until the key's write event arrives (VERDICT round-2: the
+        # 2ms rendezvous spin burned the very core the control plane runs
+        # on).  Register BEFORE checking so a write between check and wait
+        # cannot be lost; periodic re-checks guard against a dropped event
+        # ring (gap wakes handle the common case).
+        from ray_tpu._private import kv_watch
+
+        watcher = kv_watch.get_watcher(w.gcs_address, _KV_NS)
+        ev = watcher.register(key.encode())
+        try:
+            while True:
+                v = _kv_get(key)
+                if v is not None:
+                    return v
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"collective rendezvous timed out on {key!r}")
+                ev.wait(min(remaining, 2.0))
+                ev.clear()
+        finally:
+            watcher.unregister(key.encode(), ev)
+    # no GCS endpoint in this process (minimal embedded contexts): poll
     while True:
         v = _kv_get(key)
         if v is not None:

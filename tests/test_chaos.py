@@ -42,3 +42,113 @@ def test_tasks_survive_rpc_chaos():
                           env=env, cwd="/root/repo")
     assert proc.returncode == 0, proc.stderr[-4000:]
     assert "CHAOS SURVIVED" in proc.stdout
+
+
+def test_per_method_chaos_parsing_and_counting():
+    """The scoped form injects at most max_failures failures, scoped to
+    the named method only (reference: rpc_chaos.h per-method scoping)."""
+    from ray_tpu._private import protocol
+
+    spec = protocol._parse_chaos.__wrapped__ if hasattr(
+        protocol._parse_chaos, "__wrapped__") else None
+    # parse directly via a temporary env
+    import os
+    old = os.environ.get("RTPU_TESTING_RPC_FAILURE")
+    try:
+        os.environ["RTPU_TESTING_RPC_FAILURE"] = \
+            "kv_get=2:0:100,pull=-1:50:0,3:4"
+        gs, gr, methods = protocol._parse_chaos()
+        assert (gs, gr) == (0.03, 0.04)
+        assert methods["kv_get"] == [2, 0.0, 1.0]
+        assert methods["pull"] == [-1, 0.5, 0.0]
+    finally:
+        if old is None:
+            os.environ.pop("RTPU_TESTING_RPC_FAILURE", None)
+        else:
+            os.environ["RTPU_TESTING_RPC_FAILURE"] = old
+
+    # counting: patch the live table — exactly 2 kv_get resp failures fire
+    orig = dict(protocol._CHAOS_METHODS)
+    try:
+        protocol._CHAOS_METHODS.clear()
+        protocol._CHAOS_METHODS["kv_get"] = [2, 0.0, 1.0]
+        fails = [protocol.chaos_should_fail("kv_get", "resp")
+                 for _ in range(10)]
+        assert sum(fails) == 2 and fails[0] and fails[1]
+        assert not protocol.chaos_should_fail("kv_put", "resp")
+        assert not protocol.chaos_should_fail("kv_get", "req")
+    finally:
+        protocol._CHAOS_METHODS.clear()
+        protocol._CHAOS_METHODS.update(orig)
+
+
+def test_gcs_client_survives_scoped_response_drops(tmp_path):
+    """Drop the first 2 kv_get responses: the client's reconnect path
+    absorbs the first, the caller sees the second as a transport error,
+    and the third call succeeds — the targeted-failure shape the
+    reference's per-method chaos enables."""
+    from ray_tpu._private import protocol
+    from ray_tpu._private.gcs import Gcs, GcsClient, GcsServer
+
+    gcs = Gcs()
+    server = GcsServer(gcs, str(tmp_path / "gcs.sock"))
+    orig = dict(protocol._CHAOS_METHODS)
+    try:
+        client = GcsClient(server.socket_path)
+        client.kv_put("ns", b"k", b"v")
+        protocol._CHAOS_METHODS.clear()
+        protocol._CHAOS_METHODS["kv_get"] = [2, 0.0, 1.0]
+        survived = 0
+        for _ in range(4):
+            try:
+                assert client.kv_get("ns", b"k") == b"v"
+                survived += 1
+            except (ConnectionError, OSError):
+                pass
+        assert survived >= 2  # budget exhausted -> calls succeed again
+        assert protocol._CHAOS_METHODS["kv_get"][0] == 0
+    finally:
+        protocol._CHAOS_METHODS.clear()
+        protocol._CHAOS_METHODS.update(orig)
+        server.shutdown()
+
+
+def test_cluster_survives_scoped_pull_chaos():
+    """Scope chaos to the object-transfer path ('pull' + 'fetch_object'):
+    cross-node gets still complete because pulls are re-requested."""
+    script = textwrap.dedent("""
+        import ray_tpu
+        from ray_tpu.cluster_utils import Cluster
+
+        cluster = Cluster(initialize_head=True,
+                          head_node_args={"resources": {"CPU": 1.0},
+                                          "min_workers": 1,
+                                          "max_workers": 2})
+        cluster.add_node(resources={"CPU": 4.0}, min_workers=1,
+                         max_workers=3)
+        ray_tpu.init(address=cluster.gcs_address)
+
+        @ray_tpu.remote(resources={"CPU": 1.0})
+        def produce():
+            return bytes(2_000_000)
+
+        refs = [produce.options(max_retries=10).remote() for _ in range(4)]
+        got = ray_tpu.get(refs, timeout=180)
+        assert all(len(g) == 2_000_000 for g in got)
+        print("PULL CHAOS SURVIVED")
+        ray_tpu.shutdown()
+        cluster.shutdown()
+    """)
+    env = {
+        # first 3 pull requests + 3 fetch_object requests vanish
+        "RTPU_TESTING_RPC_FAILURE": "pull=3:100:0,fetch_object=3:100:0",
+        "JAX_PLATFORMS": "cpu",
+        "PATH": "/usr/bin:/bin:/usr/local/bin",
+        "PYTHONPATH": ".",
+        "HOME": "/root",
+    }
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=400,
+                          env=env, cwd="/root/repo")
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "PULL CHAOS SURVIVED" in proc.stdout

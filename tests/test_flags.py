@@ -1,0 +1,91 @@
+"""Flag registry: completeness contract + typed reads + propagation set.
+
+The registry (reference: the RAY_CONFIG X-macro table,
+src/ray/common/ray_config_def.h) is only useful if it can't drift: the
+completeness test greps the source tree for env-var reads and fails on any
+RTPU_*/RAY_TPU_* variable not in the table.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+from ray_tpu._private import flags
+
+
+def test_every_env_read_is_registered():
+    root = os.path.join(os.path.dirname(__file__), "..", "ray_tpu")
+    pat = re.compile(r"environ(?:\.get\(|\.setdefault\(|\[)\s*\"((?:RTPU|RAY_TPU)_[A-Z0-9_]+)\"")
+    found = set()
+    for dirpath, _, files in os.walk(root):
+        if "__pycache__" in dirpath:
+            continue
+        for f in files:
+            if f.endswith(".py"):
+                src = open(os.path.join(dirpath, f)).read()
+                found |= set(pat.findall(src))
+    unregistered = found - set(flags.FLAGS)
+    assert not unregistered, (
+        f"env vars read but not in the flag registry: {sorted(unregistered)}"
+        " — add them to _private/flags.py FLAGS")
+
+
+def test_typed_reads(monkeypatch):
+    monkeypatch.delenv("RTPU_INLINE_MAX", raising=False)
+    assert flags.get("RTPU_INLINE_MAX") == 100 * 1024
+    monkeypatch.setenv("RTPU_INLINE_MAX", "12345")
+    assert flags.get("RTPU_INLINE_MAX") == 12345
+    monkeypatch.setenv("RTPU_INLINE_MAX", "not-a-number")
+    assert flags.get("RTPU_INLINE_MAX") == 100 * 1024  # default on garbage
+    monkeypatch.setenv("RTPU_LOG_TO_DRIVER", "0")
+    assert flags.get("RTPU_LOG_TO_DRIVER") is False
+    monkeypatch.setenv("RTPU_LOG_TO_DRIVER", "1")
+    assert flags.get("RTPU_LOG_TO_DRIVER") is True
+
+
+def test_explicit_excludes_process_local(monkeypatch):
+    monkeypatch.setenv("RTPU_NODE_DEATH_TIMEOUT_S", "9.5")
+    monkeypatch.setenv("RAY_TPU_WORKER_ID", "aabb")
+    monkeypatch.setenv("RTPU_GCS_ADDRESS", "/tmp/x.sock")
+    exp = flags.explicit()
+    assert exp.get("RTPU_NODE_DEATH_TIMEOUT_S") == "9.5"
+    assert "RAY_TPU_WORKER_ID" not in exp
+    assert "RTPU_GCS_ADDRESS" not in exp
+
+
+def test_describe_covers_all_flags():
+    rows = flags.describe()
+    assert {r["name"] for r in rows} == set(flags.FLAGS)
+    assert all(r["doc"] for r in rows)
+
+
+def test_cluster_flag_propagation_to_joining_node():
+    """A head's explicitly-set flags reach nodes that join over the GCS —
+    the _system_config propagation path (reference: ray.init
+    _system_config serialized to every raylet)."""
+    script = r"""
+import os
+os.environ["RTPU_NODE_DEATH_TIMEOUT_S"] = "7.25"
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+cluster = Cluster(initialize_head=True,
+                  head_node_args={"min_workers": 0, "max_workers": 2})
+head = cluster.head_node
+blob = head.gcs.kv_get("config", b"flags")
+assert blob is not None, "head did not publish flags"
+# a joining node adopts the cluster value unless locally overridden
+os.environ.pop("RTPU_NODE_DEATH_TIMEOUT_S")
+node = cluster.add_node(min_workers=0, max_workers=2)
+assert os.environ.get("RTPU_NODE_DEATH_TIMEOUT_S") == "7.25", \
+    os.environ.get("RTPU_NODE_DEATH_TIMEOUT_S")
+print("FLAGS-PROPAGATED")
+cluster.shutdown()
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=180,
+                          env=env, cwd="/root/repo")
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "FLAGS-PROPAGATED" in proc.stdout

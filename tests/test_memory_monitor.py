@@ -1,0 +1,135 @@
+"""Memory monitor + worker-killing policy.
+
+VERDICT round-2 item 6 (reference: src/ray/common/memory_monitor.h:52 +
+raylet worker_killing_policy_retriable_fifo.cc): memory pressure kills ONE
+policy-chosen worker — a retriable task retries transparently, a
+non-retriable one surfaces OutOfMemoryError with provenance — and the node
+(scheduler + store daemon) survives.  Pressure is injected by driving the
+scheduler's handler directly, the same way the reference unit-tests its
+killing policies without real OOM.
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.memory_monitor import (
+    MemoryMonitor,
+    choose_victim,
+    node_memory_usage,
+    process_rss,
+)
+from ray_tpu.exceptions import OutOfMemoryError
+
+
+class _W:
+    def __init__(self, alive=True, in_flight=(), actor=None, proc=object()):
+        self.alive = alive
+        self.in_flight = {i: s for i, s in enumerate(in_flight)}
+        self.actor_id = actor
+        self.proc = proc
+
+
+class _Spec:
+    def __init__(self, retries_left=0, kind="TASK"):
+        self.retries_left = retries_left
+        self.kind = kind
+
+
+def test_choose_victim_prefers_retriable_plain_workers():
+    retriable = _W(in_flight=[_Spec(retries_left=3)])
+    plain = _W(in_flight=[_Spec(retries_left=0)])
+    actor = _W(in_flight=[_Spec(retries_left=3)], actor=b"a1")
+    idle = _W(in_flight=[])
+    dead = _W(alive=False, in_flight=[_Spec(retries_left=3)])
+    assert choose_victim([actor, plain, retriable, idle, dead]) is retriable
+    # no retriable plain worker: non-retriable plain beats actors
+    assert choose_victim([actor, plain, idle]) is plain
+    # actors are last resort
+    assert choose_victim([actor, idle]) is actor
+    # nothing killable
+    assert choose_victim([idle, dead]) is None
+
+
+def test_node_memory_and_rss_sane():
+    used, total = node_memory_usage()
+    assert 0 < used <= total
+    import os
+
+    assert process_rss(os.getpid()) > 1 << 20  # this interpreter > 1MB
+
+
+def test_monitor_fires_above_threshold_with_cooldown():
+    calls = []
+    usage = {"v": (50, 100)}
+    mon = MemoryMonitor(0.9, lambda u, t, th: calls.append((u, t)) or True,
+                        cooldown_s=10.0, usage_fn=lambda: usage["v"])
+    assert not mon.check_once()  # below threshold
+    usage["v"] = (95, 100)
+    assert mon.check_once()
+    assert not mon.check_once()  # cooldown suppresses the second kill
+    assert calls == [(95, 100)]
+
+
+def test_oom_kill_retries_task_and_preserves_node(ray_cluster):
+    """Pressure kills the worker mid-task; the task (retriable) re-runs to
+    completion and the cluster stays healthy — a targeted kill, not node
+    death."""
+    import ray_tpu.api as api
+
+    sched = api._global_node.scheduler
+    release = threading.Event()
+
+    @ray_tpu.remote
+    def slow(x):
+        import time as _t
+
+        _t.sleep(1.0)  # long enough for the pressure injection to land
+        return x * 3
+
+    ref = slow.options(max_retries=2).remote(14)
+    # wait until the task is actually running on a worker
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        with sched._lock:
+            if any(w.in_flight for w in sched._workers.values()):
+                break
+        time.sleep(0.02)
+    killed = sched._handle_memory_pressure(95 << 20, 100 << 20, 0.95)
+    assert killed, "no victim found while a task was in flight"
+    assert ray_tpu.get(ref, timeout=120) == 42  # retried transparently
+
+    @ray_tpu.remote
+    def quick():
+        return "alive"
+
+    assert ray_tpu.get(quick.remote(), timeout=60) == "alive"
+    release.set()
+
+
+def test_oom_error_carries_provenance(ray_cluster):
+    """A NON-retriable task killed under pressure fails with
+    OutOfMemoryError naming rss/node usage/threshold."""
+    import ray_tpu.api as api
+
+    sched = api._global_node.scheduler
+
+    @ray_tpu.remote
+    def hog():
+        import time as _t
+
+        _t.sleep(1.0)
+        return 1
+
+    ref = hog.options(max_retries=0).remote()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        with sched._lock:
+            if any(w.in_flight for w in sched._workers.values()):
+                break
+        time.sleep(0.02)
+    assert sched._handle_memory_pressure(97 << 20, 100 << 20, 0.95)
+    with pytest.raises(OutOfMemoryError, match="memory monitor"):
+        ray_tpu.get(ref, timeout=60)

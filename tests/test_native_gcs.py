@@ -1,0 +1,219 @@
+"""Native (C++) GCS daemon: protocol parity, pubsub, auth, persistence.
+
+The daemon (native/gcs_server.cc) is the default control plane; these tests
+exercise it directly through GcsClient/GcsSubscriber — the same surface the
+Python Gcs serves — plus the daemon-only concerns: process lifecycle,
+snapshot restore across restarts, and TCP token auth.
+"""
+
+import os
+import subprocess
+import threading
+import time
+
+import pytest
+
+from ray_tpu._private.gcs import ActorInfo, GcsClient, GcsSubscriber, NodeInfo
+from ray_tpu.native.build import binary_path
+
+
+def _spawn(tmp_path, bind=None, persist=None, env=None, death_timeout=5.0):
+    adv = str(tmp_path / f"adv.{time.monotonic_ns()}")
+    cmd = [binary_path("gcs_server"),
+           "--bind", bind or str(tmp_path / "gcs.sock"),
+           "--advertise-file", adv,
+           "--death-timeout-s", str(death_timeout)]
+    if persist:
+        cmd += ["--persist", str(persist)]
+    proc = subprocess.Popen(cmd, env=env)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if os.path.exists(adv):
+            return proc, open(adv).read().strip()
+        assert proc.poll() is None, "daemon died at startup"
+        time.sleep(0.02)
+    raise AssertionError("daemon did not advertise in 10s")
+
+
+@pytest.fixture
+def daemon(tmp_path):
+    proc, addr = _spawn(tmp_path)
+    yield addr
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+def test_table_parity(daemon):
+    c = GcsClient(daemon)
+    c.register_node(NodeInfo(node_id=b"n1", resources={"CPU": 2.0},
+                             sched_socket="/tmp/s1"))
+    c.register_actor(ActorInfo(actor_id=b"a1", name="x", max_restarts=1))
+    c.update_actor(b"a1", state="ALIVE", addr="addr1", node_id=b"n1")
+    assert c.get_actor_by_name("x").addr == "addr1"
+    assert [n.node_id for n in c.list_nodes()] == [b"n1"]
+    assert [a.actor_id for a in c.list_actors()] == [b"a1"]
+    # DEAD frees the name for reuse, like the Python Gcs
+    c.update_actor(b"a1", state="DEAD")
+    assert c.get_actor_by_name("x") is None
+    c.register_actor(ActorInfo(actor_id=b"a2", name="x"))
+    assert c.get_actor_by_name("x").actor_id == b"a2"
+
+
+def test_health_check_marks_stale_nodes(tmp_path):
+    proc, addr = _spawn(tmp_path, death_timeout=0.3)
+    try:
+        c = GcsClient(addr)
+        c.register_node(NodeInfo(node_id=b"stale", resources={}))
+        c.register_node(NodeInfo(node_id=b"head", resources={},
+                                 is_head=True))
+        time.sleep(0.5)  # no heartbeats
+        dead = c.check_node_health()
+        assert dead == [b"stale"]  # head is exempt
+        assert not c.get_node(b"stale").alive
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def test_pubsub_longpoll_wakes_subscriber(daemon):
+    sub = GcsSubscriber(daemon, ["actors"])
+    events, gap = sub.poll(0.1)
+    assert gap  # first poll establishes the cursor
+    got = []
+
+    def listen():
+        evs, _ = sub.poll(5.0)
+        got.extend(evs)
+
+    t = threading.Thread(target=listen)
+    t.start()
+    time.sleep(0.2)  # subscriber parks server-side
+    c = GcsClient(daemon)
+    start = time.monotonic()
+    c.register_actor(ActorInfo(actor_id=b"a1"))
+    t.join(timeout=5)
+    elapsed = time.monotonic() - start
+    assert got and got[0]["actor_id"] == b"a1"
+    assert elapsed < 2.0, "long-poll should wake on publish, not timeout"
+
+
+def test_pubsub_channel_filter(daemon):
+    sub = GcsSubscriber(daemon, ["kv:jobs"])
+    sub.poll(0.1)
+    c = GcsClient(daemon)
+    c.kv_put("other", b"k", b"v")  # different channel: no event
+    c.kv_put("jobs", b"job1", b"spec")
+    events, gap = sub.poll(5.0)
+    assert not gap
+    assert [e["key"] for e in events] == [b"job1"]
+
+
+def test_object_location_events(daemon):
+    sub = GcsSubscriber(daemon, ["objects"])
+    sub.poll(0.1)
+    c = GcsClient(daemon)
+    c.register_node(NodeInfo(node_id=b"n1", resources={}))
+    c.add_object_location(b"obj1", b"n1")
+    events, _ = sub.poll(5.0)
+    assert {"ch": "objects", "oid": b"obj1", "lost": False} in [
+        dict(e) for e in events]
+    # node death tombstones the object and publishes lost=True
+    c.mark_node_dead(b"n1")
+    events, _ = sub.poll(5.0)
+    assert any(e["oid"] == b"obj1" and e["lost"] for e in events)
+    assert c.object_lost(b"obj1")
+
+
+def test_persistence_across_daemon_restart(tmp_path):
+    snap = tmp_path / "snap"
+    proc, addr = _spawn(tmp_path, persist=snap)
+    c = GcsClient(addr)
+    c.register_actor(ActorInfo(actor_id=b"a1", name="keep",
+                               max_restarts=-1, class_name="C"))
+    c.update_actor(b"a1", state="ALIVE", addr="old-addr")
+    c.register_actor(ActorInfo(actor_id=b"a2", max_restarts=0))
+    c.update_actor(b"a2", state="ALIVE")
+    c.kv_put("fn", b"blob", b"\x00" * 1024)
+    c.register_pg(b"pg", [{"CPU": 1.0}], "SPREAD", [b"n"])
+    proc.terminate()  # SIGTERM path must flush the debounced snapshot
+    proc.wait(timeout=5)
+    assert snap.exists()
+
+    proc, addr = _spawn(tmp_path, persist=snap)
+    try:
+        c2 = GcsClient(addr)
+        a1 = c2.get_actor(b"a1")
+        # infinite-restart actor comes back RESTARTING with stale placement
+        # cleared; non-restartable actor comes back DEAD with its name freed
+        assert a1.state == "RESTARTING" and a1.addr is None
+        assert a1.num_restarts == 1
+        a2 = c2.get_actor(b"a2")
+        assert a2.state == "DEAD" and "not restartable" in a2.death_cause
+        assert c2.kv_get("fn", b"blob") == b"\x00" * 1024
+        assert c2.get_pg(b"pg")["strategy"] == "SPREAD"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def test_python_snapshot_interop(tmp_path):
+    """A snapshot written by the Python Gcs restores in the daemon."""
+    from ray_tpu._private.gcs import Gcs
+
+    snap = tmp_path / "snap"
+    g = Gcs(persist_path=str(snap))
+    g.register_actor(ActorInfo(actor_id=b"a1", name="xp", max_restarts=-1))
+    g.kv_put("ns", b"k", b"v")
+    g._snapshot()  # flush the debounce synchronously
+    proc, addr = _spawn(tmp_path, persist=snap)
+    try:
+        c = GcsClient(addr)
+        assert c.kv_get("ns", b"k") == b"v"
+        assert c.get_actor_by_name("xp").state == "RESTARTING"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def test_tcp_token_auth(tmp_path):
+    env = dict(os.environ, RTPU_CLUSTER_TOKEN="sekrit")
+    proc, addr = _spawn(tmp_path, bind="127.0.0.1:0", env=env)
+    try:
+        # right token: full round trip
+        c = GcsClient(f"sekrit@{addr}")
+        c.kv_put("ns", b"k", b"v")
+        assert c.kv_get("ns", b"k") == b"v"
+        # wrong token: rejected before any frame is interpreted
+        with pytest.raises((ConnectionError, OSError)):
+            GcsClient(f"wrong@{addr}")
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def test_malformed_frames_do_not_kill_daemon(daemon):
+    """Fuzz the live daemon: garbage frames must at worst close that
+    connection — the control plane stays up for everyone else."""
+    import random
+    import socket
+    import struct
+
+    rng = random.Random(7)
+    for _ in range(50):
+        path = daemon
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(path)
+        try:
+            payload = rng.randbytes(rng.randrange(1, 128))
+            s.sendall(struct.pack("<I", len(payload)) + payload)
+            s.settimeout(0.2)
+            try:
+                s.recv(64)
+            except OSError:
+                pass
+        finally:
+            s.close()
+    # daemon still serves
+    c = GcsClient(daemon)
+    c.kv_put("ns", b"alive", b"yes")
+    assert c.kv_get("ns", b"alive") == b"yes"

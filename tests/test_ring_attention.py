@@ -85,3 +85,70 @@ def test_llama_ring_attention_end_to_end():
     # loosely elementwise.
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=5e-2, atol=1e-1)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_zigzag_matches_dense(causal):
+    mesh = create_mesh(MeshConfig(fsdp=2, sp=4, tp=1))
+    q, k, v = _make_qkv(jax.random.PRNGKey(3))
+    ref = flash_attention(q, k, v, causal=causal, impl="xla")
+    out = jax.jit(lambda q, k, v: sequence_parallel_attention(
+        q, k, v, mesh, impl="zigzag", causal=causal))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_zigzag_gradients_match_dense():
+    mesh = create_mesh(MeshConfig(fsdp=1, dp=2, sp=4, tp=1))
+    q, k, v = _make_qkv(jax.random.PRNGKey(4))
+
+    def loss_sp(q, k, v):
+        out = sequence_parallel_attention(q, k, v, mesh, impl="zigzag")
+        return jnp.sum(out * out)
+
+    def loss_dense(q, k, v):
+        out = flash_attention(q, k, v, causal=True, impl="xla")
+        return jnp.sum(out * out)
+
+    g_sp = jax.jit(jax.grad(loss_sp, argnums=(0, 1, 2)))(q, k, v)
+    g_dn = jax.jit(jax.grad(loss_dense, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_sp, g_dn):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-4)
+
+
+def test_zigzag_balances_causal_work():
+    """The point of zigzag (VERDICT round-2 item 5): with contiguous
+    sharding the per-shard unmasked area ranges ~sp-fold across the ring;
+    zigzag pins every shard's total work to within one block of uniform.
+    Computed analytically from the layout (multi-device wall-clock cannot
+    be observed on a host-emulated mesh)."""
+    from ray_tpu.ops.ring_attention import _shard_positions, zigzag_permutation
+
+    sp, s_loc = 8, 16
+    seq = sp * s_loc
+
+    def shard_work(layout):
+        work = []
+        for i in range(sp):
+            rows = np.asarray(_shard_positions(jnp.asarray(i), s_loc, sp,
+                                               layout))
+            unmasked = 0
+            for src in range(sp):
+                cols = np.asarray(_shard_positions(jnp.asarray(src), s_loc,
+                                                   sp, layout))
+                unmasked += int((rows[:, None] >= cols[None, :]).sum())
+            work.append(unmasked)
+        return work
+
+    contiguous, zigzag = shard_work("contiguous"), shard_work("zigzag")
+    # identical total area (same global causal mask)...
+    assert sum(contiguous) == sum(zigzag) == seq * (seq + 1) // 2
+    # ...but contiguous spreads ~sp-fold while zigzag is near-uniform
+    assert max(contiguous) / min(contiguous) > 4.0
+    assert max(zigzag) / min(zigzag) < 1.1
+
+    # the permutation round-trips
+    perm, inv = zigzag_permutation(seq, sp)
+    x = np.arange(seq)
+    assert (x[perm][inv] == x).all()
