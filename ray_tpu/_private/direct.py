@@ -30,6 +30,8 @@ matching the scheduler path's semantics for in-flight methods.
 from __future__ import annotations
 
 import os
+import pickle
+import struct
 import threading
 import time
 import traceback
@@ -44,6 +46,114 @@ from ray_tpu.exceptions import ActorDiedError
 # live in the caller's memory store; larger results go through the shm
 # store as before (reference: max_direct_call_object_size, 100KB).
 INLINE_MAX = int(os.environ.get("RTPU_INLINE_MAX", 100 * 1024))
+
+
+# ---------------------------------------------------------------------------
+# Wire dialects.  One port serves both:
+#
+# - legacy frames: pickled dicts (first byte 0x80, the pickle PROTO
+#   opcode) — what the pure-Python path speaks.
+# - binary frames: hand-packed records (first byte 0x01 call / 0x02 reply /
+#   0x03 pickled-spec call) — what the native (_rtpu_core) path speaks; the
+#   C++ reply matcher parses 0x02 without the GIL.
+#
+# The native transport is the default when the extension builds; chaos mode
+# forces the Python path so RTPU_TESTING_RPC_FAILURE keeps injecting at the
+# frame layer (the C++ threads bypass Python chaos by construction).
+# ---------------------------------------------------------------------------
+
+FRAME_CALL = 0x01
+FRAME_REPLY = 0x02
+FRAME_CALL_PICKLED = 0x03
+
+REPLY_OK = 1  # flags bit0: executed without raising
+REPLY_IN_STORE = 2  # flags bit1: result in the shm store, payload empty
+
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+
+_native_core = None
+_native_failed = False
+
+
+def native_core():
+    """The _rtpu_core extension, or None (unavailable / disabled)."""
+    global _native_core, _native_failed
+    if _native_core is not None or _native_failed:
+        return _native_core
+    if (os.environ.get("RTPU_NATIVE_TRANSPORT", "1") == "0"
+            or os.environ.get("RTPU_TESTING_RPC_FAILURE")):
+        _native_failed = True
+        return None
+    try:
+        from ray_tpu.native.build import load_extension
+
+        _native_core = load_extension("_rtpu_core")
+    except Exception:
+        _native_failed = True
+    return _native_core
+
+
+def pack_call_frame(spec) -> bytes:
+    """Binary call record; falls back to a pickled-spec record for specs
+    the compact form can't carry (multi-return, device tensors, ...)."""
+    simple = (len(spec.return_ids) == 1 and spec.tensor_transport is None
+              and spec.method_name is not None
+              and len(spec.method_name) < 65536)
+    if not simple:
+        body = pickle.dumps(spec, protocol=5)
+        return (bytes([FRAME_CALL_PICKLED, len(spec.task_id)])
+                + spec.task_id + body)
+    m = spec.method_name.encode("utf-8")
+    parts = [bytes([FRAME_CALL, len(spec.task_id)]), spec.task_id,
+             bytes([len(spec.return_ids[0])]), spec.return_ids[0],
+             bytes([len(spec.actor_id)]), spec.actor_id,
+             _U16.pack(len(m)), m, spec.args_blob or b""]
+    return b"".join(parts)
+
+
+def parse_direct_frame(frame: bytes):
+    """-> ("call", spec) | ("hello", None) | (None, None) for any dialect."""
+    if not frame:
+        return None, None
+    kind = frame[0]
+    if kind == 0x80:  # legacy pickled dict
+        msg = pickle.loads(frame)
+        t = msg.get("t")
+        if t == "call":
+            return "call", msg["spec"]
+        return ("hello", None) if t == "hello" else (None, None)
+    if kind == FRAME_CALL_PICKLED:
+        tl = frame[1]
+        return "call", pickle.loads(frame[2 + tl:])
+    if kind == FRAME_CALL:
+        from ray_tpu._private.task_spec import ACTOR_METHOD, TaskSpec
+
+        pos = 1
+        tl = frame[pos]; pos += 1
+        tid = frame[pos:pos + tl]; pos += tl
+        rl = frame[pos]; pos += 1
+        rid = frame[pos:pos + rl]; pos += rl
+        al = frame[pos]; pos += 1
+        aid = frame[pos:pos + al]; pos += al
+        (ml,) = _U16.unpack_from(frame, pos); pos += 2
+        method = frame[pos:pos + ml].decode("utf-8"); pos += ml
+        return "call", TaskSpec(
+            task_id=tid, kind=ACTOR_METHOD, fn_id=b"",
+            args_blob=frame[pos:], return_ids=[rid], actor_id=aid,
+            method_name=method, name=method)
+    return None, None
+
+
+def encode_direct_reply(request_first_byte: int, reply: dict) -> bytes:
+    """Encode a reply dict in the dialect of the request it answers."""
+    if request_first_byte in (FRAME_CALL, FRAME_CALL_PICKLED):
+        flags = (REPLY_OK if reply.get("ok") else 0) | (
+            REPLY_IN_STORE if reply.get("in_store") else 0)
+        tid = reply["task_id"]
+        return (bytes([FRAME_REPLY, len(tid)]) + tid + bytes([flags])
+                + (reply.get("payload") or b""))
+    return pickle.dumps(reply, protocol=5)
 
 _MEMSTORE_MAX_ENTRIES = int(os.environ.get("RTPU_MEMSTORE_ENTRIES", 65536))
 _MEMSTORE_MAX_BYTES = int(os.environ.get("RTPU_MEMSTORE_BYTES", 256 << 20))
@@ -209,27 +319,90 @@ def fail_payload(exc: BaseException, tb: str = "") -> bytes:
 # Caller side
 # ---------------------------------------------------------------------------
 
-class _Channel:
-    """One caller's connection to one actor's worker process.
+class _ChannelBase:
+    """Shared half of a caller→actor channel: outstanding bookkeeping and
+    the in-place repair state machine.
 
     Per-caller FIFO holds across transport failures: the channel repairs
     itself IN PLACE under its lock — outstanding calls are resent over the
-    fresh connection before any new ``call`` (blocked on the lock) can
+    fresh transport before any new ``call`` (blocked on the lock) can
     send, so resends can never be overtaken.  Repair gives up (and fails
     the outstanding calls with ActorDiedError) when the actor is no longer
-    ALIVE at this address.
+    ALIVE at this address.  Subclasses provide the transport: ``call`` and
+    ``_reconnect_resend`` (reconnect + resend every outstanding spec +
+    start the reply reader; raises/returns None on failure).
     """
 
     def __init__(self, actor_id: bytes, addr: str, client: "DirectClient"):
         self.actor_id = actor_id
         self.addr = addr
         self._client = client
-        self._conn = protocol.connect_addr(addr, timeout=5.0)
         self._lock = threading.Lock()
         # task_id -> spec, in send order (for resend after reconnect)
         self._outstanding: OrderedDict[bytes, object] = OrderedDict()
         self.dead = False
         self._epoch = 0  # bumps per successful repair; stale readers exit
+
+    def _deliver(self, task_id: bytes, in_store: bool, payload):
+        with self._lock:
+            spec = self._outstanding.pop(task_id, None)
+        if spec is None:
+            return
+        if in_store:
+            for oid in spec.return_ids:
+                self._client.memstore.mark_in_store(oid)
+        else:
+            self._client.memstore.put_payload(spec.return_ids[0], payload)
+
+    def _reconnect_resend(self) -> bool:
+        raise NotImplementedError
+
+    def _on_broken(self, epoch: int):
+        """Transport lost (EOF, reset, or injected chaos): repair in
+        place; if the actor is gone, fail the outstanding calls and
+        retire the channel."""
+        with self._lock:
+            if self.dead or epoch != self._epoch:
+                return  # a newer incarnation already took over
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                state, addr = self._client.resolve(self.actor_id,
+                                                   use_cache=False)
+                if state is None:
+                    # resolve itself failed (transient control-plane error,
+                    # e.g. injected chaos): retry within the deadline
+                    time.sleep(0.1)
+                    continue
+                if state != "ALIVE" or addr != self.addr:
+                    break  # dead/restarting/moved: in-flight calls are lost
+                try:
+                    ok = self._reconnect_resend()
+                except (OSError, ConnectionError):
+                    ok = False
+                if not ok:
+                    # partial resends are absorbed by the callee's dedup
+                    time.sleep(0.1)
+                    continue
+                self._epoch += 1
+                return
+            # actor unreachable: retire the channel, fail what's in flight
+            self.dead = True
+            pending = list(self._outstanding.values())
+            self._outstanding.clear()
+        self._client._forget(self.actor_id, self)
+        err = fail_payload(ActorDiedError(
+            "actor died while executing method (direct call lost)"))
+        for spec in pending:
+            for oid in spec.return_ids:
+                self._client.memstore.put_payload(oid, err)
+
+
+class _Channel(_ChannelBase):
+    """Pure-Python transport: pickled frames, reader thread per channel."""
+
+    def __init__(self, actor_id: bytes, addr: str, client: "DirectClient"):
+        super().__init__(actor_id, addr, client)
+        self._conn = protocol.connect_addr(addr, timeout=5.0)
         self._start_reader(self._conn, self._epoch)
 
     def _start_reader(self, conn, epoch: int):
@@ -258,69 +431,80 @@ class _Channel:
             except (OSError, ConnectionError):
                 msg = None
             if msg is None:
-                self._on_broken(conn, epoch)
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                self._on_broken(epoch)
                 return
             if msg.get("t") != "result":
                 continue
-            self._deliver(msg)
+            self._deliver(msg["task_id"], bool(msg.get("in_store")),
+                          msg.get("payload"))
 
-    def _deliver(self, msg: dict):
-        task_id = msg["task_id"]
+    def _reconnect_resend(self) -> bool:
+        fresh = protocol.connect_addr(self.addr, timeout=5.0)
+        for spec in self._outstanding.values():
+            fresh.send({"t": "call", "spec": spec})
+        self._conn = fresh
+        self._start_reader(fresh, self._epoch + 1)
+        return True
+
+
+class _NativeChannel(_ChannelBase):
+    """_rtpu_core transport: C++ owns framing, socket I/O, and reply
+    parsing; one Python drain thread delivers ready results into the
+    memstore.  Repair semantics are _ChannelBase's, with frames re-packed
+    from the outstanding specs on the (rare) resend path."""
+
+    def __init__(self, actor_id: bytes, addr: str, client: "DirectClient"):
+        super().__init__(actor_id, addr, client)
+        self._ch = self._connect()
+        self._start_drain(self._ch, self._epoch)
+
+    def _connect(self):
+        # protocol.connect_addr performs the TCP cluster-token handshake
+        # in Python; the raw fd (post-handshake) is handed to C++
+        conn = protocol.connect_addr(self.addr, timeout=5.0)
+        return native_core().Channel(conn.sock.detach())
+
+    def _start_drain(self, ch, epoch: int):
+        threading.Thread(target=self._drain_loop, args=(ch, epoch),
+                         name="direct-drain", daemon=True).start()
+
+    def call(self, spec) -> bool:
         with self._lock:
-            spec = self._outstanding.pop(task_id, None)
-        if spec is None:
-            return
-        if msg.get("in_store"):
+            if self.dead:
+                return False
+            self._outstanding[spec.task_id] = spec
             for oid in spec.return_ids:
-                self._client.memstore.mark_in_store(oid)
-        else:
-            self._client.memstore.put_payload(
-                spec.return_ids[0], msg["payload"])
-
-    def _on_broken(self, conn, epoch: int):
-        """Connection lost (EOF, reset, or injected chaos): repair in
-        place — reconnect and resend outstanding calls while holding the
-        channel lock, so no new call can jump the queue; if the actor is
-        gone, fail the outstanding calls and retire the channel."""
-        with self._lock:
-            if self.dead or epoch != self._epoch:
-                return  # a newer incarnation already took over
+                self._client.memstore.expect(oid)
             try:
-                conn.close()
-            except OSError:
-                pass
-            deadline = time.monotonic() + 15.0
-            while time.monotonic() < deadline:
-                state, addr = self._client.resolve(self.actor_id,
-                                                   use_cache=False)
-                if state is None:
-                    # resolve itself failed (transient control-plane error,
-                    # e.g. injected chaos): retry within the deadline
-                    time.sleep(0.1)
-                    continue
-                if state != "ALIVE" or addr != self.addr:
-                    break  # dead/restarting/moved: in-flight calls are lost
-                try:
-                    fresh = protocol.connect_addr(self.addr, timeout=5.0)
-                    for spec in self._outstanding.values():
-                        fresh.send({"t": "call", "spec": spec})
-                except (OSError, ConnectionError):
-                    time.sleep(0.1)
-                    continue
-                self._conn = fresh
-                self._epoch += 1
-                self._start_reader(fresh, self._epoch)
+                self._ch.submit(pack_call_frame(spec))
+            except Exception:
+                pass  # drain thread observes the dead channel and repairs
+            return True
+
+    def _drain_loop(self, ch, epoch: int):
+        while True:
+            try:
+                item = ch.recv_reply(30000)
+            except ConnectionError:
+                self._on_broken(epoch)
                 return
-            # actor unreachable: retire the channel, fail what's in flight
-            self.dead = True
-            pending = list(self._outstanding.values())
-            self._outstanding.clear()
-        self._client._forget(self.actor_id, self)
-        err = fail_payload(ActorDiedError(
-            "actor died while executing method (direct call lost)"))
-        for spec in pending:
-            for oid in spec.return_ids:
-                self._client.memstore.put_payload(oid, err)
+            if item is None:
+                continue  # idle wakeup
+            tid, flags, payload = item
+            self._deliver(tid, bool(flags & REPLY_IN_STORE), payload)
+
+    def _reconnect_resend(self) -> bool:
+        fresh = self._connect()
+        if not all(fresh.submit(pack_call_frame(spec))
+                   for spec in self._outstanding.values()):
+            return False  # dedup absorbs any partial resend
+        self._ch = fresh
+        self._start_drain(fresh, self._epoch + 1)
+        return True
 
 
 class DirectClient:
@@ -378,7 +562,8 @@ class DirectClient:
             chan = self._channels.get(actor_id)
             if chan is not None and not chan.dead and chan.addr == addr:
                 return chan
-            chan = _Channel(actor_id, addr, self)
+            cls = _NativeChannel if native_core() is not None else _Channel
+            chan = cls(actor_id, addr, self)
             self._channels[actor_id] = chan
             return chan
 
@@ -433,31 +618,33 @@ class DirectServer:
             return
         while True:
             try:
-                msg = conn.recv()
-            except (OSError, ConnectionError):
+                frame = conn.recv_frame()
+            except (OSError, ConnectionError, ValueError):
                 conn.close()
                 return
-            if msg is None:
+            if frame is None:
                 conn.close()
                 return
-            t = msg.get("t")
-            if t == "hello":
+            try:
+                kind, spec = parse_direct_frame(frame)
+            except Exception:
+                continue  # malformed frame: drop it, keep the stream
+            if kind != "call":
                 continue
-            if t != "call":
-                continue
-            spec = msg["spec"]
-            self._handle_call(spec, conn)
+            first = frame[0]
 
-    def _send_reply(self, conn: protocol.Connection, reply: dict):
-        try:
-            conn.send(reply)
-        except (OSError, ConnectionError):
-            # Reply lost (incl. injected chaos): promote to connection
-            # loss so the caller's resend path takes over; the cached
-            # reply serves the resend.
-            conn.close()
+            def send_reply(reply: dict, _conn=conn, _first=first):
+                try:
+                    _conn.send_frame(encode_direct_reply(_first, reply))
+                except (OSError, ConnectionError):
+                    # Reply lost (incl. injected chaos): promote to
+                    # connection loss so the caller's resend path takes
+                    # over; the cached reply serves the resend.
+                    _conn.close()
 
-    def _handle_call(self, spec, conn: protocol.Connection):
+            self._handle_call(spec, send_reply)
+
+    def _handle_call(self, spec, send_reply: Callable[[dict], None]):
         with self._state_lock:
             cached = self._done.get(spec.task_id)
             if cached is not None:
@@ -467,22 +654,10 @@ class DirectServer:
                 if running is None:
                     self._running[spec.task_id] = threading.Event()
         if cached is not None:
-            self._send_reply(conn, cached)
+            send_reply(cached)
             return
         if running is not None:
-            # duplicate of an in-flight call (resend after reconnect):
-            # wait for the original execution — however long it takes
-            # (the scheduler path imposes no method deadline either) —
-            # then replay its reply
-            while not running.wait(timeout=60):
-                pass
-            with self._state_lock:
-                cached = self._done.get(spec.task_id)
-            self._send_reply(conn, cached or {
-                "t": "result", "task_id": spec.task_id, "ok": False,
-                "in_store": False,
-                "payload": fail_payload(RuntimeError(
-                    "duplicate direct call completed without a reply"))})
+            self._await_duplicate(spec, running, send_reply)
             return
         rt = self._runtime
         pool = rt.actor_pools.get(spec.actor_id)
@@ -493,7 +668,7 @@ class DirectServer:
             fut = pool.submit(rt.run_actor_method, spec)
             fut.add_done_callback(
                 lambda f: self._complete(spec, self._reply_from(spec, f),
-                                         conn))
+                                         send_reply))
             return
         with rt.actor_lock(spec.actor_id):
             try:
@@ -501,7 +676,24 @@ class DirectServer:
                 reply = self._pack_result(spec, result)
             except BaseException as e:  # noqa: BLE001 — ship to caller
                 reply = self._pack_error(spec, e, traceback.format_exc())
-        self._complete(spec, reply, conn)
+        self._complete(spec, reply, send_reply)
+
+    def _await_duplicate(self, spec, running: threading.Event,
+                         send_reply: Callable[[dict], None]):
+        """Duplicate of an in-flight call (resend after reconnect): wait
+        for the original execution — however long it takes (the scheduler
+        path imposes no method deadline either) — then replay its reply.
+        Runs on the per-connection thread here; the native server
+        overrides to avoid blocking its single executor."""
+        while not running.wait(timeout=60):
+            pass
+        with self._state_lock:
+            cached = self._done.get(spec.task_id)
+        send_reply(cached or {
+            "t": "result", "task_id": spec.task_id, "ok": False,
+            "in_store": False,
+            "payload": fail_payload(RuntimeError(
+                "duplicate direct call completed without a reply"))})
 
     def _reply_from(self, spec, fut) -> dict:
         exc = fut.exception()
@@ -512,7 +704,8 @@ class DirectServer:
         except BaseException as e:  # noqa: BLE001
             return self._pack_error(spec, e, traceback.format_exc())
 
-    def _complete(self, spec, reply: dict, conn: protocol.Connection):
+    def _complete(self, spec, reply: dict,
+                  send_reply: Callable[[dict], None]):
         with self._state_lock:
             self._done[spec.task_id] = reply
             self._done_bytes += len(reply.get("payload") or b"")
@@ -528,7 +721,7 @@ class DirectServer:
             ev = self._running.pop(spec.task_id, None)
         if ev is not None:
             ev.set()
-        self._send_reply(conn, reply)
+        send_reply(reply)
 
     def _pack_error(self, spec, exc: BaseException, tb: str) -> dict:
         rt = self._runtime
@@ -560,3 +753,72 @@ class DirectServer:
         rt.store_returns(spec, result)
         reply["in_store"] = True
         return reply
+
+
+class NativeDirectServer(DirectServer):
+    """DirectServer over the _rtpu_core transport.
+
+    C++ owns accept/framing/reply I/O (reference: the C++ TaskReceiver,
+    src/ray/core_worker/transport/task_receiver.cc); ONE Python executor
+    thread drains Server.next() and runs user methods — no thread per
+    connection, no pickled envelopes on the binary dialect, and the
+    executor blocks in C++ with the GIL released.  Dedup/result-packing
+    logic is inherited unchanged.
+    """
+
+    def __init__(self, runtime, bind_addr: str):
+        core = native_core()
+        self._runtime = runtime
+        listener, self.addr = protocol.listener_addr(bind_addr)
+        self._is_tcp = protocol.is_tcp_addr(self.addr)
+        token = protocol.cluster_token() if self._is_tcp else ""
+        self._srv = core.Server(listener.detach(), int(self._is_tcp),
+                                token.encode("utf-8"))
+        self._done: OrderedDict[bytes, dict] = OrderedDict()
+        self._done_bytes = 0
+        self._done_bytes_cap = 32 << 20
+        self._running: dict[bytes, threading.Event] = {}
+        self._state_lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._exec_loop, name="direct-exec", daemon=True)
+        self._thread.start()
+
+    def _exec_loop(self):
+        while True:
+            try:
+                item = self._srv.next(-1)
+            except ConnectionError:
+                return  # server closed
+            if item is None:
+                continue
+            conn_id, frame = item
+            try:
+                kind, spec = parse_direct_frame(frame)
+            except Exception:
+                continue  # malformed frame from an authed peer: drop
+            if kind != "call":
+                continue
+            first = frame[0]
+
+            def send_reply(reply: dict, _cid=conn_id, _first=first):
+                # enqueued; the exec thread's next() flushes it (a gone
+                # caller resends after reconnecting — dedup replays this)
+                self._srv.reply(_cid, encode_direct_reply(_first, reply))
+
+            self._handle_call(spec, send_reply)
+
+    def _await_duplicate(self, spec, running, send_reply):
+        # A duplicate's wait must not freeze the single executor thread —
+        # every other caller's frames would stall behind one slow method.
+        threading.Thread(
+            target=DirectServer._await_duplicate,
+            args=(self, spec, running, send_reply),
+            name="direct-dup-wait", daemon=True).start()
+
+
+def make_direct_server(runtime, bind_addr: str) -> DirectServer:
+    """Native transport when the extension is available, Python otherwise
+    (chaos mode forces Python so frame-level injection stays live)."""
+    if native_core() is not None:
+        return NativeDirectServer(runtime, bind_addr)
+    return DirectServer(runtime, bind_addr)

@@ -358,6 +358,16 @@ class Gcs:
             self._publish("objects", {"ch": "objects", "oid": oid,
                                       "lost": False})
 
+    def add_object_locations(self, pairs: list):
+        """Batched location publish: one RPC per seal-notification flush
+        instead of one per sealed object (the hot put path)."""
+        with self._lock:
+            for oid, node_id in pairs:
+                self.object_locations.setdefault(oid, set()).add(node_id)
+                self.lost_objects.discard(oid)
+                self._publish("objects", {"ch": "objects", "oid": oid,
+                                          "lost": False})
+
     def object_lost(self, oid: bytes) -> bool:
         with self._lock:
             return oid in self.lost_objects
@@ -441,7 +451,8 @@ class Gcs:
 _GCS_METHODS = frozenset({
     "register_actor", "update_actor", "get_actor", "get_actor_by_name",
     "list_actors", "register_node", "list_nodes", "get_node", "heartbeat",
-    "mark_node_dead", "add_object_location", "remove_object_location",
+    "mark_node_dead", "add_object_location", "add_object_locations",
+    "remove_object_location",
     "get_object_locations", "all_object_locations",
     "object_lost", "clear_object_lost",
     "register_pg", "get_pg", "remove_pg", "list_pgs",

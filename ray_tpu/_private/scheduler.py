@@ -349,6 +349,8 @@ class Scheduler:
                                          "force": force})
 
     def kill_actor(self, actor_id: bytes, no_restart: bool = True):
+        w = None
+        remote_wait = False
         with self._lock:
             worker_id = self._actor_workers.get(actor_id)
             if worker_id is None:
@@ -361,20 +363,52 @@ class Scheduler:
                     self._links.send(info.node_id, {
                         "t": "kill_actor", "actor_id": actor_id,
                         "no_restart": no_restart})
-                    return
-                self.gcs.update_actor(actor_id, state=gcs_mod.DEAD,
-                                      death_cause="killed before placement")
-                self._cleanup_actor_kv(actor_id)
-                # Drop queued creation/method tasks for it.
-                for spec in [s for s in self._pending if s.actor_id == actor_id]:
-                    self._pending.remove(spec)
-                    self._fail_task(spec, ActorDiedError("actor was killed"))
+                    remote_wait = no_restart
+                else:
+                    self.gcs.update_actor(
+                        actor_id, state=gcs_mod.DEAD,
+                        death_cause="killed before placement")
+                    self._cleanup_actor_kv(actor_id)
+                    # Drop queued creation/method tasks for it.
+                    for spec in [s for s in self._pending
+                                 if s.actor_id == actor_id]:
+                        self._pending.remove(spec)
+                        self._fail_task(spec, ActorDiedError(
+                            "actor was killed"))
+            else:
+                w = self._workers.get(worker_id)
+                if no_restart:
+                    self.gcs.update_actor(actor_id, max_restarts=0)
+                if w is not None:
+                    self._pool.terminate_worker(w)
+        # Waits run OUTSIDE the lock.  A caller that got kill() back must
+        # observe the NEXT method call fail; the direct transport is fast
+        # enough to race SIGTERM into a still-alive process otherwise.
+        if w is not None and w.proc is not None:
+            try:
+                w.proc.wait(timeout=3.0)
+            except Exception:
+                try:
+                    # escalate: worker ignored SIGTERM (wedged native code)
+                    w.proc.kill()
+                    w.proc.wait(timeout=2.0)
+                except Exception:
+                    pass
+        elif remote_wait:
+            self._await_actor_dead(actor_id)
+
+    def _await_actor_dead(self, actor_id: bytes, timeout_s: float = 5.0):
+        """Wait (lock NOT held) for a remote kill to be observed in the
+        GCS — the hosting node's worker-death handler flips the state."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                cur = self.gcs.get_actor(actor_id)
+            except Exception:
                 return
-            w = self._workers.get(worker_id)
-            if no_restart:
-                self.gcs.update_actor(actor_id, max_restarts=0)
-            if w is not None:
-                self._pool.terminate_worker(w)
+            if cur is None or cur.state == gcs_mod.DEAD:
+                return
+            time.sleep(0.05)
 
     # ------------------------------------------------------------------
     # Placement groups (2PC reserve/commit; reference:
@@ -634,7 +668,15 @@ class Scheduler:
                         if fwd is not None:
                             self._forwarded[msg["task_id"]] = (msg["node"], fwd[1])
                 elif t == "kill_actor":
-                    self.kill_actor(msg["actor_id"], msg.get("no_restart", True))
+                    # kill now BLOCKS until the worker exits (so callers
+                    # observe the death) — run it off the link reader, or
+                    # a wedged worker would stall every control message
+                    # from this peer for seconds
+                    threading.Thread(
+                        target=self.kill_actor,
+                        args=(msg["actor_id"],
+                              msg.get("no_restart", True)),
+                        name="kill-actor", daemon=True).start()
                 elif t == "cancel":
                     self.cancel(msg["task_id"], msg.get("force", False))
                 elif t == "blocked":
@@ -859,7 +901,14 @@ class Scheduler:
         """Start a pull; if no remote copy exists yet, arm an event-driven
         retry — the GCS "objects" pubsub channel re-triggers the pull the
         moment a location is published anywhere in the cluster, so a
-        cross-node get is bounded by the transfer, not a poll interval."""
+        cross-node get is bounded by the transfer, not a poll interval.
+
+        Single-node fast path: with no live peers there is nowhere to pull
+        FROM — getters on not-yet-sealed local results hit this on every
+        first miss, and spawning a pull thread + location RPCs per task
+        get would tax the hot path for nothing."""
+        if len(self._known_alive) <= 1 and len(self._cluster_nodes) <= 1:
+            return False
         if not self._store.contains(oid):
             self._watch_object(oid)
         return self._transfer.trigger_pull(oid)

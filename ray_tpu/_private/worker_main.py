@@ -59,9 +59,10 @@ class WorkerRuntime:
         )
         set_global_worker(self.ctx)
         # Direct-call server: callers push actor methods straight to this
-        # process (see _private/direct.py).  TCP clusters bind the same
-        # interface as the scheduler; unix clusters use a per-worker path.
-        from ray_tpu._private.direct import DirectServer
+        # process (see _private/direct.py; native C++ transport when the
+        # extension is available).  TCP clusters bind the same interface
+        # as the scheduler; unix clusters use a per-worker path.
+        from ray_tpu._private.direct import make_direct_server
 
         if protocol.is_tcp_addr(args.scheduler_socket):
             host, _, _ = args.scheduler_socket.rpartition(":")
@@ -70,7 +71,7 @@ class WorkerRuntime:
             bind = os.path.join(
                 os.path.dirname(args.store_socket),
                 f"w_{self.worker_id.hex()}.sock")
-        self.direct_server = DirectServer(self, bind)
+        self.direct_server = make_direct_server(self, bind)
         # Caller-side direct path for actor calls made FROM this worker.
         self.ctx.init_direct(self._rpc)
 

@@ -16,6 +16,7 @@ import queue as queue_mod
 import threading
 import time
 import uuid
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -117,7 +118,14 @@ class LLMEngine:
         self._thread: Optional[threading.Thread] = None
         # decode-state host mirrors (device arrays rebuilt when they change)
         self._stats = {"prefills": 0, "decode_steps": 0,
-                       "tokens_generated": 0, "preempted": 0}
+                       "tokens_generated": 0, "preempted": 0,
+                       "admitted": 0}
+        # Queue/admission observability (VERDICT round-2: the serving
+        # bench conflated queue wait with prefill; these separate them):
+        # recent per-request queue waits (submit -> admission) and prefill
+        # compute times, rings of the last 128.
+        self._queue_waits: "deque[float]" = deque(maxlen=128)
+        self._prefill_times: "deque[float]" = deque(maxlen=128)
 
     # ------------------------- public API ---------------------------------
 
@@ -221,9 +229,30 @@ class LLMEngine:
 
     def stats(self) -> dict:
         active = sum(s is not None for s in self._slots)
+
+        def _pctile(ring, frac):
+            # the scheduler thread appends concurrently; a mid-iteration
+            # append at maxlen pops the head and invalidates the iterator
+            for _ in range(4):
+                try:
+                    xs = sorted(ring)
+                    break
+                except RuntimeError:
+                    continue
+            else:
+                return None
+            return round(xs[int((len(xs) - 1) * frac)] * 1e3, 2) \
+                if xs else None
+
         return {**self._stats, "active_slots": active,
                 "free_pages": self.allocator.num_free(),
-                "waiting": self._waiting.qsize()}
+                "waiting": self._waiting.qsize(),
+                # admission observability: time requests spent queued
+                # before a slot/pages freed up, vs pure prefill compute
+                "p50_queue_wait_ms": _pctile(self._queue_waits, 0.5),
+                "p90_queue_wait_ms": _pctile(self._queue_waits, 0.9),
+                "p50_prefill_ms": _pctile(self._prefill_times, 0.5),
+                "p90_prefill_ms": _pctile(self._prefill_times, 0.9)}
 
     # ------------------------- scheduler loop ------------------------------
 
@@ -347,12 +376,17 @@ class LLMEngine:
             page_rows[i] = pages[pi] if pi < len(pages) else 0
         slot_positions = np.arange(bucket, dtype=np.int32) \
             % self.cfg.page_size
+        t0 = time.monotonic()
         logits, self.cache_k, self.cache_v = lm.prefill(
             self.params, jnp.asarray(tokens), self.cache_k, self.cache_v,
             jnp.asarray(page_rows), jnp.int32(n),
             jnp.asarray(slot_positions), self.model_cfg)
+        out = self._sample_one(np.asarray(logits), req.params, rng)
         self._stats["prefills"] += 1
-        return self._sample_one(np.asarray(logits), req.params, rng)
+        self._prefill_times.append(time.monotonic() - t0)
+        self._queue_waits.append(t0 - req.submitted_at)
+        self._stats["admitted"] += 1
+        return out
 
     def _decode_all(self) -> bool:
         active_slots = [(i, s) for i, s in enumerate(self._slots)

@@ -2,63 +2,71 @@
 //
 // Counterpart of the reference's C++ core-worker transport
 // (/root/reference/src/ray/core_worker/transport/actor_task_submitter.cc +
-// task_receiver.cc): the reference executes Python user code but keeps
-// framing, socket I/O, queueing, and reply matching in C++ threads that
-// never hold the GIL.  Round-2's pure-Python direct path paid for pickled
-// frame envelopes and 3+ Python thread wakeups per call — on a single-core
-// host that Python overhead IS the n:n actor-call ceiling (BENCH_core
-// 0.41x reference).  This extension moves the transport half of every call
-// off the GIL:
+// task_receiver.cc): framing, socket I/O, and frame parsing in C++ with the
+// GIL released.  Round-2's pure-Python direct path paid for pickled frame
+// envelopes and a Python thread-per-connection; on a single-core host that
+// overhead IS the actor-call ceiling (BENCH_core n:n at 0.41x reference).
 //
-//   caller:  Channel.submit(tid, frame)  — C++ enqueue + sendall
-//            Channel.wait(tid, ms)       — blocks on a C++ condvar (GIL
-//                                          released); the C++ reader thread
-//                                          parses replies and signals it.
-//            No Python reader thread exists at all.
-//   callee:  Server accepts connections, C++ reader threads parse frames
-//            into one arrival-ordered queue; ONE Python executor thread
-//            drains Server.next(), runs the user method, Server.reply().
+// Design: THREADLESS.  The extension spawns no threads at all — on a
+// one-core box every extra hop between threads is pure scheduling latency:
+//
+//   caller:  Channel.submit(frame)        — sendall on the calling thread
+//            Channel.recv_reply(ms)       — recv+parse on the calling
+//                                           thread (the Python drain
+//                                           thread), GIL released while
+//                                           blocked.  One wake per reply,
+//                                           exactly like a plain socket
+//                                           reader, but parsing is C++.
+//   callee:  Server.next(ms)              — epoll accept/read/parse on the
+//                                           calling thread (the single
+//                                           Python executor); returns one
+//                                           complete call frame.
+//            Server.reply(conn_id, frame) — sendall on the same thread.
 //
 // Frames are the 4-byte-LE length-prefixed format of _private/protocol.py;
-// frame BODIES here are the binary call/reply records built by
-// _private/direct.py (first byte 0x01/0x02/0x03; a 0x80 first byte is a
-// legacy pickled-dict frame from a Python-fallback peer, which the Python
-// executor still understands — one port, both dialects).
+// bodies are the records built by _private/direct.py (0x01/0x02/0x03
+// binary dialect; 0x80-first-byte legacy pickles from Python-fallback
+// peers pass through opaquely — the Python layer handles both).
 //
 // Build: CPython C API (no pybind11 in this image) — see native/build.py.
 
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
-#include <arpa/inet.h>
 #include <errno.h>
+#include <fcntl.h>
 #include <netinet/in.h>
+#include <sys/eventfd.h>
 #include <netinet/tcp.h>
 #include <poll.h>
-#include <pthread.h>
 #include <stdint.h>
 #include <string.h>
+#include <sys/epoll.h>
 #include <sys/socket.h>
-#include <sys/un.h>
 #include <unistd.h>
 
-#include <condition_variable>
 #include <deque>
 #include <map>
 #include <mutex>
 #include <string>
-#include <thread>
-#include <vector>
 
 namespace {
 
-// ---------- low-level framed I/O ----------
+constexpr uint32_t kMaxFrame = 1u << 28;
 
 bool send_all(int fd, const char* p, size_t n) {
   while (n > 0) {
     ssize_t k = ::send(fd, p, n, MSG_NOSIGNAL);
     if (k < 0) {
       if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        // Non-blocking fd (server-accepted conns) with a full buffer:
+        // wait for drain.  Bailing here would truncate mid-frame and
+        // permanently desync the stream.
+        struct pollfd pfd{fd, POLLOUT, 0};
+        if (::poll(&pfd, 1, 10000) <= 0) return false;
+        continue;
+      }
       return false;
     }
     p += k;
@@ -67,37 +75,34 @@ bool send_all(int fd, const char* p, size_t n) {
   return true;
 }
 
-bool recv_all(int fd, char* p, size_t n) {
-  while (n > 0) {
-    ssize_t k = ::recv(fd, p, n, 0);
-    if (k <= 0) {
-      if (k < 0 && errno == EINTR) continue;
-      return false;
-    }
-    p += k;
-    n -= size_t(k);
-  }
-  return true;
-}
-
-constexpr uint32_t kMaxFrame = 1u << 28;
-
+// Framed send: one writev-ish call (header copied into a stack prefix for
+// small frames to keep it a single syscall).
 bool send_frame(int fd, std::mutex& mu, const char* body, size_t n) {
-  char hdr[4];
-  uint32_t len = uint32_t(n);
-  memcpy(hdr, &len, 4);
   std::lock_guard<std::mutex> g(mu);
+  uint32_t len = uint32_t(n);
+  if (n <= 65536 - 4) {
+    char buf[65536];
+    memcpy(buf, &len, 4);
+    memcpy(buf + 4, body, n);
+    return send_all(fd, buf, n + 4);
+  }
+  char hdr[4];
+  memcpy(hdr, &len, 4);
   return send_all(fd, hdr, 4) && send_all(fd, body, n);
 }
 
-bool recv_frame(int fd, std::string* out) {
-  char hdr[4];
-  if (!recv_all(fd, hdr, 4)) return false;
+// Incremental frame extraction: 1 = frame out, 0 = need more bytes,
+// -1 = poisoned stream (oversize length) — the caller MUST drop the
+// connection; after a bogus length no later byte boundary can be trusted.
+int extract_frame(std::string& acc, std::string* out) {
+  if (acc.size() < 4) return 0;
   uint32_t len;
-  memcpy(&len, hdr, 4);
-  if (len > kMaxFrame) return false;
-  out->resize(len);
-  return len == 0 || recv_all(fd, &(*out)[0], len);
+  memcpy(&len, acc.data(), 4);
+  if (len > kMaxFrame) return -1;
+  if (acc.size() < 4 + size_t(len)) return 0;
+  out->assign(acc, 4, len);
+  acc.erase(0, 4 + size_t(len));
+  return 1;
 }
 
 // ---------- Channel (caller side) ----------
@@ -105,41 +110,8 @@ bool recv_frame(int fd, std::string* out) {
 struct ChannelCore {
   int fd = -1;
   std::mutex send_mu;
-  std::mutex mu;  // guards results/outstanding/dead
-  std::condition_variable cv;
-  std::map<std::string, std::pair<uint8_t, std::string>> results;
-  std::deque<std::string> outstanding;  // submit order
+  std::string in;  // recv accumulation (single reader thread by contract)
   bool dead = false;
-  std::thread reader;
-
-  void reader_loop() {
-    std::string body;
-    for (;;) {
-      if (!recv_frame(fd, &body)) break;
-      // reply frame: 0x02 | u8 tid_len | tid | u8 flags | payload
-      if (body.size() < 3 || uint8_t(body[0]) != 0x02) continue;
-      uint8_t tl = uint8_t(body[1]);
-      if (body.size() < size_t(2 + tl + 1)) continue;
-      std::string tid = body.substr(2, tl);
-      uint8_t flags = uint8_t(body[2 + tl]);
-      std::string payload = body.substr(2 + tl + 1);
-      {
-        std::lock_guard<std::mutex> g(mu);
-        results[tid] = {flags, std::move(payload)};
-        for (auto it = outstanding.begin(); it != outstanding.end(); ++it)
-          if (*it == tid) {
-            outstanding.erase(it);
-            break;
-          }
-      }
-      cv.notify_all();
-    }
-    {
-      std::lock_guard<std::mutex> g(mu);
-      dead = true;
-    }
-    cv.notify_all();
-  }
 };
 
 typedef struct {
@@ -157,158 +129,105 @@ static PyObject* Channel_new(PyTypeObject* type, PyObject* args,
   self->core->fd = fd;
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
-  self->core->reader = std::thread([c = self->core] { c->reader_loop(); });
   return (PyObject*)self;
 }
 
 static void Channel_dealloc(ChannelObject* self) {
-  ChannelCore* c = self->core;
-  if (c) {
-    ::shutdown(c->fd, SHUT_RDWR);
-    Py_BEGIN_ALLOW_THREADS
-    if (c->reader.joinable()) c->reader.join();
-    Py_END_ALLOW_THREADS
-    ::close(c->fd);
-    delete c;
+  if (self->core) {
+    ::shutdown(self->core->fd, SHUT_RDWR);
+    ::close(self->core->fd);
+    delete self->core;
+    self->core = nullptr;
   }
   Py_TYPE(self)->tp_free((PyObject*)self);
 }
 
 static PyObject* Channel_submit(ChannelObject* self, PyObject* args) {
-  const char *tid, *frame;
-  Py_ssize_t tid_len, frame_len;
-  if (!PyArg_ParseTuple(args, "y#y#", &tid, &tid_len, &frame, &frame_len))
-    return nullptr;
+  Py_buffer frame;
+  if (!PyArg_ParseTuple(args, "y*", &frame)) return nullptr;
   ChannelCore* c = self->core;
-  {
-    std::lock_guard<std::mutex> g(c->mu);
-    if (c->dead) Py_RETURN_FALSE;
-    c->outstanding.emplace_back(tid, size_t(tid_len));
-  }
   bool ok;
   Py_BEGIN_ALLOW_THREADS
-  ok = send_frame(c->fd, c->send_mu, frame, size_t(frame_len));
+  ok = !c->dead && send_frame(c->fd, c->send_mu, (const char*)frame.buf,
+                              size_t(frame.len));
   Py_END_ALLOW_THREADS
-  if (!ok) {
-    // the reader will observe EOF and flip dead; the frame stays in
-    // outstanding so the repair path resends it
-    Py_RETURN_FALSE;
-  }
-  Py_RETURN_TRUE;
+  PyBuffer_Release(&frame);
+  return PyBool_FromLong(ok);
 }
 
-static PyObject* Channel_wait(ChannelObject* self, PyObject* args) {
-  const char* tid;
-  Py_ssize_t tid_len;
-  long timeout_ms;
-  if (!PyArg_ParseTuple(args, "y#l", &tid, &tid_len, &timeout_ms))
-    return nullptr;
-  ChannelCore* c = self->core;
-  std::string key(tid, size_t(tid_len));
-  std::pair<uint8_t, std::string> result;
-  bool found = false, is_dead = false;
-  Py_BEGIN_ALLOW_THREADS
-  {
-    std::unique_lock<std::mutex> lk(c->mu);
-    auto ready = [&] { return c->dead || c->results.count(key); };
-    if (timeout_ms < 0) {
-      c->cv.wait(lk, ready);
-    } else {
-      c->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), ready);
-    }
-    auto it = c->results.find(key);
-    if (it != c->results.end()) {
-      result = std::move(it->second);
-      c->results.erase(it);
-      found = true;
-    }
-    is_dead = c->dead;
-  }
-  Py_END_ALLOW_THREADS
-  if (found)
-    return Py_BuildValue("(iy#)", int(result.first), result.second.data(),
-                         Py_ssize_t(result.second.size()));
-  if (is_dead) {
-    PyErr_SetString(PyExc_ConnectionError, "direct channel lost");
-    return nullptr;
-  }
-  Py_RETURN_NONE;  // timeout
-}
-
-static PyObject* Channel_wait_any(ChannelObject* self, PyObject* args) {
-  // Any ready result (delivery-thread draining): replies can complete out
-  // of caller order on concurrent actors, so the drain must not pick a tid.
+// recv_reply(timeout_ms) -> (task_id, flags, payload) | None on timeout;
+// raises ConnectionError on EOF/reset.  Non-0x02 frames are skipped.
+static PyObject* Channel_recv_reply(ChannelObject* self, PyObject* args) {
   long timeout_ms;
   if (!PyArg_ParseTuple(args, "l", &timeout_ms)) return nullptr;
   ChannelCore* c = self->core;
-  std::string tid;
-  std::pair<uint8_t, std::string> result;
-  bool found = false, is_dead = false;
+  std::string frame;
+  bool got = false;
   Py_BEGIN_ALLOW_THREADS
-  {
-    std::unique_lock<std::mutex> lk(c->mu);
-    auto ready = [&] { return c->dead || !c->results.empty(); };
-    if (timeout_ms < 0) {
-      c->cv.wait(lk, ready);
-    } else {
-      c->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), ready);
+  for (;;) {
+    int fr = extract_frame(c->in, &frame);
+    if (fr < 0) {  // poisoned framing: the channel is unusable
+      c->dead = true;
+      ::shutdown(c->fd, SHUT_RDWR);
+      break;
     }
-    if (!c->results.empty()) {
-      auto it = c->results.begin();
-      tid = it->first;
-      result = std::move(it->second);
-      c->results.erase(it);
-      found = true;
+    if (fr > 0) {
+      if (frame.size() >= 3 && uint8_t(frame[0]) == 0x02) {
+        got = true;
+        break;
+      }
+      continue;  // not a reply frame: skip
     }
-    is_dead = c->dead;
+    if (c->dead) break;
+    struct pollfd pfd{c->fd, POLLIN, 0};
+    int pr = ::poll(&pfd, 1, int(timeout_ms));
+    if (pr == 0) break;  // timeout
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      c->dead = true;
+      break;
+    }
+    char buf[1 << 16];
+    ssize_t k = ::recv(c->fd, buf, sizeof buf, 0);
+    if (k <= 0) {
+      if (k < 0 && errno == EINTR) continue;
+      c->dead = true;
+      break;
+    }
+    c->in.append(buf, size_t(k));
   }
   Py_END_ALLOW_THREADS
-  if (found)
-    return Py_BuildValue("(y#iy#)", tid.data(), Py_ssize_t(tid.size()),
-                         int(result.first), result.second.data(),
-                         Py_ssize_t(result.second.size()));
-  if (is_dead) {
+  if (got) {
+    uint8_t tl = uint8_t(frame[1]);
+    if (frame.size() < size_t(2 + tl + 1)) Py_RETURN_NONE;
+    uint8_t flags = uint8_t(frame[2 + tl]);
+    return Py_BuildValue("(y#iy#)", frame.data() + 2, Py_ssize_t(tl),
+                         int(flags), frame.data() + 2 + tl + 1,
+                         Py_ssize_t(frame.size() - 2 - tl - 1));
+  }
+  if (self->core->dead) {
     PyErr_SetString(PyExc_ConnectionError, "direct channel lost");
     return nullptr;
   }
-  Py_RETURN_NONE;  // timeout
-}
-
-static PyObject* Channel_outstanding(ChannelObject* self, PyObject*) {
-  ChannelCore* c = self->core;
-  std::vector<std::string> tids;
-  {
-    std::lock_guard<std::mutex> g(c->mu);
-    tids.assign(c->outstanding.begin(), c->outstanding.end());
-  }
-  PyObject* list = PyList_New(Py_ssize_t(tids.size()));
-  for (size_t i = 0; i < tids.size(); ++i)
-    PyList_SET_ITEM(list, i, PyBytes_FromStringAndSize(
-                                  tids[i].data(), tids[i].size()));
-  return list;
+  Py_RETURN_NONE;
 }
 
 static PyObject* Channel_is_dead(ChannelObject* self, PyObject*) {
-  std::lock_guard<std::mutex> g(self->core->mu);
   return PyBool_FromLong(self->core->dead);
 }
 
 static PyObject* Channel_close(ChannelObject* self, PyObject*) {
+  self->core->dead = true;
   ::shutdown(self->core->fd, SHUT_RDWR);
   Py_RETURN_NONE;
 }
 
 static PyMethodDef Channel_methods[] = {
     {"submit", (PyCFunction)Channel_submit, METH_VARARGS,
-     "submit(task_id, frame) -> bool"},
-    {"wait", (PyCFunction)Channel_wait, METH_VARARGS,
-     "wait(task_id, timeout_ms) -> (flags, payload) | None; raises "
+     "submit(frame) -> bool (False when the connection is gone)"},
+    {"recv_reply", (PyCFunction)Channel_recv_reply, METH_VARARGS,
+     "recv_reply(timeout_ms) -> (task_id, flags, payload) | None; raises "
      "ConnectionError when the channel is dead"},
-    {"wait_any", (PyCFunction)Channel_wait_any, METH_VARARGS,
-     "wait_any(timeout_ms) -> (task_id, flags, payload) | None; raises "
-     "ConnectionError when the channel is dead"},
-    {"outstanding", (PyCFunction)Channel_outstanding, METH_NOARGS,
-     "task ids submitted but not yet answered, in send order"},
     {"is_dead", (PyCFunction)Channel_is_dead, METH_NOARGS, ""},
     {"close", (PyCFunction)Channel_close, METH_NOARGS, ""},
     {nullptr, nullptr, 0, nullptr}};
@@ -319,101 +238,124 @@ static PyTypeObject ChannelType = {
 
 // ---------- Server (callee side) ----------
 
-struct ServerCore {
-  int listen_fd = -1;
-  bool is_tcp = false;
-  std::string token;  // TCP peers must present this before frame 1
-  std::mutex mu;
-  std::condition_variable cv;
-  std::deque<std::pair<uint64_t, std::string>> queue;  // (conn_id, frame)
-  std::map<uint64_t, int> conns;          // conn_id -> fd
-  std::map<uint64_t, std::mutex*> send_mus;
-  uint64_t next_conn_id = 1;
-  bool closed = false;
-  std::thread acceptor;
-  std::vector<std::thread> readers;
+struct ConnState {
+  int fd;
+  std::string in;
+  enum Phase { AUTH, READY } phase = READY;
+};
 
-  void accept_loop() {
+struct ServerCore {
+  // Threadless contract: the conns map and every socket write/read/close
+  // happen ONLY on the thread inside Server_next (the Python executor).
+  // Other threads (max_concurrency>1 pool callbacks) hand replies over
+  // through out_queue + an eventfd wake — they never touch sockets, so
+  // there is no map race and no send-to-recycled-fd window.
+  int epfd = -1;
+  int listen_fd = -1;
+  int wake_fd = -1;  // eventfd: reply producers wake the epoll loop
+  bool is_tcp = false;
+  bool closed = false;
+  std::string token;
+  std::map<uint64_t, ConnState> conns;
+  uint64_t next_conn_id = 1;
+  std::map<int, uint64_t> by_fd;
+  std::deque<std::pair<uint64_t, std::string>> ready;  // parsed call frames
+  std::mutex out_mu;  // guards out_queue only
+  std::deque<std::pair<uint64_t, std::string>> out_queue;
+  std::mutex dummy_send_mu;  // sends are single-threaded; kept for helpers
+
+  void drop(uint64_t id) {
+    auto it = conns.find(id);
+    if (it == conns.end()) return;
+    epoll_ctl(epfd, EPOLL_CTL_DEL, it->second.fd, nullptr);
+    by_fd.erase(it->second.fd);
+    ::close(it->second.fd);
+    conns.erase(it);
+  }
+
+  // Exec-thread only: drain queued replies onto their sockets.
+  void flush_replies() {
     for (;;) {
-      int fd = ::accept(listen_fd, nullptr, nullptr);
-      if (fd < 0) {
-        if (errno == EINTR) continue;
-        break;  // listener closed
+      uint64_t id;
+      std::string frame;
+      {
+        std::lock_guard<std::mutex> g(out_mu);
+        if (out_queue.empty()) return;
+        id = out_queue.front().first;
+        frame = std::move(out_queue.front().second);
+        out_queue.pop_front();
       }
+      auto it = conns.find(id);
+      if (it == conns.end()) continue;  // caller hung up; it will resend
+      if (!send_frame(it->second.fd, dummy_send_mu, frame.data(),
+                      frame.size()))
+        drop(id);
+    }
+  }
+
+  void accept_ready() {
+    for (;;) {
+      int fd = ::accept4(listen_fd, nullptr, nullptr, SOCK_NONBLOCK);
+      if (fd < 0) return;
       if (is_tcp) {
         int one = 1;
         setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
       }
-      uint64_t id;
-      std::mutex* smu = new std::mutex();
-      {
-        std::lock_guard<std::mutex> g(mu);
-        if (closed) {
-          ::close(fd);
-          delete smu;
-          return;
-        }
-        id = next_conn_id++;
-        conns[id] = fd;
-        send_mus[id] = smu;
-        readers.emplace_back([this, id, fd] { reader_loop(id, fd); });
-      }
+      uint64_t id = next_conn_id++;  // starts at 2 (0=listener, 1=wake)
+      ConnState cs;
+      cs.fd = fd;
+      cs.phase = is_tcp ? ConnState::AUTH : ConnState::READY;
+      conns.emplace(id, std::move(cs));
+      by_fd[fd] = id;
+      struct epoll_event ev;
+      ev.events = EPOLLIN;
+      ev.data.u64 = id;
+      epoll_ctl(epfd, EPOLL_CTL_ADD, fd, &ev);
     }
-    std::lock_guard<std::mutex> g(mu);
-    closed = true;
-    cv.notify_all();
   }
 
-  void reader_loop(uint64_t id, int fd) {
-    std::string body;
-    if (is_tcp) {
-      // cluster-token handshake (reference of record: protocol.py
-      // authenticate_server_side) — constant-time-ish compare
-      if (!recv_frame(fd, &body) || body.size() != token.size()) {
-        drop(id, fd);
-        return;
-      }
-      unsigned char d = 0;
-      for (size_t i = 0; i < body.size(); ++i)
-        d |= (unsigned char)(body[i]) ^ (unsigned char)(token[i]);
-      if (d != 0) {
-        std::mutex* smu;
-        {
-          std::lock_guard<std::mutex> g(mu);
-          smu = send_mus[id];
-        }
-        send_frame(fd, *smu, "NO", 2);
-        drop(id, fd);
-        return;
-      }
-      std::mutex* smu;
-      {
-        std::lock_guard<std::mutex> g(mu);
-        smu = send_mus[id];
-      }
-      if (!send_frame(fd, *smu, "OK", 2)) {
-        drop(id, fd);
-        return;
-      }
-    }
+  // Read everything available on conn `id`; parse complete frames into
+  // `ready`.  Returns false when the conn died.
+  bool read_conn(uint64_t id) {
+    auto it = conns.find(id);
+    if (it == conns.end()) return false;
+    ConnState& cs = it->second;
+    char buf[1 << 16];
     for (;;) {
-      if (!recv_frame(fd, &body)) break;
-      {
-        std::lock_guard<std::mutex> g(mu);
-        queue.emplace_back(id, std::move(body));
+      ssize_t k = ::recv(cs.fd, buf, sizeof buf, 0);
+      if (k > 0) {
+        cs.in.append(buf, size_t(k));
+        if (cs.in.size() > kMaxFrame + 4) return false;
+      } else if (k == 0) {
+        return false;
+      } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        break;
+      } else if (errno != EINTR) {
+        return false;
       }
-      cv.notify_one();
-      body.clear();
     }
-    drop(id, fd);
-  }
-
-  void drop(uint64_t id, int fd) {
-    ::close(fd);
-    std::lock_guard<std::mutex> g(mu);
-    conns.erase(id);
-    // send_mus entry leaks intentionally until shutdown: a reply racing
-    // the disconnect may still hold the mutex
+    std::string frame;
+    int fr;
+    while ((fr = extract_frame(cs.in, &frame)) != 0) {
+      if (fr < 0) return false;  // poisoned framing: drop the connection
+      if (cs.phase == ConnState::AUTH) {
+        // cluster-token handshake (reference of record:
+        // protocol.py authenticate_server_side), constant-time-ish
+        unsigned char d = frame.size() == token.size() ? 0 : 1;
+        for (size_t i = 0; i < frame.size() && i < token.size(); ++i)
+          d |= (unsigned char)(frame[i]) ^ (unsigned char)(token[i]);
+        if (d != 0) {
+          send_frame(cs.fd, dummy_send_mu, "NO", 2);
+          return false;
+        }
+        if (!send_frame(cs.fd, dummy_send_mu, "OK", 2)) return false;
+        cs.phase = ConnState::READY;
+        continue;
+      }
+      ready.emplace_back(id, std::move(frame));
+      frame.clear();
+    }
+    return true;
   }
 };
 
@@ -431,70 +373,96 @@ static PyObject* Server_new(PyTypeObject* type, PyObject* args,
     return nullptr;
   ServerObject* self = (ServerObject*)type->tp_alloc(type, 0);
   if (!self) return nullptr;
-  self->core = new ServerCore();
-  self->core->listen_fd = fd;
-  self->core->is_tcp = is_tcp != 0;
-  self->core->token.assign(token, size_t(token_len));
-  self->core->acceptor =
-      std::thread([c = self->core] { c->accept_loop(); });
+  ServerCore* c = new ServerCore();
+  self->core = c;
+  c->listen_fd = fd;
+  c->is_tcp = is_tcp != 0;
+  c->token.assign(token, size_t(token_len));
+  c->next_conn_id = 2;  // 0 = listener sentinel, 1 = wake sentinel
+  int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+  c->epfd = epoll_create1(0);
+  struct epoll_event ev;
+  ev.events = EPOLLIN;
+  ev.data.u64 = 0;  // 0 = the listener
+  epoll_ctl(c->epfd, EPOLL_CTL_ADD, fd, &ev);
+  c->wake_fd = eventfd(0, EFD_NONBLOCK);
+  struct epoll_event wev;
+  wev.events = EPOLLIN;
+  wev.data.u64 = 1;  // 1 = reply-queue wake
+  epoll_ctl(c->epfd, EPOLL_CTL_ADD, c->wake_fd, &wev);
   return (PyObject*)self;
 }
 
 static void Server_dealloc(ServerObject* self) {
   ServerCore* c = self->core;
   if (c) {
-    {
-      std::lock_guard<std::mutex> g(c->mu);
-      c->closed = true;
-      for (auto& [id, fd] : c->conns) ::shutdown(fd, SHUT_RDWR);
-    }
-    ::shutdown(c->listen_fd, SHUT_RDWR);
+    for (auto& [id, cs] : c->conns) ::close(cs.fd);
     ::close(c->listen_fd);
-    c->cv.notify_all();
-    Py_BEGIN_ALLOW_THREADS
-    if (c->acceptor.joinable()) c->acceptor.join();
-    {
-      std::lock_guard<std::mutex> g(c->mu);
-      for (auto& t : c->readers)
-        if (t.joinable()) t.detach();  // readers exit on their closed fds
-    }
-    Py_END_ALLOW_THREADS
-    // send_mus / core leak a few bytes at process teardown by design:
-    // joining every reader here could deadlock against a reply in flight
+    ::close(c->wake_fd);
+    ::close(c->epfd);
+    delete c;
     self->core = nullptr;
   }
   Py_TYPE(self)->tp_free((PyObject*)self);
 }
 
+// next(timeout_ms) -> (conn_id, frame) | None; raises ConnectionError
+// after close().  Runs accept/read/parse inline on the calling thread.
 static PyObject* Server_next(ServerObject* self, PyObject* args) {
   long timeout_ms;
   if (!PyArg_ParseTuple(args, "l", &timeout_ms)) return nullptr;
   ServerCore* c = self->core;
+  if (c->closed) {
+    PyErr_SetString(PyExc_ConnectionError, "server closed");
+    return nullptr;
+  }
   uint64_t conn_id = 0;
   std::string frame;
-  bool got = false, closed = false;
+  bool got = false;
   Py_BEGIN_ALLOW_THREADS
-  {
-    std::unique_lock<std::mutex> lk(c->mu);
-    auto ready = [&] { return c->closed || !c->queue.empty(); };
-    if (timeout_ms < 0) {
-      c->cv.wait(lk, ready);
-    } else {
-      c->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), ready);
-    }
-    if (!c->queue.empty()) {
-      conn_id = c->queue.front().first;
-      frame = std::move(c->queue.front().second);
-      c->queue.pop_front();
+  for (;;) {
+    c->flush_replies();  // pool-thread replies drain on THIS thread
+    if (!c->ready.empty()) {
+      conn_id = c->ready.front().first;
+      frame = std::move(c->ready.front().second);
+      c->ready.pop_front();
       got = true;
+      break;
     }
-    closed = c->closed;
+    struct epoll_event evs[32];
+    int n = epoll_wait(c->epfd, evs, 32, int(timeout_ms));
+    if (n == 0) break;  // timeout
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      c->closed = true;
+      break;
+    }
+    for (int i = 0; i < n; ++i) {
+      if (evs[i].data.u64 == 0) {
+        if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+          c->closed = true;
+        } else {
+          c->accept_ready();
+        }
+      } else if (evs[i].data.u64 == 1) {
+        uint64_t junk;
+        while (::read(c->wake_fd, &junk, 8) == 8) {
+        }
+        // replies flushed at loop top
+      } else {
+        // read even on HUP: there may be buffered final frames
+        uint64_t id = evs[i].data.u64;
+        if (!c->read_conn(id)) c->drop(id);
+      }
+    }
+    if (c->closed) break;
   }
   Py_END_ALLOW_THREADS
   if (got)
     return Py_BuildValue("(Ky#)", (unsigned long long)conn_id, frame.data(),
                          Py_ssize_t(frame.size()));
-  if (closed) {
+  if (c->closed) {
     PyErr_SetString(PyExc_ConnectionError, "server closed");
     return nullptr;
   }
@@ -502,39 +470,29 @@ static PyObject* Server_next(ServerObject* self, PyObject* args) {
 }
 
 static PyObject* Server_reply(ServerObject* self, PyObject* args) {
+  // Callable from ANY thread (the exec thread or max_concurrency pool
+  // callbacks): only enqueues — the exec thread owns the sockets.
   unsigned long long conn_id;
-  const char* frame;
-  Py_ssize_t frame_len;
-  if (!PyArg_ParseTuple(args, "Ky#", &conn_id, &frame, &frame_len))
-    return nullptr;
+  Py_buffer frame;
+  if (!PyArg_ParseTuple(args, "Ky*", &conn_id, &frame)) return nullptr;
   ServerCore* c = self->core;
-  int fd = -1;
-  std::mutex* smu = nullptr;
   {
-    std::lock_guard<std::mutex> g(c->mu);
-    auto it = c->conns.find(conn_id);
-    if (it != c->conns.end()) {
-      fd = it->second;
-      smu = c->send_mus[conn_id];
-    }
+    std::lock_guard<std::mutex> g(c->out_mu);
+    c->out_queue.emplace_back(
+        conn_id, std::string((const char*)frame.buf, size_t(frame.len)));
   }
-  if (fd < 0) Py_RETURN_FALSE;  // caller hung up; it will resend elsewhere
-  bool ok;
-  Py_BEGIN_ALLOW_THREADS
-  ok = send_frame(fd, *smu, frame, size_t(frame_len));
-  Py_END_ALLOW_THREADS
-  return PyBool_FromLong(ok);
+  uint64_t one = 1;
+  (void)!::write(c->wake_fd, &one, 8);  // wake the epoll loop
+  PyBuffer_Release(&frame);
+  Py_RETURN_TRUE;
 }
 
 static PyObject* Server_close(ServerObject* self, PyObject*) {
   ServerCore* c = self->core;
-  {
-    std::lock_guard<std::mutex> g(c->mu);
-    c->closed = true;
-    for (auto& [id, fd] : c->conns) ::shutdown(fd, SHUT_RDWR);
-  }
+  c->closed = true;
   ::shutdown(c->listen_fd, SHUT_RDWR);
-  c->cv.notify_all();
+  uint64_t one = 1;
+  (void)!::write(c->wake_fd, &one, 8);  // wake a parked next()
   Py_RETURN_NONE;
 }
 
@@ -555,7 +513,7 @@ static PyTypeObject ServerType = {
 
 static PyModuleDef rtpu_core_module = {
     PyModuleDef_HEAD_INIT, "_rtpu_core",
-    "Native transport core for direct actor calls", -1,
+    "Native transport core for direct actor calls (threadless)", -1,
     nullptr, nullptr, nullptr, nullptr, nullptr};
 
 }  // namespace
@@ -567,7 +525,7 @@ PyMODINIT_FUNC PyInit__rtpu_core(void) {
   ChannelType.tp_new = Channel_new;
   ChannelType.tp_dealloc = (destructor)Channel_dealloc;
   ChannelType.tp_methods = Channel_methods;
-  ChannelType.tp_doc = "Caller-side direct channel (C++ I/O + reply match)";
+  ChannelType.tp_doc = "Caller-side direct channel (C++ framed I/O)";
   if (PyType_Ready(&ChannelType) < 0) return nullptr;
 
   ServerType.tp_name = "_rtpu_core.Server";
@@ -576,7 +534,7 @@ PyMODINIT_FUNC PyInit__rtpu_core(void) {
   ServerType.tp_new = Server_new;
   ServerType.tp_dealloc = (destructor)Server_dealloc;
   ServerType.tp_methods = Server_methods;
-  ServerType.tp_doc = "Callee-side frame server (C++ accept/read/reply)";
+  ServerType.tp_doc = "Callee-side epoll frame server (threadless)";
   if (PyType_Ready(&ServerType) < 0) return nullptr;
 
   PyObject* m = PyModule_Create(&rtpu_core_module);

@@ -462,6 +462,25 @@ static std::string dispatch(Gcs& g, const wire::Request& req,
       ev.set("oid", Value::Bytes(oid));
       ev.set("lost", Value::Bool(false));
       g.publish("objects", std::move(ev));
+    } else if (m == "add_object_locations") {
+      // batched seal-notification flush: one RPC, many locations
+      const Value* pairs = arg(req, 0, "pairs");
+      if (pairs && pairs->items) {
+        for (const Value& p : *pairs->items) {
+          if (!p.items || p.items->size() != 2) continue;
+          const Value& oid = (*p.items)[0];
+          const Value& nid = (*p.items)[1];
+          if (oid.kind != Value::BYTES || nid.kind != Value::BYTES)
+            continue;
+          g.obj_locs[oid.s].insert(nid.s);
+          g.lost_objects.erase(oid.s);
+          Value ev = Value::Dict();
+          ev.set("ch", Value::Str("objects"));
+          ev.set("oid", Value::Bytes(oid.s));
+          ev.set("lost", Value::Bool(false));
+          g.publish("objects", std::move(ev));
+        }
+      }
     } else if (m == "remove_object_location") {
       std::string oid = arg_bytes(req, 0, "oid");
       auto it = g.obj_locs.find(oid);

@@ -64,8 +64,15 @@ using ObjectId = std::array<uint8_t, kIdLen>;
 
 struct IdHash {
   size_t operator()(const ObjectId& id) const {
-    size_t h;
-    memcpy(&h, id.data(), sizeof(h));
+    // FNV-1a over every byte: ids are an 8-byte process prefix + a
+    // monotonic counter (_private/ids.py), so any fixed-window hash
+    // collapses one producer's ids into one bucket and turns the table
+    // O(n) — the full mix costs ~20 cheap ops and is layout-proof.
+    size_t h = 1469598103934665603ull;
+    for (unsigned char ch : id) {
+      h ^= ch;
+      h *= 1099511628211ull;
+    }
     return h;
   }
 };

@@ -1,13 +1,17 @@
-"""Fused attention for TPU: Pallas flash-attention forward + custom VJP.
+"""Fused attention for TPU: Pallas flash-attention forward AND backward.
 
 Net-new relative to the reference, which delegates attention math to
-torch/vLLM (SURVEY.md §2.4): here it is a first-class op.  The forward pass
-is a Pallas kernel — online-softmax over KV blocks, O(seq) memory, bf16
-inputs with f32 accumulation on the MXU; the backward pass rematerializes
-attention with standard XLA ops (saves only out + logsumexp from forward).
+torch/vLLM (SURVEY.md §2.4): here it is a first-class op.  Forward is a
+Pallas kernel — online-softmax over KV blocks, O(seq) memory, bf16 inputs
+with f32 accumulation on the MXU — and saves the per-row logsumexp.  The
+backward is the FlashAttention-2 split, also in Pallas: a dK/dV kernel
+gridded over KV blocks and a dQ kernel gridded over Q blocks, each
+recomputing p = exp(s - lse) blockwise from the saved statistics, so
+activation memory stays O(seq) end to end (the round-2 backward
+rematerialized the full (q, k) score matrix in XLA — O(seq^2)).
 
-Layout: (batch*heads, seq, head_dim) inside the kernel; the public API takes
-(batch, seq, heads, head_dim) and handles GQA by repeating KV heads.
+Layout: (batch*heads, seq, head_dim) inside the kernels; the public API
+takes (batch, seq, heads, head_dim) and handles GQA by repeating KV heads.
 """
 
 from __future__ import annotations
@@ -42,7 +46,7 @@ def repeat_kv_heads(k, v, num_heads):
     return k, v
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                   causal: bool, sm_scale: float):
     """One (bh, q_block) program: stream KV blocks with online softmax."""
     block_q = q_ref.shape[1]
@@ -90,6 +94,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
 
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    # Per-row logsumexp, saved for the Pallas backward: p = exp(s - lse)
+    # reconstructs softmax blockwise without the O(seq^2) score matrix.
+    # Rows with no unmasked column get +inf-ish so backward p == 0.
+    lse = jnp.where(l == 0.0, -NEG_INF, m + jnp.log(l_safe))
+    lse_ref[0] = lse[:, None]  # (block_q, 1): TPU block-shape rules
+    # want the trailing dim equal to the array's (1), so lse rides as
+    # a 3D (bh, seq, 1) array rather than a 2D row vector
 
 
 @functools.partial(
@@ -107,7 +118,7 @@ def _flash_forward(q, k, v, *, causal: bool, sm_scale: float,
     kernel = functools.partial(
         _flash_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale)
     mem = {} if _VMEM is None else {"memory_space": _VMEM}
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(bh, num_q_blocks),
         in_specs=[
@@ -116,12 +127,19 @@ def _flash_forward(q, k, v, *, causal: bool, sm_scale: float,
             pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0), **mem),
             pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0), **mem),
         ],
-        out_specs=pl.BlockSpec((1, block_q, head_dim),
-                               lambda b, i: (b, i, 0), **mem),
-        out_shape=jax.ShapeDtypeStruct((bh, seq_q, head_dim), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, head_dim),
+                         lambda b, i: (b, i, 0), **mem),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0),
+                         **mem),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_q, head_dim), q.dtype),
+            jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
+        ],
         interpret=interpret,
     )(q, k, v)
-    return out
+    return out, lse
 
 
 def _reference_attention(q, k, v, causal: bool, sm_scale: float):
@@ -137,40 +155,194 @@ def _reference_attention(q, k, v, causal: bool, sm_scale: float):
     return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, causal: bool,
+                          sm_scale: float):
+    """One (bh, k_block) program: accumulate dK/dV over the Q blocks that
+    attend to this KV block (FlashAttention-2 backward, column pass)."""
+    block_k = k_ref.shape[1]
+    head_dim = k_ref.shape[2]
+    seq_q = q_ref.shape[1]
+    ki = pl.program_id(1)
+    k_offset = ki * block_k
+
+    k = k_ref[0].astype(jnp.float32)  # (block_k, d)
+    v = v_ref[0].astype(jnp.float32)
+
+    num_q = pl.cdiv(seq_q, block_q)
+    # causal: rows before this KV block's first row never attend to it
+    start_q = (k_offset // block_q) if causal else 0
+
+    def body(j, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(j * block_q, block_q), 0]
+        delta = delta_ref[0, pl.ds(j * block_q, block_q), 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # (bq, bk)
+        if causal:
+            row = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = k_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(row >= col, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # (bq, bk), masked entries -> 0
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # p^T @ do
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (bq, bk)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # ds^T @ q
+        return dk_acc, dv_acc
+
+    zeros = jnp.zeros((block_k, head_dim), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start_q, num_q, body, (zeros, zeros))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, causal: bool,
+                         sm_scale: float):
+    """One (bh, q_block) program: accumulate dQ over this block's KV range
+    (FlashAttention-2 backward, row pass)."""
+    block_q = q_ref.shape[1]
+    head_dim = q_ref.shape[2]
+    seq_k = k_ref.shape[1]
+    qi = pl.program_id(1)
+    q_offset = qi * block_q
+
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
+
+    if causal:
+        num_kv = jnp.minimum(
+            pl.cdiv(q_offset + block_q, block_k), pl.cdiv(seq_k, block_k))
+    else:
+        num_kv = pl.cdiv(seq_k, block_k)
+
+    def body(j, dq_acc):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            row = q_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(row >= col, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        return dq_acc + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros((block_q, head_dim), jnp.float32)
+    dq = jax.lax.fori_loop(0, num_kv, body, dq0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "sm_scale", "block_q", "block_k",
+                              "interpret"))
+def _flash_backward(q, k, v, out, lse, d_out, *, causal: bool,
+                    sm_scale: float, block_q: int, block_k: int,
+                    interpret: bool):
+    bh, seq_q, head_dim = q.shape
+    seq_k = k.shape[1]
+    block_q = min(block_q, seq_q)
+    block_k = min(block_k, seq_k)
+    mem = {} if _VMEM is None else {"memory_space": _VMEM}
+    # delta = rowsum(do * o): one fused elementwise+reduce, O(seq) memory
+    delta = jnp.sum(d_out.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)[..., None]  # (bh, seq_q, 1)
+
+    full_q = pl.BlockSpec((1, seq_q, head_dim), lambda b, i: (b, 0, 0),
+                          **mem)
+    full_k = pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0),
+                          **mem)
+    row_stats = pl.BlockSpec((1, seq_q, 1), lambda b, i: (b, 0, 0),
+                             **mem)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+                          causal=causal, sm_scale=sm_scale),
+        grid=(bh, pl.cdiv(seq_k, block_k)),
+        in_specs=[full_q,
+                  pl.BlockSpec((1, block_k, head_dim),
+                               lambda b, i: (b, i, 0), **mem),
+                  pl.BlockSpec((1, block_k, head_dim),
+                               lambda b, i: (b, i, 0), **mem),
+                  full_q, row_stats, row_stats],
+        out_specs=[
+            pl.BlockSpec((1, block_k, head_dim),
+                         lambda b, i: (b, i, 0), **mem),
+            pl.BlockSpec((1, block_k, head_dim),
+                         lambda b, i: (b, i, 0), **mem),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_k, head_dim), k.dtype),
+            jax.ShapeDtypeStruct((bh, seq_k, head_dim), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, d_out, lse, delta)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
+                          causal=causal, sm_scale=sm_scale),
+        grid=(bh, pl.cdiv(seq_q, block_q)),
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim),
+                         lambda b, i: (b, i, 0), **mem),
+            full_k, full_k,
+            pl.BlockSpec((1, block_q, head_dim),
+                         lambda b, i: (b, i, 0), **mem),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0),
+                         **mem),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0),
+                         **mem),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, head_dim),
+                               lambda b, i: (b, i, 0), **mem),
+        out_shape=jax.ShapeDtypeStruct((bh, seq_q, head_dim), q.dtype),
+        interpret=interpret,
+    )(q, k, v, d_out, lse, delta)
+    return dq, dk, dv
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_attention(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal=causal, sm_scale=sm_scale,
-                          block_q=block_q, block_k=block_k,
-                          interpret=interpret)
+    out, _ = _flash_forward(q, k, v, causal=causal, sm_scale=sm_scale,
+                            block_q=block_q, block_k=block_k,
+                            interpret=interpret)
+    return out
 
 
 def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal=causal, sm_scale=sm_scale,
-                         block_q=block_q, block_k=block_k,
-                         interpret=interpret)
-    return out, (q, k, v, out)
+    out, lse = _flash_forward(q, k, v, causal=causal, sm_scale=sm_scale,
+                              block_q=block_q, block_k=block_k,
+                              interpret=interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, sm_scale, block_q, block_k, interpret, res, d_out):
-    q, k, v, out = res
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    do = d_out.astype(jnp.float32)
-    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * sm_scale
-    if causal:
-        row = jnp.arange(s.shape[-2])[:, None]
-        col = jnp.arange(s.shape[-1])[None, :]
-        s = jnp.where(row >= col, s, NEG_INF)
-    lse = jax.scipy.special.logsumexp(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - lse)  # rematerialized softmax
-    dv = jnp.einsum("bqk,bqd->bkd", p, do)
-    dp = jnp.einsum("bqd,bkd->bqk", do, vf)
-    delta = jnp.sum(do * out.astype(jnp.float32), axis=-1, keepdims=True)
-    ds = p * (dp - delta) * sm_scale
-    dq = jnp.einsum("bqk,bkd->bqd", ds, kf)
-    dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    q, k, v, out, lse = res
+    return _flash_backward(q, k, v, out, lse, d_out, causal=causal,
+                           sm_scale=sm_scale, block_q=block_q,
+                           block_k=block_k, interpret=interpret)
 
 
 _flash_attention.defvjp(_fwd, _bwd)

@@ -91,9 +91,17 @@ def test_timeline_export(tmp_path):
 
 
 def test_list_objects_tracks_locations():
+    import time
+
     ref = ray_tpu.put(b"state-api-payload")
-    objs = state.list_objects()
-    ids = {o["object_id"] for o in objs}
+    # location publishing is batched (ObjectTransfer seal flusher, ~10ms
+    # window): the directory is eventually consistent by design
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        ids = {o["object_id"] for o in state.list_objects()}
+        if ref.binary().hex() in ids:
+            break
+        time.sleep(0.05)
     assert ref.binary().hex() in ids
 
 
