@@ -198,6 +198,14 @@ class Node:
             # Persisted-GCS recovery: re-create actors restored as
             # RESTARTING (no-op on a fresh control plane).
             self.scheduler.recover_restored_actors()
+        # Structured event export for external consumers (reference:
+        # export_event_logger.py); enabled by RTPU_EXPORT_EVENTS.  Every
+        # node exports its own task events; the head also subscribes to
+        # the GCS actor/node channels (once, cluster-wide).
+        from ray_tpu.util.events import start_exporter
+
+        self._event_exporter = start_exporter(self.gcs_address,
+                                              subscribe=head)
         self.dashboard = None
         self.dashboard_url = None
         if head and include_dashboard and not os.environ.get(
@@ -282,6 +290,9 @@ class Node:
         )
 
     def shutdown(self):
+        exporter = getattr(self, "_event_exporter", None)
+        if exporter is not None:
+            exporter.shutdown()
         jm = getattr(self.scheduler, "job_manager", None)
         if jm is not None:
             jm.shutdown()

@@ -25,8 +25,10 @@ _KV_NS = "runtime_env_packages"
 _MAX_PACKAGE_BYTES = 256 << 20
 _EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
 
-_SUPPORTED = {"env_vars", "working_dir", "py_modules", "config"}
-_REJECTED = {"pip", "conda", "uv", "container", "image_uri"}
+_SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip", "config"}
+# conda/container isolation needs an image-build pipeline; pip installs
+# work (offline via RTPU_PIP_ARGS wheel mirrors — see ensure_pip_env)
+_REJECTED = {"conda", "uv", "container", "image_uri"}
 
 
 def validate(runtime_env: Optional[dict]) -> Optional[dict]:
@@ -35,9 +37,10 @@ def validate(runtime_env: Optional[dict]) -> Optional[dict]:
     bad = set(runtime_env) & _REJECTED
     if bad:
         raise ValueError(
-            f"runtime_env fields {sorted(bad)} are not supported: cluster "
-            f"nodes have no package-install egress; bake dependencies into "
-            f"the node image instead")
+            f"runtime_env fields {sorted(bad)} are not supported: conda/"
+            f"container isolation requires an image-build pipeline; use "
+            f"'pip' (offline-capable via RTPU_PIP_ARGS) or bake "
+            f"dependencies into the node image")
     unknown = set(runtime_env) - _SUPPORTED
     if unknown:
         raise ValueError(f"unknown runtime_env fields {sorted(unknown)}; "
@@ -186,6 +189,63 @@ class AppliedEnv:
                 os.environ[k] = prev
 
 
+_PIP_ENVS_ROOT = "/tmp/ray_tpu/pip_envs"
+_pip_env_lock = None  # lazily a threading.Lock (workers are threaded)
+
+
+def ensure_pip_env(requirements: list) -> str:
+    """Materialize a pip requirement set into a content-addressed target
+    directory; returns the directory (added to sys.path on apply).
+
+    Counterpart of the reference's pip runtime-env plugin
+    (/root/reference/python/ray/_private/runtime_env/pip.py), sized for
+    air-gapped TPU pods: instead of a full virtualenv + dedicated worker
+    process, packages install once per node into a cached ``--target``
+    directory and activate additively via sys.path — the same additive
+    semantics the reference's pip env has with system-site-packages.
+    Offline installs: put extra pip args (e.g. ``--no-index
+    --find-links /wheels``) in RTPU_PIP_ARGS.
+    """
+    import fcntl
+    import hashlib
+    import subprocess
+    import threading
+
+    global _pip_env_lock
+    if _pip_env_lock is None:
+        _pip_env_lock = threading.Lock()
+    reqs = sorted(str(r) for r in requirements)
+    extra = os.environ.get("RTPU_PIP_ARGS", "").split()
+    tag = hashlib.sha256(
+        ("\n".join(reqs + extra)).encode()).hexdigest()[:16]
+    dest = os.path.join(_PIP_ENVS_ROOT, f"pip-{tag}")
+    marker = os.path.join(dest, ".rtpu_ready")
+    os.makedirs(_PIP_ENVS_ROOT, exist_ok=True)
+    # Workers are separate OS PROCESSES: the install critical section needs
+    # a file lock, not just a thread lock (a shared --target dir being
+    # written by two pips concurrently yields torn package trees — the
+    # reference's pip plugin locks the same way).
+    with _pip_env_lock, open(dest + ".lock", "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(marker):
+                return dest
+            os.makedirs(dest, exist_ok=True)
+            cmd = [sys.executable, "-m", "pip", "install", "--target",
+                   dest, "--no-warn-script-location", *extra, *reqs]
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=600)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"runtime_env pip install failed "
+                    f"({' '.join(reqs)}): {proc.stderr[-2000:]}")
+            with open(marker, "w") as f:
+                f.write("\n".join(reqs))
+        finally:
+            fcntl.flock(lockf, fcntl.LOCK_UN)
+    return dest
+
+
 def apply(runtime_env: Optional[dict], ctx) -> Optional[AppliedEnv]:
     if not runtime_env:
         return None
@@ -203,6 +263,13 @@ def apply(runtime_env: Optional[dict], ctx) -> Optional[AppliedEnv]:
             applied._sys_path_added.append(path)
         for uri in runtime_env.get("py_modules") or []:
             path = _materialize(uri, ctx)
+            sys.path.insert(0, path)
+            applied._sys_path_added.append(path)
+        pip_reqs = runtime_env.get("pip")
+        if pip_reqs:
+            if isinstance(pip_reqs, dict):  # {"packages": [...]} form
+                pip_reqs = pip_reqs.get("packages") or []
+            path = ensure_pip_env(list(pip_reqs))
             sys.path.insert(0, path)
             applied._sys_path_added.append(path)
     except BaseException:

@@ -36,6 +36,7 @@ from ray_tpu._private.object_transfer import ObjectTransfer
 from ray_tpu._private.protocol import (
     Connection,
     authenticate_server_side,
+    cluster_token,
     is_tcp_addr,
     listener_addr,
 )
@@ -70,6 +71,59 @@ def _dbg(msg):
             f.write(f"{time.time():.3f} {msg}\n")
     except OSError:
         pass
+
+
+class _ConnCtx:
+    """One node-service connection: the sendable conn, the worker bound
+    to it (after "register"), and how to run blocking rpc handlers.
+    Thread-per-conn transport: offload = run inline (this thread IS the
+    connection's thread)."""
+
+    __slots__ = ("conn", "worker")
+
+    def __init__(self, conn):
+        self.conn = conn
+        self.worker = None
+
+    def close(self):
+        self.conn.close()
+
+    def offload(self, fn):
+        fn()
+
+
+class _NativeConnShim:
+    """WorkerState.conn replacement under the native node server: sends
+    enqueue frames to the C++ exec loop (callable from any thread —
+    dispatch, rpc pool, kill threads)."""
+
+    __slots__ = ("_srv", "_cid")
+
+    def __init__(self, srv, conn_id: int):
+        self._srv = srv
+        self._cid = conn_id
+
+    def send(self, msg: dict):
+        import pickle as _pickle
+
+        self._srv.reply(self._cid, _pickle.dumps(msg, protocol=5))
+
+    def close(self):
+        self._srv.kick(self._cid)
+
+
+class _NativeConnCtx(_ConnCtx):
+    """Native-server connection context: rpc handlers offload to a pool
+    (the event loop has ONE serving thread and some handlers block)."""
+
+    __slots__ = ("_pool",)
+
+    def __init__(self, conn, pool):
+        super().__init__(conn)
+        self._pool = pool
+
+    def offload(self, fn):
+        self._pool.submit(fn)
 
 
 @dataclass
@@ -193,9 +247,28 @@ class Scheduler:
 
             self._log_monitor = LogMonitor(self._pool.logs_dir,
                                            self._forward_worker_logs)
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, name="sched-accept", daemon=True
-        )
+        # Node service transport: the native event loop (one C++ epoll
+        # serving thread, the raylet's asio-loop counterpart —
+        # src/ray/raylet/main.cc runs the node manager the same way) when
+        # the extension is available; thread-per-connection otherwise
+        # (and always under chaos, which injects at the Python frame
+        # layer).
+        from ray_tpu._private import direct as direct_mod
+
+        self._node_srv = None
+        core = direct_mod.native_core()
+        if core is not None:
+            token = cluster_token() if self._is_tcp else ""
+            self._node_srv = core.Server(
+                self._listener.detach(), int(self._is_tcp),
+                token.encode("utf-8"))
+            self._accept_thread = threading.Thread(
+                target=self._native_serve_loop, name="sched-serve",
+                daemon=True)
+        else:
+            self._accept_thread = threading.Thread(
+                target=self._accept_loop, name="sched-accept", daemon=True
+            )
         self._sched_thread = threading.Thread(
             target=self._schedule_loop, name="sched-loop", daemon=True
         )
@@ -313,6 +386,17 @@ class Scheduler:
             ev["ok"] = ok if ok is not None else (state == "FINISHED")
         elif state == "FORWARDED":
             ev["end_ts"] = now
+        if state in ("FINISHED", "FAILED"):
+            # terminal records stream to the export pipeline when enabled
+            # (reference: task events -> GcsTaskManager -> export loggers)
+            from ray_tpu.util.events import get_exporter
+
+            exporter = get_exporter()
+            if exporter is not None:
+                try:
+                    exporter.export_task_event(dict(ev))
+                except Exception:
+                    pass
 
     def list_task_events(self) -> list[dict]:
         with self._lock:
@@ -576,6 +660,8 @@ class Scheduler:
         if self._log_monitor is not None:
             self._log_monitor.stop()
         self._pool.shutdown_all()
+        if self._node_srv is not None:
+            self._node_srv.close()
         try:
             self._listener.close()
         except OSError:
@@ -600,12 +686,53 @@ class Scheduler:
             threading.Thread(target=self._reader_loop, args=(conn,),
                              daemon=True).start()
 
+    def _native_serve_loop(self):
+        """Node service on the C++ epoll server: ONE serving thread runs
+        accept/read/parse/dispatch for every worker, peer, and rpc
+        connection (the reference raylet's single asio io_context).  An
+        empty frame is the server's disconnect marker — that is what
+        triggers worker-death recovery."""
+        import pickle as _pickle
+        from concurrent.futures import ThreadPoolExecutor
+
+        srv = self._node_srv
+        ctxs: dict[int, _NativeConnCtx] = {}
+        rpc_pool = ThreadPoolExecutor(8, thread_name_prefix="sched-rpc")
+        while True:
+            try:
+                item = srv.next(-1)
+            except ConnectionError:
+                rpc_pool.shutdown(wait=False)
+                return  # server closed (node shutdown)
+            if item is None:
+                continue
+            conn_id, frame = item
+            if not frame:  # disconnect marker
+                ctx = ctxs.pop(conn_id, None)
+                if ctx is not None and ctx.worker is not None:
+                    self._on_worker_death(ctx.worker)
+                continue
+            ctx = ctxs.get(conn_id)
+            if ctx is None:
+                ctx = _NativeConnCtx(_NativeConnShim(srv, conn_id),
+                                     rpc_pool)
+                ctxs[conn_id] = ctx
+            try:
+                msg = _pickle.loads(frame)
+                keep = self._handle_node_msg(msg, ctx)
+            except Exception:
+                if not self._shutdown:
+                    traceback.print_exc()
+                keep = False  # treat a raising handler as a broken conn
+            if not keep:
+                srv.kick(conn_id)  # its disconnect marker runs cleanup
+
     def _reader_loop(self, conn: Connection):
         # TCP peers must pass the cluster-token handshake before any frame
         # of theirs is unpickled (see protocol.py).
         if not authenticate_server_side(conn, self._is_tcp):
             return
-        worker: Optional[WorkerState] = None
+        ctx = _ConnCtx(conn)
         # The try/finally is load-bearing: a raising handler (injected RPC
         # chaos in a GCS call, a malformed frame) must still run
         # _on_worker_death, or the worker's in-flight tasks are never
@@ -618,85 +745,99 @@ class Scheduler:
                     break
                 if msg is None:
                     break
-                t = msg["t"]
-                if t == "register":
-                    worker_id = bytes.fromhex(msg["worker_id"])
-                    with self._lock:
-                        worker = self._workers.get(worker_id)
-                        if worker is None:  # late registration after shutdown
-                            conn.close()
-                            return
-                        worker.conn = conn
-                        worker.server_addr = msg.get("server_addr")
-                        worker.idle = True
-                        self._wake.notify_all()
-                elif t == "done":
-                    self._on_task_done(worker, msg)
-                elif t == "submit":
-                    try:
-                        self.submit(msg["spec"])
-                    except ValueError as e:
-                        self._fail_task(msg["spec"], e)
-                elif t == "actor_exit":
-                    with self._lock:
-                        self.gcs.update_actor(msg["actor_id"], max_restarts=0)
-                elif t == "sealed":
-                    # a worker sealed an object into this node's store: record
-                    # the location so other nodes can pull it
-                    self.note_sealed(msg["oid"])
-                elif t == "worker_logs":
-                    # a worker node's monitor forwarding its workers' output;
-                    # pre-attach lines buffer just like head-local ones
-                    sink = self.log_sink
-                    if sink is not None:
-                        try:
-                            sink(msg["lines"])
-                        except Exception:
-                            pass
-                    else:
-                        self._early_logs.extend(msg["lines"])
-                elif t == "submit_spilled":
-                    self.submit_spilled(msg["spec"])
-                elif t == "spilled_done":
-                    with self._lock:
-                        self._forwarded.pop(msg["task_id"], None)
-                elif t == "spill_moved":
-                    # a relay moved our forwarded spec to another node: track
-                    # the node actually executing it for death recovery
-                    with self._lock:
-                        fwd = self._forwarded.get(msg["task_id"])
-                        if fwd is not None:
-                            self._forwarded[msg["task_id"]] = (msg["node"], fwd[1])
-                elif t == "kill_actor":
-                    # kill now BLOCKS until the worker exits (so callers
-                    # observe the death) — run it off the link reader, or
-                    # a wedged worker would stall every control message
-                    # from this peer for seconds
-                    threading.Thread(
-                        target=self.kill_actor,
-                        args=(msg["actor_id"],
-                              msg.get("no_restart", True)),
-                        name="kill-actor", daemon=True).start()
-                elif t == "cancel":
-                    self.cancel(msg["task_id"], msg.get("force", False))
-                elif t == "blocked":
-                    if worker is not None:
-                        self._on_worker_blocked(worker)
-                elif t == "unblocked":
-                    if worker is not None:
-                        self._on_worker_unblocked(worker)
-                elif t == "rpc":
-                    try:
-                        result = self._handle_rpc(msg["method"], msg.get("params", {}))
-                        conn.send({"ok": True, "result": result})
-                    except Exception as e:
-                        try:
-                            conn.send({"ok": False, "error": repr(e)})
-                        except OSError:
-                            break  # caller hung up mid-rpc (e.g. process exit)
+                if not self._handle_node_msg(msg, ctx):
+                    break
         finally:
-            if worker is not None:
-                self._on_worker_death(worker)
+            if ctx.worker is not None:
+                self._on_worker_death(ctx.worker)
+
+    def _handle_node_msg(self, msg: dict, ctx: "_ConnCtx") -> bool:
+        """One node-service message, transport-agnostic (shared by the
+        thread-per-conn server and the native event-loop server).
+        Returns False when the connection must close."""
+        t = msg["t"]
+        if t == "register":
+            worker_id = bytes.fromhex(msg["worker_id"])
+            with self._lock:
+                worker = self._workers.get(worker_id)
+                if worker is None:  # late registration after shutdown
+                    ctx.close()
+                    return False
+                ctx.worker = worker
+                worker.conn = ctx.conn
+                worker.server_addr = msg.get("server_addr")
+                worker.idle = True
+                self._wake.notify_all()
+        elif t == "done":
+            self._on_task_done(ctx.worker, msg)
+        elif t == "submit":
+            try:
+                self.submit(msg["spec"])
+            except ValueError as e:
+                self._fail_task(msg["spec"], e)
+        elif t == "actor_exit":
+            with self._lock:
+                self.gcs.update_actor(msg["actor_id"], max_restarts=0)
+        elif t == "sealed":
+            # a worker sealed an object into this node's store: record
+            # the location so other nodes can pull it
+            self.note_sealed(msg["oid"])
+        elif t == "worker_logs":
+            # a worker node's monitor forwarding its workers' output;
+            # pre-attach lines buffer just like head-local ones
+            sink = self.log_sink
+            if sink is not None:
+                try:
+                    sink(msg["lines"])
+                except Exception:
+                    pass
+            else:
+                self._early_logs.extend(msg["lines"])
+        elif t == "submit_spilled":
+            self.submit_spilled(msg["spec"])
+        elif t == "spilled_done":
+            with self._lock:
+                self._forwarded.pop(msg["task_id"], None)
+        elif t == "spill_moved":
+            # a relay moved our forwarded spec to another node: track
+            # the node actually executing it for death recovery
+            with self._lock:
+                fwd = self._forwarded.get(msg["task_id"])
+                if fwd is not None:
+                    self._forwarded[msg["task_id"]] = (msg["node"], fwd[1])
+        elif t == "kill_actor":
+            # kill BLOCKS until the worker exits (so callers observe the
+            # death) — run it off the serving thread, or a wedged worker
+            # would stall every control message behind it for seconds
+            threading.Thread(
+                target=self.kill_actor,
+                args=(msg["actor_id"], msg.get("no_restart", True)),
+                name="kill-actor", daemon=True).start()
+        elif t == "cancel":
+            self.cancel(msg["task_id"], msg.get("force", False))
+        elif t == "blocked":
+            if ctx.worker is not None:
+                self._on_worker_blocked(ctx.worker)
+        elif t == "unblocked":
+            if ctx.worker is not None:
+                self._on_worker_unblocked(ctx.worker)
+        elif t == "rpc":
+            def run_rpc():
+                try:
+                    result = self._handle_rpc(msg["method"],
+                                              msg.get("params", {}))
+                    ctx.conn.send({"ok": True, "result": result})
+                except Exception as e:
+                    try:
+                        ctx.conn.send({"ok": False, "error": repr(e)})
+                    except OSError:
+                        ctx.close()  # caller hung up mid-rpc
+
+            # rpc conns are one-shot, so offloading preserves ordering;
+            # the native server MUST offload (handlers like fetch_object
+            # or pg 2PC block, and it has one serving thread)
+            ctx.offload(run_rpc)
+        return True
 
     def _handle_rpc(self, method: str, params: dict):
         """Request/response control-plane calls from workers (one-shot conns)."""
@@ -808,6 +949,35 @@ class Scheduler:
                 return jm.logs(params["submission_id"])
             if method == "job_stop":
                 return jm.stop(params["submission_id"])
+        if method == "list_logs":
+            # per-node log browsing (reference: the dashboard agent's log
+            # API, python/ray/dashboard/modules/log/) — this node's
+            # scheduler IS its agent
+            logs_dir = self._pool.logs_dir
+            out = []
+            try:
+                for name in sorted(os.listdir(logs_dir)):
+                    path = os.path.join(logs_dir, name)
+                    if os.path.isfile(path):
+                        out.append({"file": name,
+                                    "size": os.path.getsize(path)})
+            except OSError:
+                pass
+            return out
+        if method == "read_log":
+            name = os.path.basename(params["file"])  # no path traversal
+            path = os.path.join(self._pool.logs_dir, name)
+            tail = int(params.get("tail", 200))
+            try:
+                with open(path, "rb") as f:
+                    f.seek(0, 2)
+                    size = f.tell()
+                    f.seek(max(0, size - 256 * 1024))
+                    data = f.read().decode(errors="replace")
+            except OSError:
+                return {"lines": [], "error": f"no such log: {name}"}
+            lines = data.splitlines()
+            return {"lines": lines[-tail:] if tail > 0 else lines}
         if method == "pull":
             return self.trigger_pull(params["oid"])
         if method == "object_locations":

@@ -300,7 +300,38 @@ class DashboardHead:
             text = await loop.run_in_executor(None, self._status_html)
             return web.Response(text=text, content_type="text/html")
 
+        async def logs(request):
+            # /api/logs?node_id=<hex>            -> list that node's logs
+            # /api/logs?node_id=<hex>&file=F&tail=N -> tail one log
+            # (reference: dashboard modules/log, served per node by its
+            # agent — here each node's scheduler plays the agent)
+            node_hex = request.query.get("node_id", "")
+            fname = request.query.get("file")
+            try:
+                tail = int(request.query.get("tail", "200"))
+            except ValueError:
+                tail = 200  # structured JSON beats a 500 on ?tail=abc
+
+            def fetch():
+                for n in self._gcs.list_nodes():
+                    if n.alive and n.node_id.hex() == node_hex:
+                        if fname:
+                            return _node_rpc(n.sched_socket, "read_log",
+                                             {"file": fname, "tail": tail})
+                        return _node_rpc(n.sched_socket, "list_logs")
+                if not node_hex:  # default: the head node's logs
+                    if fname:
+                        return _node_rpc(self._head_sock, "read_log",
+                                         {"file": fname, "tail": tail})
+                    return _node_rpc(self._head_sock, "list_logs")
+                return {"error": f"no alive node {node_hex}"}
+
+            data = await loop.run_in_executor(None, fetch)
+            return web.Response(text=json.dumps(data, default=str),
+                                content_type="application/json")
+
         app = web.Application()
+        app.router.add_get("/api/logs", logs)
         app.router.add_get("/", index)
         app.router.add_get("/status", status_page)
         app.router.add_get("/api/nodes", json_handler(self._nodes))

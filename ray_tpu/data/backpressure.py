@@ -1,0 +1,73 @@
+"""Pluggable backpressure policies for the streaming executor.
+
+Counterpart of the reference's backpressure policy plugins
+(/root/reference/python/ray/data/_internal/execution/backpressure_policy/:
+ConcurrencyCapBackpressurePolicy, StreamingOutputBackpressurePolicy).  The
+pull-based generator executor gives coarse backpressure for free (an op
+launches at most ``window`` tasks and only refills when downstream
+consumes); policies refine WHEN the window may refill:
+
+- ``ConcurrencyCapPolicy``: the classic in-flight task cap (the default).
+- ``OutputBytesPolicy``: bound the estimated bytes of unconsumed output an
+  op may hold in the object store — ops producing huge blocks throttle
+  below their concurrency cap so the store isn't flooded (the reference's
+  streaming-output policy plays this role).
+
+Custom policies subclass ``BackpressurePolicy`` and are installed on the
+``DataContext``::
+
+    ctx = DataContext.get_current()
+    ctx.backpressure_policies = [MyPolicy(), ConcurrencyCapPolicy()]
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class OpSnapshot:
+    """What a policy sees before each launch decision."""
+
+    op_name: str
+    in_flight: int            # tasks currently running
+    window: int               # the op's configured concurrency cap
+    bytes_per_task: float     # rolling estimate of output bytes per task
+    outstanding_bytes: float  # estimated unconsumed output in the store
+
+
+class BackpressurePolicy:
+    """Decide whether an operator may launch one more task."""
+
+    def can_launch(self, snap: OpSnapshot) -> bool:
+        raise NotImplementedError
+
+
+class ConcurrencyCapPolicy(BackpressurePolicy):
+    """At most ``window`` tasks in flight (reference:
+    ConcurrencyCapBackpressurePolicy)."""
+
+    def can_launch(self, snap: OpSnapshot) -> bool:
+        return snap.in_flight < snap.window
+
+
+class OutputBytesPolicy(BackpressurePolicy):
+    """Bound estimated unconsumed output bytes per op (reference:
+    StreamingOutputBackpressurePolicy).  Always admits the first task —
+    the estimate needs one completed task to calibrate."""
+
+    def __init__(self, max_outstanding_bytes: int = 512 * 1024 * 1024):
+        self.max_outstanding_bytes = max_outstanding_bytes
+
+    def can_launch(self, snap: OpSnapshot) -> bool:
+        if snap.in_flight == 0:
+            return True
+        if snap.bytes_per_task <= 0:
+            # uncalibrated (no task has completed): hold concurrency low
+            # instead of flooding the window before the first estimate
+            return snap.in_flight < 2
+        return snap.outstanding_bytes < self.max_outstanding_bytes
+
+
+def default_policies() -> list:
+    return [ConcurrencyCapPolicy(), OutputBytesPolicy()]

@@ -10,6 +10,7 @@ from __future__ import annotations
 import os
 import threading
 from dataclasses import dataclass, field
+from typing import Optional
 
 
 @dataclass
@@ -34,6 +35,12 @@ class DataContext:
     wait_for_min_actors_s: int = 60
     # Retries for data tasks (transient worker crashes).
     task_max_retries: int = 2
+    # Pluggable launch-gating policies consulted by every task-launching
+    # operator (reference: _internal/execution/backpressure_policy/).
+    # None = data.backpressure.default_policies() (concurrency cap +
+    # output-bytes bound); install custom BackpressurePolicy instances to
+    # change admission behavior.
+    backpressure_policies: Optional[list] = None
 
     _instance = None
     _lock = threading.Lock()

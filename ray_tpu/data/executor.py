@@ -118,13 +118,28 @@ class InputOp(PhysicalOp):
 
 
 def _window_run(submit: Callable[[], Optional[ObjectRef]],
-                window: int, stats: OpStats) -> Iterator[List[RefBundle]]:
-    """Core streaming loop for task-launching ops: keep up to ``window``
-    tasks in flight; yield results of whichever finishes first."""
+                window: int, stats: OpStats,
+                policies: Optional[list] = None,
+                op_name: str = "") -> Iterator[List[RefBundle]]:
+    """Core streaming loop for task-launching ops: keep tasks in flight up
+    to the concurrency window AND every backpressure policy's consent
+    (data/backpressure.py); yield results in FIFO order."""
+    from ray_tpu.data.backpressure import OpSnapshot, default_policies
+
+    if policies is None:
+        policies = default_policies()
     pending: deque = deque()
     exhausted = False
+    bytes_per_task = 0.0  # rolling estimate from completed tasks
+    completed = 0
     while True:
         while not exhausted and len(pending) < window:
+            snap = OpSnapshot(
+                op_name=op_name, in_flight=len(pending), window=window,
+                bytes_per_task=bytes_per_task,
+                outstanding_bytes=bytes_per_task * len(pending))
+            if not all(p.can_launch(snap) for p in policies):
+                break
             ref = submit()
             if ref is None:
                 exhausted = True
@@ -132,14 +147,27 @@ def _window_run(submit: Callable[[], Optional[ObjectRef]],
             pending.append(ref)
             stats.tasks += 1
         if not pending:
-            return
+            if exhausted:
+                return
+            # a policy denied the launch with NOTHING in flight: input
+            # remains, so returning would silently truncate the dataset —
+            # wait for whatever external condition the policy watches
+            time.sleep(0.02)
+            continue
         # Yield in submission (FIFO) order so dataset order is deterministic
         # (reference: streaming executor preserves block order).  Later tasks
         # in the window keep running while we wait on the head.
         head = pending.popleft()
         result = ray_tpu.get(head)
+        out_bytes = 0
         for _, meta in result:
             stats.rows += meta.num_rows
+            out_bytes += meta.size_bytes or 0
+        completed += 1
+        # exponential moving average keeps the estimate fresh across
+        # size regimes without storing per-task history
+        alpha = 1.0 if completed == 1 else 0.25
+        bytes_per_task += alpha * (out_bytes - bytes_per_task)
         yield result
 
 
@@ -166,7 +194,9 @@ class TaskMapOp(PhysicalOp):
             return task.remote(*[ref for ref, _ in bundle])
 
         t0 = time.perf_counter()
-        yield from _window_run(submit, self._window, stats)
+        yield from _window_run(submit, self._window, stats,
+                               policies=self._ctx.backpressure_policies,
+                               op_name=self.name)
         stats.wall_s += time.perf_counter() - t0
 
 
@@ -197,7 +227,8 @@ class ReadOp(PhysicalOp):
 
         t0 = time.perf_counter()
         yield from _window_run(
-            submit, self._ctx.max_tasks_in_flight_per_op, stats)
+            submit, self._ctx.max_tasks_in_flight_per_op, stats,
+            policies=self._ctx.backpressure_policies, op_name=self.name)
         stats.wall_s += time.perf_counter() - t0
 
 

@@ -270,10 +270,16 @@ struct ServerCore {
     epoll_ctl(epfd, EPOLL_CTL_DEL, it->second.fd, nullptr);
     by_fd.erase(it->second.fd);
     ::close(it->second.fd);
+    bool was_ready = it->second.phase == ConnState::READY;
     conns.erase(it);
+    // surface the disconnect to Python as an EMPTY frame (never legal on
+    // the wire) so the consumer can run its death/cleanup handler — the
+    // raylet-mode consumer requeues the dead worker's in-flight tasks
+    if (was_ready) ready.emplace_back(id, std::string());
   }
 
-  // Exec-thread only: drain queued replies onto their sockets.
+  // Exec-thread only: drain queued replies onto their sockets.  An empty
+  // queued frame is the close command (Server.kick).
   void flush_replies() {
     for (;;) {
       uint64_t id;
@@ -287,6 +293,10 @@ struct ServerCore {
       }
       auto it = conns.find(id);
       if (it == conns.end()) continue;  // caller hung up; it will resend
+      if (frame.empty()) {
+        drop(id);
+        continue;
+      }
       if (!send_frame(it->second.fd, dummy_send_mu, frame.data(),
                       frame.size()))
         drop(id);
@@ -352,6 +362,7 @@ struct ServerCore {
         cs.phase = ConnState::READY;
         continue;
       }
+      if (frame.empty()) continue;  // empty frames are reserved markers
       ready.emplace_back(id, std::move(frame));
       frame.clear();
     }
@@ -496,12 +507,28 @@ static PyObject* Server_close(ServerObject* self, PyObject*) {
   Py_RETURN_NONE;
 }
 
+static PyObject* Server_kick(ServerObject* self, PyObject* args) {
+  // Close a connection from any thread (processed by the exec thread).
+  unsigned long long conn_id;
+  if (!PyArg_ParseTuple(args, "K", &conn_id)) return nullptr;
+  ServerCore* c = self->core;
+  {
+    std::lock_guard<std::mutex> g(c->out_mu);
+    c->out_queue.emplace_back(conn_id, std::string());
+  }
+  uint64_t one = 1;
+  (void)!::write(c->wake_fd, &one, 8);
+  Py_RETURN_NONE;
+}
+
 static PyMethodDef Server_methods[] = {
     {"next", (PyCFunction)Server_next, METH_VARARGS,
-     "next(timeout_ms) -> (conn_id, frame) | None; raises ConnectionError "
-     "after close()"},
+     "next(timeout_ms) -> (conn_id, frame) | None; an EMPTY frame means "
+     "the connection closed; raises ConnectionError after close()"},
     {"reply", (PyCFunction)Server_reply, METH_VARARGS,
-     "reply(conn_id, frame) -> bool"},
+     "reply(conn_id, frame) -> bool (enqueued; exec thread flushes)"},
+    {"kick", (PyCFunction)Server_kick, METH_VARARGS,
+     "kick(conn_id): close a connection"},
     {"close", (PyCFunction)Server_close, METH_NOARGS, ""},
     {nullptr, nullptr, 0, nullptr}};
 

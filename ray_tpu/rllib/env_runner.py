@@ -19,13 +19,18 @@ from ray_tpu.rllib import module as module_mod
 
 class EnvRunner:
     def __init__(self, env_maker: Union[str, Callable], num_envs: int = 1,
-                 seed: int = 0):
+                 seed: int = 0, env_to_module=None):
+        """env_to_module: optional ConnectorPipeline (rllib/connectors.py)
+        applied to observation batches before the module forward and to
+        reward vectors before they enter returns/batches — the reference's
+        env-to-module connector slot."""
         import gymnasium as gym
 
         if isinstance(env_maker, str):
             self._envs = [gym.make(env_maker) for _ in range(num_envs)]
         else:
             self._envs = [env_maker() for _ in range(num_envs)]
+        self._connectors = env_to_module
         self._obs = []
         for i, env in enumerate(self._envs):
             obs, _ = env.reset(seed=seed + i)
@@ -52,6 +57,8 @@ class EnvRunner:
         truncated_next: list = []  # (t, env_idx, next_obs) at truncations
         for t in range(num_steps):
             obs = np.stack(self._obs).astype(np.float32)
+            if self._connectors is not None:
+                obs = self._connectors.transform_obs(obs)
             key = jax.random.PRNGKey(
                 (self._seed * 1_000_003 + self._steps) & 0x7FFFFFFF)
             action, logp, value = module_mod.action_dist(params, obs, key)
@@ -82,15 +89,27 @@ class EnvRunner:
                     self._ep_return[i], self._ep_len[i] = 0.0, 0
                     nobs, _ = env.reset()
                 self._obs[i] = nobs
+            if self._connectors is not None:
+                rews = self._connectors.transform_rewards(rews)
             rew_buf.append(rews)
             done_buf.append(dones)
             self._steps += 1
         last_obs = np.stack(self._obs).astype(np.float32)
+        if self._connectors is not None:
+            # update=False: these same observations re-enter (with
+            # update=True) as the first step of the NEXT sample() call —
+            # counting them here would double-bias running filters
+            last_obs = self._connectors.transform_obs(last_obs,
+                                                      update=False)
         # V(s') at time-limit truncations (zero elsewhere); the learner
         # folds gamma * trunc_values into rewards before GAE
         trunc_values = np.zeros((num_steps, n), np.float32)
         if truncated_next:
             batch = np.stack([o for _, _, o in truncated_next])
+            if self._connectors is not None:
+                # discarded-by-reset states: project, never accumulate
+                batch = self._connectors.transform_obs(batch,
+                                                       update=False)
             _, v = module_mod.forward(params, batch)
             v = np.asarray(v)
             for k, (t, i, _) in enumerate(truncated_next):
@@ -124,6 +143,8 @@ class EnvRunner:
         obs_b, act_b, rew_b, nobs_b, done_b = [], [], [], [], []
         for _ in range(num_steps):
             obs = np.stack(self._obs).astype(np.float32)
+            if self._connectors is not None:
+                obs = self._connectors.transform_obs(obs)
             q, _ = module_mod.forward(params, obs)
             q = np.asarray(q)
             if policy == "softmax":
@@ -155,11 +176,20 @@ class EnvRunner:
                     nobs, _ = env.reset()
                 self._obs[i] = nobs
             self._steps += 1
+        next_obs = np.stack(nobs_b).astype(np.float32)
+        rewards = np.asarray(rew_b, np.float32)
+        if self._connectors is not None:
+            # re-project next_obs with the SAME filter state (no stats
+            # update: these observations were already counted when they
+            # became current obs on the following step)
+            next_obs = self._connectors.transform_obs(next_obs,
+                                                      update=False)
+            rewards = self._connectors.transform_rewards(rewards)
         return {
             "obs": np.stack(obs_b).astype(np.float32),
             "actions": np.asarray(act_b, np.int32),
-            "rewards": np.asarray(rew_b, np.float32),
-            "next_obs": np.stack(nobs_b).astype(np.float32),
+            "rewards": rewards,
+            "next_obs": next_obs,
             "dones": np.asarray(done_b, np.float32),
         }
 

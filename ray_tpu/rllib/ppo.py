@@ -34,6 +34,10 @@ class PPOConfig:
     env: Union[str, Callable] = "CartPole-v1"
     num_env_runners: int = 2
     num_envs_per_runner: int = 4
+    # env-to-module connector pipeline factory (rllib/connectors.py):
+    # each env-runner actor builds its own pipeline instance (stateful
+    # filters like NormalizeObs are per-runner, as in the reference)
+    env_to_module: "Optional[Callable]" = None
     rollout_fragment_length: int = 128
     gamma: float = 0.99
     lambda_: float = 0.95
@@ -152,8 +156,11 @@ class PPO:
         self.config = config
         runner_cls = ray_tpu.remote(EnvRunner)
         self.runners = [
-            runner_cls.remote(config.env, config.num_envs_per_runner,
-                              seed=config.seed + 1000 * i)
+            runner_cls.remote(
+                config.env, config.num_envs_per_runner,
+                seed=config.seed + 1000 * i,
+                env_to_module=(config.env_to_module()
+                               if config.env_to_module else None))
             for i in range(config.num_env_runners)]
         spec = ray_tpu.get(self.runners[0].env_spec.remote(), timeout=60)
         self.module_cfg = module_mod.MLPConfig(

@@ -1,0 +1,166 @@
+"""Structured event export for external consumers.
+
+Counterpart of the reference's export-event pipeline
+(/root/reference/python/ray/_private/event/export_event_logger.py + the
+export_*.proto schemas): when enabled, cluster lifecycle events stream to
+JSONL files an external system can tail — one record per line, stable
+``type``/``ts``/``data`` envelope.
+
+Enable by pointing ``RTPU_EXPORT_EVENTS`` at a directory (the head node
+starts the exporter).  Three files are written there:
+
+- ``actor_events.jsonl`` — every actor state transition (from GCS pubsub)
+- ``node_events.jsonl``  — node alive/dead transitions
+- ``task_events.jsonl``  — task lifecycle records (exported by each
+  node's scheduler as tasks finish)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+
+class ExportEventLogger:
+    """Exporter for one node.  Every node exports its scheduler's task
+    events (enqueued, written by a dedicated thread — the sink is called
+    under the scheduler's lock and must not do file I/O there); the HEAD
+    additionally subscribes to the GCS actor/node channels so those
+    cluster-wide transitions are written exactly once."""
+
+    def __init__(self, out_dir: str, gcs_address: str,
+                 subscribe: bool = True):
+        import queue as queue_mod
+
+        self.out_dir = out_dir
+        os.makedirs(out_dir, exist_ok=True)
+        self._gcs_address = gcs_address
+        self._stop = threading.Event()
+        self._files: dict[str, object] = {}
+        self._lock = threading.Lock()
+        self._queue: "queue_mod.Queue" = queue_mod.Queue()
+        self._writer = threading.Thread(
+            target=self._writer_loop, name="event-export-writer",
+            daemon=True)
+        self._writer.start()
+        self._sub_thread = None
+        if subscribe:
+            self._sub_thread = threading.Thread(
+                target=self._subscribe_loop, name="event-export-sub",
+                daemon=True)
+            self._sub_thread.start()
+
+    def _write(self, stream: str, record: dict):
+        """Serialize + append one record (writer/subscriber threads only).
+        One write() call per line: concurrent exporters appending to the
+        same file (multi-node, shared fs) stay line-atomic."""
+        line = json.dumps({"type": stream, "ts": time.time(),
+                           "data": record}, default=_jsonable)
+        with self._lock:
+            f = self._files.get(stream)
+            if f is None:
+                f = open(os.path.join(self.out_dir,
+                                      f"{stream}_events.jsonl"), "a")
+                self._files[stream] = f
+            f.write(line + "\n")
+            f.flush()
+
+    def export_task_event(self, record: dict):
+        """Called by the scheduler (under its lock): enqueue only."""
+        self._queue.put(("task", record))
+
+    def _writer_loop(self):
+        import queue as queue_mod
+
+        while not self._stop.is_set():
+            try:
+                stream, record = self._queue.get(timeout=0.5)
+            except queue_mod.Empty:
+                continue
+            try:
+                self._write(stream, record)
+            except Exception:
+                pass  # export is best-effort
+
+    def _subscribe_loop(self):
+        from ray_tpu._private.gcs import GcsClient, GcsSubscriber
+
+        sub = None
+        while not self._stop.is_set():
+            try:
+                if sub is None:
+                    sub = GcsSubscriber(self._gcs_address,
+                                        ["actors", "nodes"])
+                events, gap = sub.poll(timeout_s=5.0)
+            except Exception:
+                sub = None
+                if self._stop.wait(0.5):
+                    return
+                continue
+            # write what we HAVE before any snapshot re-read can fail —
+            # a dropped DEAD transition is exactly what consumers need
+            # most during GCS blips
+            for e in events:
+                ch = e.get("ch")
+                if ch == "actors":
+                    self._write("actor", e)
+                elif ch == "nodes":
+                    self._write("node", e)
+            if gap:
+                # subscriber contract: a gap (including the bootstrap
+                # poll) means re-read table state — transitions published
+                # before we subscribed surface as snapshot records
+                try:
+                    client = GcsClient(self._gcs_address)
+                    for n in client.list_nodes():
+                        self._write("node", {
+                            "ch": "nodes", "node_id": n.node_id,
+                            "alive": n.alive, "snapshot": True})
+                    for a in client.list_actors():
+                        self._write("actor", {
+                            "ch": "actors", "actor_id": a.actor_id,
+                            "state": a.state, "addr": a.addr,
+                            "snapshot": True})
+                except Exception:
+                    pass  # next gap retries the snapshot
+
+    def shutdown(self):
+        self._stop.set()
+        with self._lock:
+            for f in self._files.values():
+                try:
+                    f.close()
+                except OSError:
+                    pass
+            self._files.clear()
+
+
+def _jsonable(obj):
+    if isinstance(obj, bytes):
+        return obj.hex()
+    return str(obj)
+
+
+_exporter: Optional[ExportEventLogger] = None
+
+
+def start_exporter(gcs_address: str,
+                   subscribe: bool = True) -> Optional[ExportEventLogger]:
+    """Start this node's exporter when RTPU_EXPORT_EVENTS names a
+    directory.  subscribe=True (the head) additionally streams GCS
+    actor/node transitions; other nodes export only their own task
+    events."""
+    global _exporter
+    out_dir = os.environ.get("RTPU_EXPORT_EVENTS")
+    if not out_dir:
+        return None
+    _exporter = ExportEventLogger(out_dir, gcs_address,
+                                  subscribe=subscribe)
+    return _exporter
+
+
+def get_exporter() -> Optional[ExportEventLogger]:
+    return _exporter

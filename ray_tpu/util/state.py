@@ -179,3 +179,38 @@ def timeline(filename: Optional[str] = None) -> List[dict]:
         with open(filename, "w") as f:
             json.dump(events, f)
     return events
+
+
+def list_logs(node_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Worker log files on one node (reference: ray.util.state.list_logs
+    served by the node's dashboard agent; here the node's scheduler plays
+    the agent).  node_id is the hex id; None = the local/driver node."""
+    if node_id is None:
+        return _rpc("list_logs")
+    for n in _rpc("list_nodes"):
+        nid = n["node_id"].hex() if isinstance(n["node_id"], bytes) \
+            else n["node_id"]
+        if nid == node_id and n.get("alive", True):
+            return _node_rpc(n["sched_socket"], "list_logs")
+    raise ValueError(f"no alive node {node_id}")
+
+
+def get_log(filename: str, node_id: Optional[str] = None,
+            tail: int = 200) -> List[str]:
+    """Tail one worker log file (reference: ray.util.state.get_log)."""
+    params = {"file": filename, "tail": tail}
+    if node_id is None:
+        out = _rpc("read_log", params)
+    else:
+        out = None
+        for n in _rpc("list_nodes"):
+            nid = n["node_id"].hex() if isinstance(n["node_id"], bytes) \
+                else n["node_id"]
+            if nid == node_id and n.get("alive", True):
+                out = _node_rpc(n["sched_socket"], "read_log", params)
+                break
+        if out is None:
+            raise ValueError(f"no alive node {node_id}")
+    if out.get("error"):
+        raise FileNotFoundError(out["error"])
+    return out["lines"]

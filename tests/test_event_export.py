@@ -1,0 +1,69 @@
+"""Structured event export (reference: export_event_logger.py + the
+export_*.proto schemas): lifecycle records stream to JSONL for external
+consumers when RTPU_EXPORT_EVENTS points at a directory."""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def test_export_pipeline_writes_jsonl(tmp_path):
+    out_dir = tmp_path / "events"
+    script = r"""
+import time
+import ray_tpu
+
+ray_tpu.init(min_workers=1, resources={"CPU": 4.0},
+             object_store_memory=1 << 27)
+
+@ray_tpu.remote
+def work(x):
+    return x * 2
+
+assert ray_tpu.get([work.remote(i) for i in range(3)], timeout=60) \
+    == [0, 2, 4]
+
+@ray_tpu.remote
+class A:
+    def ping(self):
+        return "pong"
+
+a = A.remote()
+assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+ray_tpu.kill(a)
+time.sleep(1.0)  # let the pubsub subscriber drain actor/node events
+ray_tpu.shutdown()
+print("EXPORT-RUN-OK")
+"""
+    env = dict(os.environ, RTPU_EXPORT_EVENTS=str(out_dir),
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=240,
+                          env=env, cwd="/root/repo")
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "EXPORT-RUN-OK" in proc.stdout
+
+    task_file = out_dir / "task_events.jsonl"
+    assert task_file.exists()
+    task_records = [json.loads(line) for line in
+                    task_file.read_text().splitlines()]
+    assert all(r["type"] == "task" and "ts" in r for r in task_records)
+    finished_work = [r for r in task_records
+                     if r["data"]["name"] == "work"
+                     and r["data"]["state"] == "FINISHED"]
+    assert len(finished_work) >= 3
+    assert all(r["data"]["ok"] for r in finished_work)
+
+    actor_file = out_dir / "actor_events.jsonl"
+    assert actor_file.exists()
+    actor_records = [json.loads(line) for line in
+                     actor_file.read_text().splitlines()]
+    states = {r["data"]["state"] for r in actor_records}
+    assert "ALIVE" in states and "DEAD" in states
+
+    node_file = out_dir / "node_events.jsonl"
+    assert node_file.exists()
+    node_records = [json.loads(line) for line in
+                    node_file.read_text().splitlines()]
+    assert any(r["data"]["alive"] for r in node_records)

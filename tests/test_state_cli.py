@@ -133,3 +133,33 @@ def test_cli_timeline(tmp_path, capsys):
     assert "wrote" in capsys.readouterr().out
     assert out_file.exists()
     json.loads(out_file.read_text())
+
+
+def test_log_api_lists_and_tails_worker_logs():
+    """Per-node log browsing (reference: state API get_log/list_logs via
+    the dashboard agent; here each node's scheduler serves its logs)."""
+    @ray_tpu.remote
+    def noisy():
+        print("log-api-marker-line")
+        return 1
+
+    assert ray_tpu.get(noisy.remote(), timeout=60) == 1
+    logs = state.list_logs()
+    assert logs and all("file" in l and "size" in l for l in logs)
+    # find the marker in some worker's .out
+    import time
+    found = False
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not found:
+        for entry in state.list_logs():
+            if entry["file"].endswith(".out"):
+                lines = state.get_log(entry["file"])
+                if any("log-api-marker-line" in ln for ln in lines):
+                    found = True
+                    break
+        time.sleep(0.2)
+    assert found, "marker line not found in any worker log"
+    # traversal guard + missing files
+    import pytest as _pytest
+    with _pytest.raises(FileNotFoundError):
+        state.get_log("../../etc/passwd")
