@@ -206,6 +206,9 @@ class Node:
 
         self._event_exporter = start_exporter(self.gcs_address,
                                               subscribe=head)
+        # per-scheduler wiring: in-process multi-node clusters must not
+        # share (or hijack) one process-global exporter
+        self.scheduler._event_exporter = self._event_exporter
         self.dashboard = None
         self.dashboard_url = None
         if head and include_dashboard and not os.environ.get(

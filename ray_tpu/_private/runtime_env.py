@@ -269,6 +269,16 @@ def apply(runtime_env: Optional[dict], ctx) -> Optional[AppliedEnv]:
         if pip_reqs:
             if isinstance(pip_reqs, dict):  # {"packages": [...]} form
                 pip_reqs = pip_reqs.get("packages") or []
+            elif isinstance(pip_reqs, str):
+                # one requirement, or a requirements.txt path (the
+                # reference accepts both string forms) — NOT a char list
+                if pip_reqs.endswith(".txt") and os.path.exists(pip_reqs):
+                    with open(pip_reqs) as f:
+                        pip_reqs = [ln.strip() for ln in f
+                                    if ln.strip()
+                                    and not ln.startswith("#")]
+                else:
+                    pip_reqs = [pip_reqs]
             path = ensure_pip_env(list(pip_reqs))
             sys.path.insert(0, path)
             applied._sys_path_added.append(path)

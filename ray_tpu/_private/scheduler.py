@@ -388,10 +388,13 @@ class Scheduler:
             ev["end_ts"] = now
         if state in ("FINISHED", "FAILED"):
             # terminal records stream to the export pipeline when enabled
-            # (reference: task events -> GcsTaskManager -> export loggers)
-            from ray_tpu.util.events import get_exporter
+            # (reference: task events -> GcsTaskManager -> export loggers);
+            # THIS node's exporter when wired, process-global fallback
+            exporter = getattr(self, "_event_exporter", None)
+            if exporter is None:
+                from ray_tpu.util.events import get_exporter
 
-            exporter = get_exporter()
+                exporter = get_exporter()
             if exporter is not None:
                 try:
                     exporter.export_task_event(dict(ev))

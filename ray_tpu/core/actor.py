@@ -9,6 +9,7 @@ and can be pickled into tasks; named actors are resolved via the GCS.
 
 from __future__ import annotations
 
+import pickle
 from typing import Optional
 
 import cloudpickle
@@ -68,7 +69,11 @@ class ActorHandle:
         # __ray_call__) — any other underscore name is a real miss.
         if item.startswith("_") and item != "__rtpu_apply__":
             raise AttributeError(item)
-        return ActorMethod(self, item)
+        method = ActorMethod(self, item)
+        # cache on the instance: later `handle.method` accesses skip
+        # __getattr__ entirely (the hot actor-call path pays for this)
+        self.__dict__[item] = method
+        return method
 
     def _submit_method(self, method_name, args, kwargs, num_returns=1,
                        tensor_transport=None):
@@ -76,11 +81,26 @@ class ActorHandle:
         task_id = ids.new_task_id()
         return_ids = [ids.object_id_for_return(task_id, i)
                       for i in range(num_returns)]
+        # stdlib pickle first: its C implementation is ~3x cloudpickle for
+        # plain-data args (the overwhelmingly common case) and runs the
+        # same ObjectRef escape hooks via __reduce__.  Fall back to
+        # cloudpickle when pickle can't (closures/lambdas) or when the
+        # blob references __main__ — stdlib pickles driver-script classes
+        # BY REFERENCE, which a worker process cannot resolve (cloudpickle
+        # ships them by value).  The b"__main__" scan is conservative: a
+        # false positive merely costs the cloudpickle path.
+        payload = (list(args), dict(kwargs))
+        try:
+            args_blob = pickle.dumps(payload, protocol=5)
+            if b"__main__" in args_blob:
+                args_blob = cloudpickle.dumps(payload)
+        except Exception:
+            args_blob = cloudpickle.dumps(payload)
         spec = TaskSpec(
             task_id=task_id,
             kind=ACTOR_METHOD,
             fn_id=b"",
-            args_blob=cloudpickle.dumps((list(args), dict(kwargs))),
+            args_blob=args_blob,
             return_ids=return_ids,
             actor_id=self._actor_id,
             method_name=method_name,

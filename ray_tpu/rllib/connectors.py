@@ -144,9 +144,13 @@ class ConnectorPipeline(Connector):
         return rewards
 
     def get_state(self) -> Dict[str, Any]:
-        return {type(c).__name__: c.get_state() for c in self.connectors}
+        # keyed by (position, class): two connectors of the same type must
+        # not collide or restore would alias their filter state
+        return {f"{i}:{type(c).__name__}": c.get_state()
+                for i, c in enumerate(self.connectors)}
 
     def set_state(self, state: Dict[str, Any]) -> None:
-        for c in self.connectors:
-            if type(c).__name__ in state:
-                c.set_state(state[type(c).__name__])
+        for i, c in enumerate(self.connectors):
+            key = f"{i}:{type(c).__name__}"
+            if key in state:
+                c.set_state(state[key])

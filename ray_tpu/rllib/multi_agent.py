@@ -1,0 +1,292 @@
+"""Multi-agent RL: env protocol, sampling runner, and independent PPO.
+
+Counterpart of the reference's multi-agent stack
+(/root/reference/rllib/env/multi_agent_env.py + MultiAgentRLModule +
+policy_mapping_fn in rllib/algorithms/algorithm_config.py): several agents
+step one environment; a ``policy_mapping_fn`` routes each agent id to a
+policy id; each policy owns its own module/optimizer and learns from the
+experience of every agent mapped to it (parameter sharing falls out of
+mapping many agents to one policy id).
+
+The environment protocol is the parallel dict API (gymnasium/PettingZoo
+shape)::
+
+    obs_dict, infos = env.reset(seed=...)
+    obs, rews, terms, truncs, infos = env.step({agent_id: action, ...})
+    # terms["__all__"] / truncs["__all__"] end the episode for everyone
+
+TPU-shaping, same stance as ppo.py: per-policy updates are the SAME jitted
+``ppo_update`` the single-agent path uses — one fixed-shape program per
+policy — and per-policy batches stack agents along the env axis so GAE and
+minibatching reuse the single-agent code unchanged.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib import module as module_mod
+from ray_tpu.rllib.ppo import compute_gae, ppo_update
+
+
+class MultiAgentEnvRunner:
+    """Samples one multi-agent env with per-policy parameter sets.
+
+    Assumes a fixed agent population per episode (the dict-API common
+    case); agents absent from a step's obs dict are treated as done.
+    """
+
+    def __init__(self, env_maker: Callable, policy_mapping_fn: Callable,
+                 seed: int = 0):
+        self._env = env_maker()
+        self._map = policy_mapping_fn
+        self._seed = seed
+        self._steps = 0
+        self._obs, _ = self._env.reset(seed=seed)
+        self._agents = sorted(self._obs)
+        self._live = set(self._agents)
+        self._ep_return = {a: 0.0 for a in self._agents}
+        self._completed: list[dict] = []
+
+    def env_spec(self) -> Dict[str, dict]:
+        """policy_id -> {obs_dim, n_actions, agents}."""
+        out: Dict[str, dict] = {}
+        for a in self._agents:
+            pid = self._map(a)
+            spec = out.setdefault(pid, {
+                "obs_dim": int(np.asarray(self._obs[a]).size),
+                "n_actions": int(self._env.action_space(a).n),
+                "agents": []})
+            spec["agents"].append(a)
+        return out
+
+    def sample(self, params_by_policy: Dict[str, Any],
+               num_steps: int) -> Dict[str, dict]:
+        """Per-policy fragments shaped like the single-agent runner's:
+        [T, n_agents_of_policy, ...] so GAE/flattening reuse applies."""
+        by_pid = {}
+        for a in self._agents:
+            by_pid.setdefault(self._map(a), []).append(a)
+        bufs = {pid: {"obs": [], "actions": [], "logp": [], "values": [],
+                      "rewards": [], "dones": []} for pid in by_pid}
+        for _ in range(num_steps):
+            key = jax.random.PRNGKey(
+                (self._seed * 1_000_003 + self._steps) & 0x7FFFFFFF)
+            actions: Dict[Any, int] = {}
+            step_cache = {}
+            for pid, agents in by_pid.items():
+                obs = np.stack([np.asarray(self._obs[a], np.float32)
+                                .reshape(-1) for a in agents])
+                act, logp, value = module_mod.action_dist(
+                    params_by_policy[pid], obs, key)
+                act = np.asarray(act)
+                step_cache[pid] = (obs, act, np.asarray(logp),
+                                   np.asarray(value))
+                for i, a in enumerate(agents):
+                    if a in self._live:  # strict dict envs reject
+                        actions[a] = int(act[i])  # actions for the dead
+            nobs, rews, terms, truncs, _ = self._env.step(actions)
+            done_all = bool(terms.get("__all__")) or \
+                bool(truncs.get("__all__"))
+            for pid, agents in by_pid.items():
+                obs, act, logp, value = step_cache[pid]
+                r = np.asarray([float(rews.get(a, 0.0)) for a in agents],
+                               np.float32)
+                d = np.asarray(
+                    [done_all or bool(terms.get(a)) or bool(truncs.get(a))
+                     or a not in nobs  # PettingZoo-style early exit
+                     for a in agents], bool)
+                b = bufs[pid]
+                b["obs"].append(obs)
+                b["actions"].append(act)
+                b["logp"].append(logp)
+                b["values"].append(value)
+                b["rewards"].append(r)
+                b["dones"].append(d)
+            for a in self._agents:
+                self._ep_return[a] += float(rews.get(a, 0.0))
+            if done_all:
+                self._completed.append(dict(self._ep_return))
+                self._obs, _ = self._env.reset()
+                self._live = set(self._agents)
+                self._ep_return = {a: 0.0 for a in self._agents}
+            else:
+                # an agent terminating early (dropped from the obs dict)
+                # keeps its last observation: dones=True already cuts its
+                # GAE trace, so the stale obs only pads the batch — and
+                # the fixed-population iteration never KeyErrors
+                self._live = {a for a in self._agents if a in nobs}
+                for a in self._live:
+                    self._obs[a] = nobs[a]
+            self._steps += 1
+        out = {}
+        for pid, agents in by_pid.items():
+            b = bufs[pid]
+            last_obs = np.stack([np.asarray(self._obs[a], np.float32)
+                                 .reshape(-1) for a in agents])
+            out[pid] = {k: np.stack(v) for k, v in b.items()}
+            out[pid]["last_obs"] = last_obs
+        return out
+
+    def get_metrics(self) -> dict:
+        done = self._completed
+        self._completed = []
+        return {"episode_returns": done}
+
+
+@dataclass
+class MultiAgentPPOConfig:
+    """Reference: AlgorithmConfig.multi_agent(policies=...,
+    policy_mapping_fn=...) on top of PPOConfig.training() args."""
+
+    env: Callable = None  # factory returning a MultiAgentEnv
+    policy_mapping_fn: Callable = lambda agent_id: "default"
+    num_env_runners: int = 1
+    rollout_fragment_length: int = 64
+    gamma: float = 0.99
+    lambda_: float = 0.95
+    clip_param: float = 0.2
+    entropy_coeff: float = 0.01
+    vf_loss_coeff: float = 0.5
+    grad_clip: float = 0.5
+    lr: float = 5e-3
+    num_epochs: int = 4
+    minibatch_size: int = 128
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "MultiAgentPPO":
+        if self.env is None:
+            raise ValueError("MultiAgentPPOConfig.env factory is required")
+        return MultiAgentPPO(self)
+
+
+class MultiAgentPPO:
+    """Independent PPO per policy id (reference: one RLModule per policy
+    in the MultiAgentRLModule; shared-parameter policies arise from the
+    mapping fn)."""
+
+    def __init__(self, config: MultiAgentPPOConfig):
+        import optax
+
+        self.config = config
+        RunnerActor = ray_tpu.remote(MultiAgentEnvRunner)
+        self.runners = [
+            RunnerActor.remote(config.env, config.policy_mapping_fn,
+                               seed=config.seed + 1000 * i)
+            for i in range(config.num_env_runners)]
+        self.spec = ray_tpu.get(self.runners[0].env_spec.remote(),
+                                timeout=60)
+        self.params: Dict[str, Any] = {}
+        self.opt_state: Dict[str, Any] = {}
+        self._tx = optax.chain(
+            optax.clip_by_global_norm(config.grad_clip),
+            optax.adam(config.lr))
+        key = jax.random.PRNGKey(config.seed)
+        for i, (pid, s) in enumerate(sorted(self.spec.items())):
+            mcfg = module_mod.MLPConfig(
+                obs_dim=s["obs_dim"], n_actions=s["n_actions"],
+                hidden=config.hidden)
+            self.params[pid] = module_mod.init_mlp(
+                mcfg, jax.random.fold_in(key, i))
+            self.opt_state[pid] = self._tx.init(self.params[pid])
+        self.iteration = 0
+        self._timesteps = 0
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        host_params = {pid: jax.device_get(p)
+                       for pid, p in self.params.items()}
+        frags = ray_tpu.get(
+            [r.sample.remote(host_params, cfg.rollout_fragment_length)
+             for r in self.runners], timeout=600)
+        stats_by_policy = {}
+        for pid in self.params:
+            obs, acts, logp, adv, rets = [], [], [], [], []
+            for f in frags:
+                fp = f[pid]
+                last_value = np.asarray(module_mod.forward(
+                    self.params[pid], fp["last_obs"])[1])
+                a, r = compute_gae(fp["rewards"], fp["values"],
+                                   fp["dones"], last_value, cfg.gamma,
+                                   cfg.lambda_)
+                T, n = fp["rewards"].shape
+                obs.append(fp["obs"].reshape(T * n, -1))
+                acts.append(fp["actions"].reshape(-1))
+                logp.append(fp["logp"].reshape(-1))
+                adv.append(a.reshape(-1))
+                rets.append(r.reshape(-1))
+            adv_all = np.concatenate(adv)
+            adv_all = (adv_all - adv_all.mean()) / (adv_all.std() + 1e-8)
+            batch = {
+                "obs": jnp.asarray(np.concatenate(obs)),
+                "actions": jnp.asarray(np.concatenate(acts), jnp.int32),
+                "logp_old": jnp.asarray(np.concatenate(logp)),
+                "adv": jnp.asarray(adv_all),
+                "returns": jnp.asarray(np.concatenate(rets)),
+            }
+            self._timesteps += int(batch["obs"].shape[0])
+            self.params[pid], self.opt_state[pid], stats = ppo_update(
+                self.params[pid], self.opt_state[pid], batch,
+                jax.random.fold_in(jax.random.PRNGKey(self.iteration),
+                                   hash(pid) & 0x7FFFFFFF),
+                num_epochs=cfg.num_epochs,
+                minibatch_size=min(cfg.minibatch_size,
+                                   int(batch["obs"].shape[0])),
+                clip=cfg.clip_param, ent_coeff=cfg.entropy_coeff,
+                vf_coeff=cfg.vf_loss_coeff, grad_clip=cfg.grad_clip,
+                lr=cfg.lr)
+            stats_by_policy[pid] = {k: float(v) for k, v in stats.items()}
+        self.iteration += 1
+        metrics = ray_tpu.get(
+            [r.get_metrics.remote() for r in self.runners], timeout=60)
+        episodes = [ep for m in metrics for ep in m["episode_returns"]]
+        mean_return = (float(np.mean([sum(ep.values())
+                                      for ep in episodes]))
+                       if episodes else float("nan"))
+        per_agent = {}
+        if episodes:
+            for a in episodes[0]:
+                per_agent[str(a)] = float(
+                    np.mean([ep[a] for ep in episodes]))
+        return {
+            "training_iteration": self.iteration,
+            "timesteps_total": self._timesteps,
+            "episode_return_mean": mean_return,
+            "per_agent_return_mean": per_agent,
+            "num_episodes": len(episodes),
+            "policies": stats_by_policy,
+            "time_this_iter_s": time.perf_counter() - t0,
+        }
+
+    # -- checkpointing ------------------------------------------------------
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            pickle.dump({"params": self.params,
+                         "opt_state": self.opt_state,
+                         "iteration": self.iteration,
+                         "timesteps": self._timesteps}, f)
+
+    def restore(self, path: str) -> None:
+        with open(path, "rb") as f:
+            st = pickle.load(f)
+        self.params = st["params"]
+        self.opt_state = st["opt_state"]
+        self.iteration = st["iteration"]
+        self._timesteps = st["timesteps"]
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

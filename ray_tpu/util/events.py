@@ -55,18 +55,20 @@ class ExportEventLogger:
 
     def _write(self, stream: str, record: dict):
         """Serialize + append one record (writer/subscriber threads only).
-        One write() call per line: concurrent exporters appending to the
-        same file (multi-node, shared fs) stay line-atomic."""
+        Unbuffered O_APPEND binary writes: one write(2) per line of ANY
+        size, so concurrent exporters appending to the same file
+        (multi-node, shared fs) stay line-atomic — a buffered text file
+        would split records beyond its buffer into interleavable chunks."""
         line = json.dumps({"type": stream, "ts": time.time(),
                            "data": record}, default=_jsonable)
         with self._lock:
             f = self._files.get(stream)
             if f is None:
                 f = open(os.path.join(self.out_dir,
-                                      f"{stream}_events.jsonl"), "a")
+                                      f"{stream}_events.jsonl"), "ab",
+                         buffering=0)
                 self._files[stream] = f
-            f.write(line + "\n")
-            f.flush()
+            f.write((line + "\n").encode())
 
     def export_task_event(self, record: dict):
         """Called by the scheduler (under its lock): enqueue only."""
@@ -75,10 +77,12 @@ class ExportEventLogger:
     def _writer_loop(self):
         import queue as queue_mod
 
-        while not self._stop.is_set():
+        while True:
             try:
                 stream, record = self._queue.get(timeout=0.5)
             except queue_mod.Empty:
+                if self._stop.is_set():
+                    return  # queue fully drained
                 continue
             try:
                 self._write(stream, record)
@@ -128,7 +132,10 @@ class ExportEventLogger:
                     pass  # next gap retries the snapshot
 
     def shutdown(self):
+        """Stop, DRAINING queued task events first — short-lived drivers
+        must not lose their final FINISHED records."""
         self._stop.set()
+        self._writer.join(timeout=5)
         with self._lock:
             for f in self._files.values():
                 try:
@@ -136,6 +143,9 @@ class ExportEventLogger:
                 except OSError:
                     pass
             self._files.clear()
+        global _exporter
+        if _exporter is self:
+            _exporter = None
 
 
 def _jsonable(obj):
@@ -157,9 +167,14 @@ def start_exporter(gcs_address: str,
     out_dir = os.environ.get("RTPU_EXPORT_EVENTS")
     if not out_dir:
         return None
-    _exporter = ExportEventLogger(out_dir, gcs_address,
-                                  subscribe=subscribe)
-    return _exporter
+    logger = ExportEventLogger(out_dir, gcs_address, subscribe=subscribe)
+    # The process-global fallback serves schedulers that predate per-node
+    # wiring; the FIRST exporter (the head's, in in-process multi-node
+    # clusters) keeps it — a later worker Node must not hijack the head's
+    # task events, nor leave a dead exporter behind on its shutdown.
+    if _exporter is None:
+        _exporter = logger
+    return logger
 
 
 def get_exporter() -> Optional[ExportEventLogger]:

@@ -120,16 +120,20 @@ def test_oom_error_carries_provenance(ray_cluster):
     def hog():
         import time as _t
 
-        _t.sleep(1.0)
+        _t.sleep(5.0)  # wide window: the kill must land mid-execution
         return 1
 
     ref = hog.options(max_retries=0).remote()
     deadline = time.monotonic() + 30
-    while time.monotonic() < deadline:
+    killed = False
+    while time.monotonic() < deadline and not killed:
         with sched._lock:
-            if any(w.in_flight for w in sched._workers.values()):
-                break
-        time.sleep(0.02)
-    assert sched._handle_memory_pressure(97 << 20, 100 << 20, 0.95)
+            busy = any(w.in_flight for w in sched._workers.values())
+        if busy:
+            killed = sched._handle_memory_pressure(97 << 20, 100 << 20,
+                                                   0.95)
+        if not killed:
+            time.sleep(0.05)
+    assert killed, "pressure injection never found an in-flight victim"
     with pytest.raises(OutOfMemoryError, match="memory monitor"):
         ray_tpu.get(ref, timeout=60)

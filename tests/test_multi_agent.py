@@ -1,0 +1,116 @@
+"""Multi-agent RL (reference: rllib/env/multi_agent_env.py + multi-agent
+RLModule + policy_mapping_fn): dict-API env protocol, per-policy sampling,
+and independent PPO learning with separate AND shared policies."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib.examples import TargetMatchEnv
+from ray_tpu.rllib.multi_agent import (
+    MultiAgentEnvRunner,
+    MultiAgentPPOConfig,
+)
+
+pytest.importorskip("gymnasium")
+
+
+def test_runner_groups_agents_by_policy():
+    runner = MultiAgentEnvRunner(
+        TargetMatchEnv, policy_mapping_fn=lambda a: f"p_{a}", seed=0)
+    spec = runner.env_spec()
+    assert set(spec) == {"p_a0", "p_a1"}
+    assert spec["p_a0"]["n_actions"] == TargetMatchEnv.N_ACTIONS
+
+    import jax
+
+    from ray_tpu.rllib import module as module_mod
+
+    params = {pid: module_mod.init_mlp(
+        module_mod.MLPConfig(obs_dim=s["obs_dim"],
+                             n_actions=s["n_actions"]),
+        jax.random.PRNGKey(i))
+        for i, (pid, s) in enumerate(spec.items())}
+    frags = runner.sample(params, 32)
+    for pid in spec:
+        f = frags[pid]
+        assert f["obs"].shape == (32, 1, TargetMatchEnv.N_ACTIONS)
+        assert f["rewards"].shape == (32, 1)
+        # __all__ episode ends mark every agent done
+        assert f["dones"].sum() == 32 // TargetMatchEnv.EP_LEN
+
+
+def test_independent_policies_learn(ray_cluster):
+    cfg = MultiAgentPPOConfig(
+        env=TargetMatchEnv,
+        policy_mapping_fn=lambda a: f"p_{a}",  # one policy PER agent
+        num_env_runners=1, rollout_fragment_length=128, seed=0,
+        lr=5e-3, num_epochs=6)
+    algo = cfg.build()
+    try:
+        best = 0.0
+        for _ in range(15):
+            result = algo.train()
+            if np.isfinite(result["episode_return_mean"]):
+                best = max(best, result["episode_return_mean"])
+            if best >= 24.0:
+                break
+        # random play: 2 agents * 16 steps / 4 actions = 8 total; near-
+        # optimal is 32 — 24 demonstrates both policies learned
+        assert best >= 24.0, f"multi-agent PPO failed: best {best}"
+        assert set(result["policies"]) == {"p_a0", "p_a1"}
+        # both agents contribute (neither policy is freeloading)
+        per_agent = result["per_agent_return_mean"]
+        assert min(per_agent.values()) >= 9.0, per_agent
+    finally:
+        algo.stop()
+
+
+def test_shared_policy_parameter_sharing(ray_cluster):
+    """Mapping every agent to ONE policy id = parameter sharing; the
+    shared policy learns from both agents' experience."""
+    cfg = MultiAgentPPOConfig(
+        env=TargetMatchEnv,
+        policy_mapping_fn=lambda a: "shared",
+        num_env_runners=1, rollout_fragment_length=128, seed=1,
+        lr=5e-3, num_epochs=6)
+    algo = cfg.build()
+    try:
+        assert list(algo.params) == ["shared"]
+        best = 0.0
+        for _ in range(15):
+            result = algo.train()
+            if np.isfinite(result["episode_return_mean"]):
+                best = max(best, result["episode_return_mean"])
+            if best >= 24.0:
+                break
+        assert best >= 24.0, f"shared-policy PPO failed: best {best}"
+    finally:
+        algo.stop()
+
+
+def test_checkpoint_roundtrip(ray_cluster, tmp_path):
+    cfg = MultiAgentPPOConfig(
+        env=TargetMatchEnv, policy_mapping_fn=lambda a: f"p_{a}",
+        num_env_runners=1, rollout_fragment_length=32, seed=2)
+    algo = cfg.build()
+    try:
+        algo.train()
+        path = str(tmp_path / "ck")
+        algo.save(path)
+        algo2 = MultiAgentPPOConfig(
+            env=TargetMatchEnv, policy_mapping_fn=lambda a: f"p_{a}",
+            num_env_runners=1, seed=3).build()
+        try:
+            algo2.restore(path)
+            assert algo2.iteration == algo.iteration
+            import jax
+
+            for pid in algo.params:
+                a = jax.tree.leaves(algo.params[pid])[0]
+                b = jax.tree.leaves(algo2.params[pid])[0]
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+        finally:
+            algo2.stop()
+    finally:
+        algo.stop()
