@@ -23,6 +23,7 @@ Custom policies subclass ``BackpressurePolicy`` and are installed on the
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Optional
 
 
 @dataclass
@@ -34,13 +35,28 @@ class OpSnapshot:
     window: int               # the op's configured concurrency cap
     bytes_per_task: float     # rolling estimate of output bytes per task
     outstanding_bytes: float  # estimated unconsumed output in the store
+    # unique per OPERATOR EXECUTION: two concurrent ops can share a
+    # display name ("Map(<lambda>)"), and identity-keyed accounting must
+    # not alias them
+    op_token: str = ""
 
 
 class BackpressurePolicy:
-    """Decide whether an operator may launch one more task."""
+    """Decide whether an operator may launch one more task.
+
+    ``on_launch``/``on_complete`` let stateful policies account across
+    operators (a policy instance installed on the DataContext is SHARED
+    by every op in the process — that sharing is what makes a global
+    resource manager possible)."""
 
     def can_launch(self, snap: OpSnapshot) -> bool:
         raise NotImplementedError
+
+    def on_launch(self, snap: OpSnapshot) -> None:
+        pass
+
+    def on_complete(self, op_name: str, out_bytes: int) -> None:
+        pass
 
 
 class ConcurrencyCapPolicy(BackpressurePolicy):
@@ -67,6 +83,47 @@ class OutputBytesPolicy(BackpressurePolicy):
             # instead of flooding the window before the first estimate
             return snap.in_flight < 2
         return snap.outstanding_bytes < self.max_outstanding_bytes
+
+
+class ResourceManagerPolicy(BackpressurePolicy):
+    """Execution-wide task budget across ALL operators (reference:
+    _internal/execution/resource_manager.py — the streaming executor's
+    per-op resource bookkeeping feeding global limits).  A pipeline of N
+    ops each honoring its own window can still oversubscribe the cluster
+    N-fold; this policy caps their SUM."""
+
+    def __init__(self, max_total_tasks: Optional[int] = None):
+        import os as _os
+        import threading as _threading
+
+        self.max_total_tasks = max_total_tasks or max(
+            8, 2 * (_os.cpu_count() or 4))
+        self._lock = _threading.Lock()
+        self._in_flight: dict = {}
+
+    def total_in_flight(self) -> int:
+        with self._lock:
+            return sum(self._in_flight.values())
+
+    def can_launch(self, snap: OpSnapshot) -> bool:
+        with self._lock:
+            other = sum(v for k, v in self._in_flight.items()
+                        if k != snap.op_token)
+        # this op's own count comes from the snapshot (authoritative)
+        return other + snap.in_flight < self.max_total_tasks
+
+    def on_launch(self, snap: OpSnapshot) -> None:
+        with self._lock:
+            self._in_flight[snap.op_token] = \
+                self._in_flight.get(snap.op_token, 0) + 1
+
+    def on_complete(self, op_token: str, out_bytes: int) -> None:
+        with self._lock:
+            n = self._in_flight.get(op_token, 0) - 1
+            if n > 0:
+                self._in_flight[op_token] = n
+            else:
+                self._in_flight.pop(op_token, None)
 
 
 def default_policies() -> list:

@@ -19,6 +19,7 @@ actor's transform.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import deque
@@ -35,6 +36,9 @@ from ray_tpu.data.context import DataContext
 from ray_tpu.data import logical as L
 
 RefBundle = Tuple[ObjectRef, BlockMetadata]
+
+# unique-per-execution operator tokens (see _window_run)
+_op_token_counter = itertools.count()
 
 
 @dataclass
@@ -128,47 +132,69 @@ def _window_run(submit: Callable[[], Optional[ObjectRef]],
 
     if policies is None:
         policies = default_policies()
+    # identity token: concurrent ops may share a display name, and
+    # identity-keyed policies (ResourceManagerPolicy) must not alias them
+    op_token = f"{op_name}#{next(_op_token_counter)}"
     pending: deque = deque()
     exhausted = False
     bytes_per_task = 0.0  # rolling estimate from completed tasks
     completed = 0
-    while True:
-        while not exhausted and len(pending) < window:
-            snap = OpSnapshot(
-                op_name=op_name, in_flight=len(pending), window=window,
-                bytes_per_task=bytes_per_task,
-                outstanding_bytes=bytes_per_task * len(pending))
-            if not all(p.can_launch(snap) for p in policies):
-                break
-            ref = submit()
-            if ref is None:
-                exhausted = True
-                break
-            pending.append(ref)
-            stats.tasks += 1
-        if not pending:
-            if exhausted:
-                return
-            # a policy denied the launch with NOTHING in flight: input
-            # remains, so returning would silently truncate the dataset —
-            # wait for whatever external condition the policy watches
-            time.sleep(0.02)
-            continue
-        # Yield in submission (FIFO) order so dataset order is deterministic
-        # (reference: streaming executor preserves block order).  Later tasks
-        # in the window keep running while we wait on the head.
-        head = pending.popleft()
-        result = ray_tpu.get(head)
-        out_bytes = 0
-        for _, meta in result:
-            stats.rows += meta.num_rows
-            out_bytes += meta.size_bytes or 0
-        completed += 1
-        # exponential moving average keeps the estimate fresh across
-        # size regimes without storing per-task history
-        alpha = 1.0 if completed == 1 else 0.25
-        bytes_per_task += alpha * (out_bytes - bytes_per_task)
-        yield result
+    try:
+        while True:
+            while not exhausted and len(pending) < window:
+                snap = OpSnapshot(
+                    op_name=op_name, in_flight=len(pending), window=window,
+                    bytes_per_task=bytes_per_task,
+                    outstanding_bytes=bytes_per_task * len(pending),
+                    op_token=op_token)
+                if not all(p.can_launch(snap) for p in policies):
+                    break
+                ref = submit()
+                if ref is None:
+                    exhausted = True
+                    break
+                pending.append(ref)
+                stats.tasks += 1
+                for p in policies:
+                    p.on_launch(snap)
+            if not pending:
+                if exhausted:
+                    return
+                # a policy denied the launch with NOTHING in flight: input
+                # remains, so returning would silently truncate the dataset
+                # — wait for whatever external condition the policy watches
+                time.sleep(0.02)
+                continue
+            # Yield in submission (FIFO) order so dataset order is
+            # deterministic (reference: streaming executor preserves block
+            # order).  Later tasks in the window keep running while we
+            # wait on the head.
+            head = pending.popleft()
+            result = ray_tpu.get(head)
+            out_bytes = 0
+            for _, meta in result:
+                stats.rows += meta.num_rows
+                out_bytes += meta.size_bytes or 0
+            completed += 1
+            # exponential moving average keeps the estimate fresh across
+            # size regimes without storing per-task history
+            alpha = 1.0 if completed == 1 else 0.25
+            bytes_per_task += alpha * (out_bytes - bytes_per_task)
+            for p in policies:
+                p.on_complete(op_token, out_bytes)
+            yield result
+    finally:
+        # Abandoned stream (take()/limit()/exception mid-iteration):
+        # release the accounting for tasks still in the window, or a
+        # process-shared policy leaks budget forever and eventually
+        # wedges every later execution.
+        for _ in pending:
+            for p in policies:
+                try:
+                    p.on_complete(op_token, 0)
+                except Exception:
+                    pass
+
 
 
 class TaskMapOp(PhysicalOp):

@@ -1,0 +1,207 @@
+"""CQL: conservative Q-learning on offline data (discrete form).
+
+Counterpart of /root/reference/rllib/algorithms/cql/ (CQLConfig + the
+torch learner's conservative penalty on top of the SAC/Q backbone).  The
+discrete form regularizes a double-Q TD loss with the CQL(H) penalty
+``E[logsumexp_a Q(s,a) - Q(s, a_data)]``: out-of-distribution actions get
+pushed DOWN relative to dataset actions, which is what makes pure-offline
+Q-learning stable without environment interaction.
+
+Offline input reuses MARWIL's episode format (rllib/marwil.py:
+collect_episodes / episodes_from_jsonl / episodes_from_dataset).
+TPU-shaping, same stance as dqn.py: the whole update is ONE jitted
+function over fixed [batch] shapes.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, List, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib import module as module_mod
+
+
+@dataclass
+class CQLConfig:
+    """Reference: rllib/algorithms/cql/cql.py CQLConfig (bc_iters /
+    min_q_weight -> cql_alpha here)."""
+
+    env: Union[str, Callable] = "CartPole-v1"
+    episodes: List[dict] = None  # offline input (required)
+    gamma: float = 0.99
+    lr: float = 5e-4
+    grad_clip: float = 10.0
+    cql_alpha: float = 1.0     # conservative penalty weight
+    target_update_freq: int = 200  # updates between target syncs
+    train_batch_size: int = 256
+    num_updates_per_iter: int = 64
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "CQL":
+        if not self.episodes:
+            raise ValueError("CQL is offline: config.episodes required")
+        return CQL(self)
+
+
+@partial(jax.jit, static_argnames=("gamma", "lr", "grad_clip",
+                                   "cql_alpha"))
+def _cql_update(params, target_params, opt_state, batch, *, gamma: float,
+                lr: float, grad_clip: float, cql_alpha: float):
+    import optax
+
+    tx = optax.chain(optax.clip_by_global_norm(grad_clip), optax.adam(lr))
+    a_idx = batch["actions"][:, None].astype(jnp.int32)
+
+    def loss_fn(p):
+        q, _ = module_mod.forward(p, batch["obs"])            # [B, A]
+        q_data = jnp.take_along_axis(q, a_idx, axis=1)[:, 0]
+        # double-Q target from the target net, greedy by the online net
+        q_next_online, _ = module_mod.forward(p, batch["next_obs"])
+        q_next_target, _ = module_mod.forward(target_params,
+                                              batch["next_obs"])
+        next_a = jnp.argmax(q_next_online, axis=-1)
+        q_next = jnp.take_along_axis(
+            q_next_target, next_a[:, None], axis=1)[:, 0]
+        target = (batch["rewards"]
+                  + gamma * (1.0 - batch["dones"])
+                  * jax.lax.stop_gradient(q_next))
+        td = jnp.mean((q_data - target) ** 2)
+        # CQL(H): push down the soft-maximum over ALL actions, push up
+        # the dataset action — the conservative gap
+        cql_gap = jnp.mean(jax.scipy.special.logsumexp(q, axis=-1)
+                           - q_data)
+        return td + cql_alpha * cql_gap, (td, cql_gap)
+
+    (loss, (td, gap)), grads = jax.value_and_grad(
+        loss_fn, has_aux=True)(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss, td, gap
+
+
+class CQL:
+    """Tune-compatible trainable over a fixed offline dataset."""
+
+    def __init__(self, config: CQLConfig):
+        import optax
+
+        self.config = config
+        obs, actions, rewards, next_obs, dones = [], [], [], [], []
+        for ep in config.episodes:
+            T = len(ep["rewards"])
+            obs.append(ep["obs"][:T])
+            actions.append(ep["actions"][:T])
+            rewards.append(ep["rewards"])
+            nxt = np.concatenate([ep["obs"][1:T],
+                                  ep["obs"][T - 1:T]], axis=0)
+            next_obs.append(nxt)
+            d = np.zeros(T, np.float32)
+            d[-1] = 1.0  # episode boundary terminates the bootstrap
+            dones.append(d)
+        self._obs = np.concatenate(obs).astype(np.float32)
+        self._actions = np.concatenate(actions).astype(np.int32)
+        self._rewards = np.concatenate(rewards).astype(np.float32)
+        self._next_obs = np.concatenate(next_obs).astype(np.float32)
+        self._dones = np.concatenate(dones)
+        n_actions = int(self._actions.max()) + 1
+        try:
+            import gymnasium as gym
+
+            env = (gym.make(config.env) if isinstance(config.env, str)
+                   else config.env())
+            n_actions = int(env.action_space.n)
+            env.close()
+        except Exception:
+            pass
+        mcfg = module_mod.MLPConfig(obs_dim=self._obs.shape[1],
+                                    n_actions=n_actions,
+                                    hidden=config.hidden)
+        self.params = module_mod.init_mlp(
+            mcfg, jax.random.PRNGKey(config.seed))
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        tx = optax.chain(optax.clip_by_global_norm(config.grad_clip),
+                         optax.adam(config.lr))
+        self.opt_state = tx.init(self.params)
+        self._rng = np.random.default_rng(config.seed)
+        self._updates = 0
+        self._iter = 0
+
+    def train(self) -> Dict[str, Any]:
+        c = self.config
+        t0 = time.perf_counter()
+        losses, tds, gaps = [], [], []
+        n = len(self._obs)
+        for _ in range(c.num_updates_per_iter):
+            idx = self._rng.integers(0, n, size=min(c.train_batch_size, n))
+            batch = {"obs": jnp.asarray(self._obs[idx]),
+                     "actions": jnp.asarray(self._actions[idx]),
+                     "rewards": jnp.asarray(self._rewards[idx]),
+                     "next_obs": jnp.asarray(self._next_obs[idx]),
+                     "dones": jnp.asarray(self._dones[idx])}
+            (self.params, self.opt_state, loss, td, gap) = _cql_update(
+                self.params, self.target_params, self.opt_state, batch,
+                gamma=c.gamma, lr=c.lr, grad_clip=c.grad_clip,
+                cql_alpha=c.cql_alpha)
+            losses.append(float(loss))
+            tds.append(float(td))
+            gaps.append(float(gap))
+            self._updates += 1
+            if self._updates % c.target_update_freq == 0:
+                self.target_params = jax.tree.map(jnp.copy, self.params)
+        self._iter += 1
+        return {
+            "training_iteration": self._iter,
+            "loss": float(np.mean(losses)),
+            "td_loss": float(np.mean(tds)),
+            "cql_gap": float(np.mean(gaps)),
+            "num_transitions": n,
+            "time_this_iter_s": time.perf_counter() - t0,
+        }
+
+    def evaluate(self, n_episodes: int = 5, seed: int = 123) -> float:
+        """Greedy rollouts in the real env; mean episode return."""
+        import gymnasium as gym
+
+        c = self.config
+        env = gym.make(c.env) if isinstance(c.env, str) else c.env()
+        total = []
+        for ep in range(n_episodes):
+            obs, _ = env.reset(seed=seed + ep)
+            ret, done = 0.0, False
+            while not done:
+                a = int(np.asarray(module_mod.greedy_action(
+                    self.params, np.asarray(obs, np.float32)[None]))[0])
+                obs, r, term, trunc, _ = env.step(a)
+                ret += float(r)
+                done = term or trunc
+            total.append(ret)
+        env.close()
+        return float(np.mean(total))
+
+    # -- checkpointing ------------------------------------------------------
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            pickle.dump({"params": self.params,
+                         "target_params": self.target_params,
+                         "opt_state": self.opt_state,
+                         "updates": self._updates, "iter": self._iter}, f)
+
+    def restore(self, path: str) -> None:
+        with open(path, "rb") as f:
+            st = pickle.load(f)
+        self.params = st["params"]
+        self.target_params = st["target_params"]
+        self.opt_state = st["opt_state"]
+        self._updates = st["updates"]
+        self._iter = st["iter"]
+
+    def stop(self) -> None:
+        pass

@@ -146,6 +146,37 @@ def ppo_update(params, opt_state, batch, key, *, num_epochs: int,
     return params, opt_state, stats
 
 
+def frags_to_batch(frags, behavior_params, cfg) -> dict:
+    """Runner fragments -> one flat PPO batch: bootstrap time-limit
+    truncations with V(s') (runner reports trunc_values; dones still cuts
+    the GAE trace there), GAE per fragment, flatten, normalize
+    advantages.  Shared by PPO (fresh params) and APPO (one-iteration-
+    stale behavior params)."""
+    obs, acts, logp, adv, rets = [], [], [], [], []
+    for f in frags:
+        last_value = np.asarray(module_mod.forward(
+            behavior_params, f["last_obs"])[1])
+        rewards = f["rewards"] + cfg.gamma * f.get(
+            "trunc_values", np.zeros_like(f["rewards"]))
+        a, r = compute_gae(rewards, f["values"], f["dones"],
+                           last_value, cfg.gamma, cfg.lambda_)
+        T, n = f["rewards"].shape
+        obs.append(f["obs"].reshape(T * n, -1))
+        acts.append(f["actions"].reshape(-1))
+        logp.append(f["logp"].reshape(-1))
+        adv.append(a.reshape(-1))
+        rets.append(r.reshape(-1))
+    adv_all = np.concatenate(adv)
+    adv_all = (adv_all - adv_all.mean()) / (adv_all.std() + 1e-8)
+    return {
+        "obs": jnp.asarray(np.concatenate(obs)),
+        "actions": jnp.asarray(np.concatenate(acts), jnp.int32),
+        "logp_old": jnp.asarray(np.concatenate(logp)),
+        "adv": jnp.asarray(adv_all),
+        "returns": jnp.asarray(np.concatenate(rets)),
+    }
+
+
 class PPO:
     """Reference: Algorithm (rllib/algorithms/algorithm.py) minimum —
     train/save/restore/stop + evaluate."""
@@ -181,32 +212,7 @@ class PPO:
         frags = ray_tpu.get(
             [r.sample.remote(params_ref, cfg.rollout_fragment_length)
              for r in self.runners], timeout=600)
-        # GAE per runner fragment, then flatten everything
-        obs, acts, logp, adv, rets = [], [], [], [], []
-        for f in frags:
-            last_value = np.asarray(module_mod.forward(
-                self.params, f["last_obs"])[1])
-            # bootstrap time-limit truncations with V(s') (runner reports
-            # it in trunc_values; dones still cuts the GAE trace there)
-            rewards = f["rewards"] + cfg.gamma * f.get(
-                "trunc_values", np.zeros_like(f["rewards"]))
-            a, r = compute_gae(rewards, f["values"], f["dones"],
-                               last_value, cfg.gamma, cfg.lambda_)
-            T, n = f["rewards"].shape
-            obs.append(f["obs"].reshape(T * n, -1))
-            acts.append(f["actions"].reshape(-1))
-            logp.append(f["logp"].reshape(-1))
-            adv.append(a.reshape(-1))
-            rets.append(r.reshape(-1))
-        adv_all = np.concatenate(adv)
-        adv_all = (adv_all - adv_all.mean()) / (adv_all.std() + 1e-8)
-        batch = {
-            "obs": jnp.asarray(np.concatenate(obs)),
-            "actions": jnp.asarray(np.concatenate(acts), jnp.int32),
-            "logp_old": jnp.asarray(np.concatenate(logp)),
-            "adv": jnp.asarray(adv_all),
-            "returns": jnp.asarray(np.concatenate(rets)),
-        }
+        batch = frags_to_batch(frags, self.params, cfg)
         self._timesteps += batch["obs"].shape[0]
         self.params, self.opt_state, stats = ppo_update(
             self.params, self.opt_state, batch,

@@ -289,3 +289,46 @@ def test_backpressure_policies_gate_launches(ray_cluster):
         assert probe.max_seen <= 2  # 1-byte budget -> (almost) serial
     finally:
         ctx.backpressure_policies = old
+
+
+def test_resource_manager_caps_total_across_ops(ray_cluster):
+    """ResourceManagerPolicy (reference: execution/resource_manager.py):
+    one shared policy bounds the SUM of in-flight tasks across every
+    operator in a pipeline."""
+    import ray_tpu.data as rdata
+    from ray_tpu.data.backpressure import (
+        BackpressurePolicy,
+        ResourceManagerPolicy,
+    )
+    from ray_tpu.data.context import DataContext
+
+    rm = ResourceManagerPolicy(max_total_tasks=3)
+
+    class TotalProbe(BackpressurePolicy):
+        def __init__(self, rm):
+            self.rm = rm
+            self.max_total = 0
+
+        def can_launch(self, snap):
+            return True
+
+        def on_launch(self, snap):
+            # runs AFTER rm.on_launch (list order): rm's count already
+            # includes this launch
+            self.max_total = max(self.max_total,
+                                 self.rm.total_in_flight())
+
+    probe = TotalProbe(rm)
+    ctx = DataContext.get_current()
+    old = ctx.backpressure_policies
+    ctx.backpressure_policies = [rm, probe]
+    try:
+        ds = rdata.range(48, override_num_blocks=8) \
+            .map(lambda r: {"id": r["id"] * 2}) \
+            .map(lambda r: {"id": r["id"] + 1})
+        total = sum(r["id"] for r in ds.iter_rows())
+        assert total == sum(i * 2 + 1 for i in range(48))
+        assert probe.max_total <= 3, probe.max_total
+        assert rm.total_in_flight() == 0  # fully released
+    finally:
+        ctx.backpressure_policies = old
