@@ -249,6 +249,17 @@ class Gcs:
                             "gap": False}
                 self._events_cond.wait(remaining)
 
+    def broadcast_command(self, payload: dict):
+        """Cluster-wide command broadcast (reference: the ray_syncer
+        COMMANDS channel, src/ray/common/ray_syncer/ray_syncer.h:83 —
+        resource views ride heartbeats here; commands ride pubsub).
+        Schedulers subscribe to the "commands" channel and act on
+        payloads like {"type": "drain", "node_id": ...}."""
+        with self._lock:
+            # "ch" last: a payload must not re-tag the channel (the C++
+            # daemon strips a payload "ch" the same way)
+            self._publish("commands", {**payload, "ch": "commands"})
+
     # -- actors ------------------------------------------------------------
     def _actor_event(self, info: ActorInfo) -> dict:
         return {"ch": "actors", "actor_id": info.actor_id,
@@ -457,7 +468,7 @@ _GCS_METHODS = frozenset({
     "object_lost", "clear_object_lost",
     "register_pg", "get_pg", "remove_pg", "list_pgs",
     "kv_put", "kv_get", "kv_del", "kv_keys",
-    "check_node_health", "sub_poll",
+    "check_node_health", "sub_poll", "broadcast_command",
 })
 
 

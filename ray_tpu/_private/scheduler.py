@@ -204,6 +204,10 @@ class Scheduler:
         # worker-death handler so exhausted retries surface
         # OutOfMemoryError instead of a generic crash.
         self._oom_kills: dict[bytes, dict] = {}
+        # Draining (syncer COMMANDS channel: {"type": "drain"}): the node
+        # advertises zero availability and spills its forwardable pending
+        # work — graceful scale-down runs this before termination.
+        self._draining = False
         self._memory_monitor = None
         threshold = float(
             os.environ.get("RTPU_MEMORY_MONITOR_THRESHOLD", 0.95))
@@ -278,6 +282,9 @@ class Scheduler:
         self._accept_thread.start()
         self._sched_thread.start()
         self._heartbeat_thread.start()
+        if gcs_address:
+            threading.Thread(target=self._commands_loop,
+                             name="sched-commands", daemon=True).start()
         with self._lock:
             for _ in range(min_workers):
                 self._pool.spawn_worker()
@@ -1099,6 +1106,36 @@ class Scheduler:
                 threading.Thread(target=self._object_events_loop,
                                  name="sched-objwatch", daemon=True).start()
 
+    def _commands_loop(self):
+        """Subscribe to the syncer COMMANDS channel (reference:
+        ray_syncer.h:83) — currently: drain/undrain this node."""
+        from ray_tpu._private.gcs import GcsSubscriber
+
+        sub = None
+        while not self._shutdown:
+            try:
+                if sub is None:
+                    sub = GcsSubscriber(self.gcs_address, ["commands"])
+                events, _gap = sub.poll(timeout_s=10.0)
+            except Exception:
+                sub = None
+                if self._shutdown:
+                    return
+                time.sleep(0.5)
+                continue
+            for e in events:
+                target = e.get("node_id")
+                if target is not None and target != self.node_id:
+                    continue  # addressed to another node (None = all)
+                if e.get("type") == "drain":
+                    with self._lock:
+                        self._draining = True
+                        self._wake.notify_all()  # spill pending work now
+                elif e.get("type") == "undrain":
+                    with self._lock:
+                        self._draining = False
+                        self._wake.notify_all()
+
     def _object_events_loop(self):
         """Subscribe to object-location events; re-trigger wanted pulls.
         (Reference: the pull manager reacting to ownership-pubsub location
@@ -1185,7 +1222,10 @@ class Scheduler:
         while not self._shutdown:
             try:
                 with self._lock:
-                    available = dict(self.available)
+                    # a draining node advertises NOTHING: peers stop
+                    # spilling to it while local work finishes
+                    available = {} if self._draining \
+                        else dict(self.available)
                     queued = len(self._pending)
                 self.gcs.heartbeat(self.node_id, available, queued)
                 if self.is_head:
@@ -1785,6 +1825,15 @@ class Scheduler:
                     progress = True
                     continue
                 # soft affinity to a dead node: fall through, run anywhere
+            if self._draining:
+                # drain: push forwardable work off this node first; only
+                # what has nowhere to go (or is pinned here) runs locally
+                target = cluster_mod.pick_spill_target(
+                    spec, self.node_id, self.total_resources,
+                    self._cluster_nodes)
+                if target is not None and self._forward(spec, target):
+                    progress = True
+                    continue
             granted = self._acquire_resources(spec)
             if granted is None:
                 target = cluster_mod.pick_spill_target(

@@ -406,8 +406,24 @@ class AutoscalerV2:
         else:
             inst.transition(ALLOCATION_FAILED, error)
 
+    drain_grace_s: float = 2.0
+
     def _terminate_instance(self, inst: Instance):
         inst.transition(TERMINATING)
+        # graceful drain first (syncer COMMANDS channel): the nodes stop
+        # advertising capacity and spill forwardable pending work before
+        # the processes die
+        broadcast = getattr(self._gcs, "broadcast_command", None)
+        if broadcast is not None and inst.node_ids:
+            any_drained = False
+            for nid in inst.node_ids:
+                try:
+                    broadcast({"type": "drain", "node_id": nid})
+                    any_drained = True
+                except Exception:
+                    continue  # per-node best effort: drain the rest
+            if any_drained and self.drain_grace_s > 0:
+                time.sleep(self.drain_grace_s)
         try:
             self._provider.terminate(inst)
         except Exception:

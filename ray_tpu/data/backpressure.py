@@ -55,7 +55,9 @@ class BackpressurePolicy:
     def on_launch(self, snap: OpSnapshot) -> None:
         pass
 
-    def on_complete(self, op_name: str, out_bytes: int) -> None:
+    def on_complete(self, op_token: str, out_bytes: int) -> None:
+        """op_token is the UNIQUE execution token (OpSnapshot.op_token),
+        matching on_launch's snap.op_token — not the display name."""
         pass
 
 

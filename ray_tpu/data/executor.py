@@ -139,6 +139,8 @@ def _window_run(submit: Callable[[], Optional[ObjectRef]],
     exhausted = False
     bytes_per_task = 0.0  # rolling estimate from completed tasks
     completed = 0
+    launched = 0
+    released = 0
     try:
         while True:
             while not exhausted and len(pending) < window:
@@ -155,6 +157,7 @@ def _window_run(submit: Callable[[], Optional[ObjectRef]],
                     break
                 pending.append(ref)
                 stats.tasks += 1
+                launched += 1
                 for p in policies:
                     p.on_launch(snap)
             if not pending:
@@ -180,15 +183,17 @@ def _window_run(submit: Callable[[], Optional[ObjectRef]],
             # size regimes without storing per-task history
             alpha = 1.0 if completed == 1 else 0.25
             bytes_per_task += alpha * (out_bytes - bytes_per_task)
+            released += 1
             for p in policies:
                 p.on_complete(op_token, out_bytes)
             yield result
     finally:
-        # Abandoned stream (take()/limit()/exception mid-iteration):
-        # release the accounting for tasks still in the window, or a
+        # Abandoned or failed stream (take()/limit(), a task exception —
+        # including the popped head ray_tpu.get raised on): release the
+        # accounting for every launch not yet released, or a
         # process-shared policy leaks budget forever and eventually
         # wedges every later execution.
-        for _ in pending:
+        for _ in range(launched - released):
             for p in policies:
                 try:
                     p.on_complete(op_token, 0)

@@ -524,6 +524,16 @@ static std::string dispatch(Gcs& g, const wire::Request& req,
       r = Value::Dict();
       for (auto& [id, pg] : g.pgs)
         r.pairs->emplace_back(Value::Bytes(id), pg);
+    } else if (m == "broadcast_command") {
+      // syncer COMMANDS channel (reference: ray_syncer.h:83): publish the
+      // payload cluster-wide; schedulers subscribed to "commands" act
+      const Value* payload = arg(req, 0, "payload");
+      Value ev = Value::Dict();
+      ev.set("ch", Value::Str("commands"));
+      if (payload && payload->pairs)
+        for (auto& [k, v] : *payload->pairs)
+          if (k.kind == Value::STR && k.s != "ch") ev.set(k.s, v);
+      g.publish("commands", std::move(ev));
     } else if (m == "sub_poll") {
       // sub_poll(channels, cursor, timeout_ms) -> {cursor, events, gap}
       const Value* chv = arg(req, 0, "channels");

@@ -2,133 +2,66 @@
 
 Counterpart of /root/reference/rllib/algorithms/appo/ (APPOConfig — PPO's
 clipped surrogate trained IMPALA-style: env runners sample continuously
-and slightly stale, a target network bounds the policy lag).  Here the
-asynchrony is pipelined futures: while the learner updates on batch N,
-every runner is already sampling batch N+1 with the previous weights —
-on-policy drift is one iteration deep, corrected by the clipped
-importance ratio exactly as APPO intends.
+and slightly stale).  Here the asynchrony is pipelined futures: while the
+learner updates on batch N, every runner is already sampling batch N+1
+with the previous weights — on-policy drift is one iteration deep,
+corrected by the clipped importance ratio exactly as APPO intends.
 
-TPU-shaping: reuses the single jitted ``ppo_update`` program; the overlap
-hides host-side env stepping behind the device update.
+Implementation: a PPO subclass overriding ONLY the collection hook
+(``_collect``) — loss, batch prep, checkpointing, and evaluation are
+inherited unchanged, and the update stays the single jitted
+``ppo_update`` program; the overlap hides host-side env stepping behind
+the device update.
 """
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, Union
 
 import jax
-import numpy as np
 
 import ray_tpu
-from ray_tpu.rllib import module as module_mod
-from ray_tpu.rllib.env_runner import EnvRunner
-from ray_tpu.rllib.ppo import frags_to_batch, ppo_update
+from ray_tpu.rllib.ppo import PPO, PPOConfig
 
 
 @dataclass
-class APPOConfig:
-    """Reference: rllib/algorithms/appo/appo.py APPOConfig."""
+class APPOConfig(PPOConfig):
+    """Reference: rllib/algorithms/appo/appo.py APPOConfig.  Fewer update
+    epochs than PPO by default: the data is one iteration stale."""
 
-    env: Union[str, Callable] = "CartPole-v1"
-    num_env_runners: int = 2
-    num_envs_per_runner: int = 2
-    rollout_fragment_length: int = 64
-    gamma: float = 0.99
-    lambda_: float = 0.95
-    clip_param: float = 0.2
-    entropy_coeff: float = 0.01
-    vf_loss_coeff: float = 0.5
-    grad_clip: float = 0.5
-    lr: float = 5e-3
-    num_epochs: int = 2   # APPO uses fewer epochs: data is slightly stale
-    minibatch_size: int = 128
-    hidden: tuple = (64, 64)
-    seed: int = 0
+    num_epochs: int = 2
 
     def build(self) -> "APPO":
         return APPO(self)
 
 
-class APPO:
-    """Tune-compatible trainable with pipelined (async) sampling."""
+class APPO(PPO):
+    """PPO with pipelined (async) sampling."""
 
     def __init__(self, config: APPOConfig):
-        import optax
-
-        self.config = config
-        RunnerActor = ray_tpu.remote(EnvRunner)
-        self.runners = [
-            RunnerActor.remote(config.env, config.num_envs_per_runner,
-                               seed=config.seed + 1000 * i)
-            for i in range(config.num_env_runners)]
-        spec = ray_tpu.get(self.runners[0].env_spec.remote(), timeout=60)
-        self.module_cfg = module_mod.MLPConfig(
-            obs_dim=spec["obs_dim"], n_actions=spec["n_actions"],
-            hidden=config.hidden)
-        self.params = module_mod.init_mlp(
-            self.module_cfg, jax.random.PRNGKey(config.seed))
-        tx = optax.chain(optax.clip_by_global_norm(config.grad_clip),
-                         optax.adam(config.lr))
-        self.opt_state = tx.init(self.params)
-        self.iteration = 0
-        self._timesteps = 0
-        # the async pipeline: futures for the batch being sampled RIGHT
-        # NOW (with the weights of the previous iteration)
+        super().__init__(config)
+        # futures for the batch being sampled RIGHT NOW, and the weights
+        # it is being sampled WITH (the behavior policy)
         self._inflight = None
         self._inflight_params = None
 
     def _launch_sampling(self):
-        host_params = jax.device_get(self.params)
-        params_ref = ray_tpu.put(host_params)
+        behavior = jax.device_get(self.params)
+        params_ref = ray_tpu.put(behavior)
         self._inflight = [
             r.sample.remote(params_ref,
                             self.config.rollout_fragment_length)
             for r in self.runners]
-        self._inflight_params = host_params
+        self._inflight_params = behavior
 
-    def train(self) -> Dict[str, Any]:
-        cfg = self.config
-        t0 = time.perf_counter()
+    def _collect(self):
         if self._inflight is None:
             self._launch_sampling()
         frags = ray_tpu.get(self._inflight, timeout=600)
         behavior_params = self._inflight_params
-        # NEXT batch starts sampling immediately — with the weights the
-        # learner is ABOUT to update away from (the APPO staleness)
+        # the NEXT batch starts sampling immediately — with the weights
+        # the learner is ABOUT to update away from (the APPO staleness);
+        # frags_to_batch uses behavior logp, which the clipped ratio
+        # corrects during the update
         self._launch_sampling()
-
-        # shared PPO batch prep with the BEHAVIOR params: logp_old from
-        # the stale policy is what the clipped ratio corrects
-        batch = frags_to_batch(frags, behavior_params, cfg)
-        self._timesteps += int(batch["obs"].shape[0])
-        self.params, self.opt_state, stats = ppo_update(
-            self.params, self.opt_state, batch,
-            jax.random.PRNGKey(self.iteration),
-            num_epochs=cfg.num_epochs,
-            minibatch_size=min(cfg.minibatch_size,
-                               int(batch["obs"].shape[0])),
-            clip=cfg.clip_param, ent_coeff=cfg.entropy_coeff,
-            vf_coeff=cfg.vf_loss_coeff, grad_clip=cfg.grad_clip,
-            lr=cfg.lr)
-        self.iteration += 1
-        metrics = ray_tpu.get(
-            [r.get_metrics.remote() for r in self.runners], timeout=60)
-        returns = [x for m in metrics for x in m["episode_returns"]]
-        return {
-            "training_iteration": self.iteration,
-            "timesteps_total": self._timesteps,
-            "episode_return_mean": (float(np.mean(returns))
-                                    if returns else float("nan")),
-            "num_episodes": len(returns),
-            "time_this_iter_s": time.perf_counter() - t0,
-            **{k: float(v) for k, v in stats.items()},
-        }
-
-    def stop(self):
-        for r in self.runners:
-            try:
-                ray_tpu.kill(r)
-            except Exception:
-                pass
+        return frags, behavior_params

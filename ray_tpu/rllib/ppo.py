@@ -205,14 +205,24 @@ class PPO:
         self.iteration = 0
         self._timesteps = 0
 
-    def train(self) -> Dict[str, Any]:
+    def _collect(self):
+        """Gather one round of fragments.  Returns (frags,
+        behavior_params) — the params the rollouts were SAMPLED with.
+        PPO samples synchronously (behavior == current); APPO overrides
+        with pipelined one-iteration-stale sampling."""
         cfg = self.config
-        t0 = time.perf_counter()
-        params_ref = ray_tpu.put(jax.device_get(self.params))
+        behavior = jax.device_get(self.params)
+        params_ref = ray_tpu.put(behavior)
         frags = ray_tpu.get(
             [r.sample.remote(params_ref, cfg.rollout_fragment_length)
              for r in self.runners], timeout=600)
-        batch = frags_to_batch(frags, self.params, cfg)
+        return frags, behavior
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        frags, behavior_params = self._collect()
+        batch = frags_to_batch(frags, behavior_params, cfg)
         self._timesteps += batch["obs"].shape[0]
         self.params, self.opt_state, stats = ppo_update(
             self.params, self.opt_state, batch,

@@ -1,0 +1,92 @@
+"""Syncer COMMANDS channel + graceful node drain (reference: the
+ray_syncer COMMANDS channel, src/ray/common/ray_syncer/ray_syncer.h:83,
+and autoscaler drain-before-terminate)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def two_nodes():
+    import ray_tpu.api as api
+    from ray_tpu._private import worker as worker_mod
+
+    prev_ctx = worker_mod._global_worker
+    prev_node = api._global_node
+    worker_mod.set_global_worker(None)
+    api._global_node = None
+    cluster = Cluster(head_node_args={
+        "resources": {"CPU": 2.0}, "min_workers": 1, "max_workers": 4,
+        "object_store_memory": 1 << 27})
+    ray_tpu.init(address=cluster.gcs_address)
+    wn = cluster.add_node(resources={"CPU": 2.0}, min_workers=1,
+                          max_workers=3, object_store_memory=1 << 27)
+    cluster.wait_for_nodes()
+    yield cluster, wn
+    ray_tpu.shutdown()
+    cluster.shutdown()
+    worker_mod.set_global_worker(prev_ctx)
+    api._global_node = prev_node
+
+
+def test_drain_zeroes_advertised_capacity_and_redirects_work(two_nodes):
+    cluster, wn = two_nodes
+    head = cluster.head_node
+
+    head.gcs.broadcast_command({"type": "drain",
+                                "node_id": wn.node_id})
+    # the drained node's next heartbeat advertises nothing
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        info = head.gcs.get_node(wn.node_id)
+        if info is not None and not info.available:
+            break
+        time.sleep(0.1)
+    assert not head.gcs.get_node(wn.node_id).available
+
+    @ray_tpu.remote(resources={"CPU": 1.0})
+    def where():
+        import os
+
+        return os.environ["RAY_TPU_NODE_ID"]
+
+    homes = set(ray_tpu.get([where.remote() for _ in range(6)],
+                            timeout=120))
+    assert wn.node_id.hex() not in homes  # nothing lands on the drained node
+
+    # undrain restores capacity and eligibility
+    head.gcs.broadcast_command({"type": "undrain",
+                                "node_id": wn.node_id})
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        info = head.gcs.get_node(wn.node_id)
+        if info is not None and info.available:
+            break
+        time.sleep(0.1)
+    assert head.gcs.get_node(wn.node_id).available
+
+
+def test_drain_spills_pending_work(two_nodes):
+    """Work already QUEUED on a node when the drain lands finishes
+    elsewhere instead of waiting out the drain."""
+    cluster, wn = two_nodes
+    head = cluster.head_node
+
+    @ray_tpu.remote(resources={"CPU": 1.0})
+    def slowish(i):
+        import os
+        import time as _t
+
+        _t.sleep(0.4)
+        return (i, os.environ["RAY_TPU_NODE_ID"])
+
+    # saturate the cluster so some specs queue on the worker node
+    refs = [slowish.remote(i) for i in range(10)]
+    time.sleep(0.3)
+    head.gcs.broadcast_command({"type": "drain", "node_id": wn.node_id})
+    results = ray_tpu.get(refs, timeout=180)
+    assert sorted(i for i, _ in results) == list(range(10))

@@ -79,7 +79,8 @@ def test_appo_learns_with_pipelined_sampling(ray_cluster):
     from ray_tpu.rllib.appo import APPOConfig
 
     algo = APPOConfig(num_env_runners=2, num_envs_per_runner=2,
-                      rollout_fragment_length=64, seed=0).build()
+                      rollout_fragment_length=64, lr=5e-3,
+                      minibatch_size=128, seed=0).build()
     try:
         best = 0.0
         for _ in range(30):
