@@ -9,6 +9,7 @@ from ray_tpu.tune.context import get_checkpoint, report
 from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,
     TrialScheduler,
@@ -32,6 +33,7 @@ __all__ = [
     "ASHAScheduler",
     "BasicVariantGenerator",
     "FIFOScheduler",
+    "HyperBandScheduler",
     "MedianStoppingRule",
     "PopulationBasedTraining",
     "Result",

@@ -160,3 +160,46 @@ class PopulationBasedTraining(TrialScheduler):
                 if isinstance(out[key], (int, float)):
                     out[key] = type(out[key])(out[key] * factor)
         return out
+
+
+class HyperBandScheduler(TrialScheduler):
+    """HyperBand: multiple successive-halving brackets trading off
+    exploration breadth against per-trial budget (reference:
+    schedulers/hyperband.py).  Bracket s gives trials a grace period of
+    max_t / rf^s; new trials join brackets round-robin, and within a
+    bracket the ASHA rung rule decides stop/continue — the asynchronous
+    formulation of HyperBand's halving, same as the reference's
+    bracket-based implementation."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 max_t: int = 81, reduction_factor: int = 3):
+        # integer bracket count: float log under-rounds exact powers
+        # (log(243, 3) == 4.9999...), which would silently drop the
+        # most-exploratory grace=1 bracket
+        s_max = 0
+        while reduction_factor ** (s_max + 1) <= max_t:
+            s_max += 1
+        self._brackets: List[ASHAScheduler] = []
+        for s in range(s_max, -1, -1):
+            grace = max(1, max_t // (reduction_factor ** s))
+            self._brackets.append(ASHAScheduler(
+                time_attr=time_attr, grace_period=grace,
+                reduction_factor=reduction_factor, max_t=max_t))
+        self._assignment: Dict[str, int] = {}
+        self._next_bracket = 0
+
+    def set_metric(self, metric: str, mode: str):
+        super().set_metric(metric, mode)
+        for b in self._brackets:
+            b.set_metric(metric, mode)
+
+    def bracket_of(self, trial_id: str) -> int:
+        if trial_id not in self._assignment:
+            self._assignment[trial_id] = self._next_bracket
+            self._next_bracket = (self._next_bracket + 1) \
+                % len(self._brackets)
+        return self._assignment[trial_id]
+
+    def on_result(self, trial_id: str, result: dict) -> str:
+        return self._brackets[self.bracket_of(trial_id)].on_result(
+            trial_id, result)

@@ -162,3 +162,43 @@ def test_median_stopping():
     # c is far below the median of running averages -> stopped
     assert sched.on_result("c", {"acc": 0.1, "training_iteration": 3}) \
         == tune.schedulers.STOP
+
+
+def test_hyperband_brackets_and_stopping():
+    """HyperBand (reference: schedulers/hyperband.py): trials round-robin
+    into brackets with geometric grace periods; within a bracket the
+    halving rung rule stops the weak."""
+    from ray_tpu import tune
+
+    hb = tune.HyperBandScheduler(max_t=9, reduction_factor=3)
+    hb.set_metric("score", "max")
+    n_brackets = len(hb._brackets)
+    assert n_brackets >= 2
+    graces = [b._rungs[0] if b._rungs else hb._brackets[0]._max_t
+              for b in hb._brackets]
+    assert graces == sorted(graces)  # exploratory -> conservative
+    # round-robin assignment
+    for i in range(2 * n_brackets):
+        assert hb.bracket_of(f"t{i}") == i % n_brackets
+    # weak trial in a halving bracket stops at its rung; strong continues
+    bracket_id = hb.bracket_of("strong")
+    # put 'weak' in the SAME bracket to share a rung history
+    hb._assignment["weak"] = bracket_id
+    decisions = []
+    for t in range(1, 10):
+        decisions.append(hb.on_result("strong", {"training_iteration": t,
+                                                 "score": 100.0}))
+        decisions.append(hb.on_result("weak", {"training_iteration": t,
+                                               "score": 1.0}))
+    assert tune.schedulers.STOP in decisions[1::2]  # weak stopped
+    # the strong trial survives EVERY rung before max_t
+    strong_decisions = decisions[0::2]
+    assert all(d == tune.schedulers.CONTINUE
+               for d in strong_decisions[:-1]), strong_decisions
+    # exact-power bracket count: no float-log under-round
+    hb243 = tune.HyperBandScheduler(max_t=243, reduction_factor=3)
+    # a bracket whose grace == max_t has no intermediate rungs (it runs
+    # every trial to completion) — read its grace as max_t
+    graces243 = [b._rungs[0] if b._rungs else 243
+                 for b in hb243._brackets]
+    assert min(graces243) == 1, graces243
