@@ -127,8 +127,6 @@ def parse_direct_frame(frame: bytes):
         tl = frame[1]
         return "call", pickle.loads(frame[2 + tl:])
     if kind == FRAME_CALL:
-        from ray_tpu._private.task_spec import ACTOR_METHOD, TaskSpec
-
         pos = 1
         tl = frame[pos]; pos += 1
         tid = frame[pos:pos + tl]; pos += tl
@@ -138,11 +136,49 @@ def parse_direct_frame(frame: bytes):
         aid = frame[pos:pos + al]; pos += al
         (ml,) = _U16.unpack_from(frame, pos); pos += 2
         method = frame[pos:pos + ml].decode("utf-8"); pos += ml
-        return "call", TaskSpec(
-            task_id=tid, kind=ACTOR_METHOD, fn_id=b"",
-            args_blob=frame[pos:], return_ids=[rid], actor_id=aid,
-            method_name=method, name=method)
+        return "call", _fast_method_spec(tid, rid, aid, method, frame[pos:])
     return None, None
+
+
+def _fast_method_spec(tid, rid, aid, method, args_blob):
+    """Hot-path TaskSpec: skip the 20-field dataclass __init__ — start
+    from a frozen defaults dict and overwrite the 7 live fields."""
+    from ray_tpu._private.task_spec import TaskSpec
+
+    spec = TaskSpec.__new__(TaskSpec)
+    defaults, mutable_keys = _method_spec_defaults()
+    spec.__dict__.update(defaults)
+    for key in mutable_keys:
+        # never share the template's mutable defaults across specs — a
+        # handler mutating one in place would corrupt concurrent tasks
+        spec.__dict__[key] = type(defaults[key])()
+    spec.task_id = tid
+    spec.args_blob = args_blob
+    spec.return_ids = [rid]
+    spec.actor_id = aid
+    spec.method_name = method
+    spec.name = method
+    return spec
+
+
+_METHOD_SPEC_DEFAULTS = None
+
+
+def _method_spec_defaults() -> tuple:
+    """(defaults dict, keys holding mutable values) — the mutable set is
+    DISCOVERED from the template, so a future TaskSpec field with a
+    list/dict/set default is copied per spec automatically."""
+    global _METHOD_SPEC_DEFAULTS
+    if _METHOD_SPEC_DEFAULTS is None:
+        from ray_tpu._private.task_spec import ACTOR_METHOD, TaskSpec
+
+        template = TaskSpec(task_id=b"", kind=ACTOR_METHOD, fn_id=b"",
+                            args_blob=b"", return_ids=[])
+        defaults = dict(template.__dict__)
+        mutable = tuple(k for k, v in defaults.items()
+                        if isinstance(v, (list, dict, set)))
+        _METHOD_SPEC_DEFAULTS = (defaults, mutable)
+    return _METHOD_SPEC_DEFAULTS
 
 
 def encode_direct_reply(request_first_byte: int, reply: dict) -> bytes:

@@ -173,6 +173,9 @@ class HyperBandScheduler(TrialScheduler):
 
     def __init__(self, time_attr: str = "training_iteration",
                  max_t: int = 81, reduction_factor: int = 3):
+        if reduction_factor < 2:
+            raise ValueError(
+                f"reduction_factor must be >= 2, got {reduction_factor}")
         # integer bracket count: float log under-rounds exact powers
         # (log(243, 3) == 4.9999...), which would silently drop the
         # most-exploratory grace=1 bracket
