@@ -239,6 +239,20 @@ class Scheduler:
             max_workers=max_workers,
             worker_env=worker_env,
         )
+        # Per-node dashboard agent: physical stats reporter (reference:
+        # dashboard/modules/reporter/ sampled by the per-node agent).
+        from ray_tpu.dashboard.agent import NodeStatsReporter
+
+        def _live_workers():
+            with self._lock:
+                rows = [(w.proc.pid,
+                         next((s.name or s.method_name or ""
+                               for s in w.in_flight.values()), ""))
+                        for w in self._pool.workers.values() if w.alive]
+            return rows
+
+        self.reporter = NodeStatsReporter(self.node_id, _live_workers)
+        self.reporter.start()
         # Worker log streaming (reference: _private/log_monitor.py tailing
         # to the driver): this node's monitor forwards new worker-output
         # lines to the driver's sink — directly on the head, via a peer
@@ -667,6 +681,7 @@ class Scheduler:
             self._wake.notify_all()
         if self._memory_monitor is not None:
             self._memory_monitor.shutdown()
+        self.reporter.shutdown()
         if self._log_monitor is not None:
             self._log_monitor.stop()
         self._pool.shutdown_all()
@@ -908,6 +923,8 @@ class Scheduler:
                 self._app_metrics = {}
             self._app_metrics[bytes(params["source"])] = params["metrics"]
             return True
+        if method == "node_physical_stats":
+            return self.reporter.latest()
         if method == "metrics_snapshot":
             sources = dict(getattr(self, "_app_metrics", {}))
             try:

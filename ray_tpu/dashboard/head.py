@@ -12,21 +12,30 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import threading
 from typing import Optional
 
 from ray_tpu._private import protocol
 
-_INDEX_HTML = """<!doctype html><title>ray_tpu dashboard</title>
-<h1>ray_tpu dashboard</h1>
+# The SPA (reference: python/ray/dashboard/client/ — a React/TS app; ours
+# is a framework-free client in dashboard/client/) is served at "/"; the
+# server-rendered /status page stays for curl/noscript use.
+_CLIENT_DIR = os.path.join(os.path.dirname(__file__), "client")
+
+_INDEX_HTML = """<!doctype html><title>ray_tpu dashboard API</title>
+<h1>ray_tpu dashboard API</h1>
 <ul>
-<li><a href="/status">/status (live cluster page)</a></li>
+<li><a href="/">/ (dashboard SPA)</a></li>
+<li><a href="/status">/status (server-rendered cluster page)</a></li>
 <li><a href="/api/nodes">/api/nodes</a></li>
+<li><a href="/api/node_stats">/api/node_stats</a></li>
 <li><a href="/api/actors">/api/actors</a></li>
 <li><a href="/api/placement_groups">/api/placement_groups</a></li>
 <li><a href="/api/jobs">/api/jobs</a></li>
 <li><a href="/api/tasks/summary">/api/tasks/summary</a></li>
 <li><a href="/api/cluster_status">/api/cluster_status</a></li>
+<li><a href="/api/serve">/api/serve</a></li>
 <li><a href="/metrics">/metrics (Prometheus)</a></li>
 </ul>"""
 
@@ -199,6 +208,37 @@ class DashboardHead:
     def _cluster_status(self):
         return _node_rpc(self._head_sock, "cluster_state")
 
+    def _node_stats(self):
+        """Aggregate every alive node's physical stats (per-node agent
+        reporter — dashboard/agent.py)."""
+        out = []
+        for n in self._gcs.list_nodes():
+            if not n.alive:
+                continue
+            try:
+                out.append(_node_rpc(n.sched_socket, "node_physical_stats"))
+            except Exception:
+                continue
+        return {"nodes": out}
+
+    def _serve_status(self):
+        """Best-effort Serve app/deployment status.  Works when the head
+        process has a driver context (in-process clusters and `rtpu
+        start` heads both do); degrades to a structured error otherwise."""
+        try:
+            from ray_tpu.serve import api as serve_api
+
+            return serve_api.status()
+        except Exception as e:
+            return {"error": f"serve not running: {type(e).__name__}"}
+
+    def _job_logs(self, submission_id: str):
+        try:
+            return {"logs": _node_rpc(self._head_sock, "job_logs",
+                                      {"submission_id": submission_id})}
+        except Exception as e:
+            return {"error": repr(e)}
+
     def _status_html(self) -> str:
         """One server-rendered, self-refreshing cluster status page
         (reference: the dashboard SPA's cluster view, rendered without the
@@ -330,9 +370,23 @@ class DashboardHead:
             return web.Response(text=json.dumps(data, default=str),
                                 content_type="application/json")
 
+        async def spa(request):
+            return web.FileResponse(os.path.join(_CLIENT_DIR, "index.html"))
+
+        async def job_logs(request):
+            sid = request.query.get("submission_id", "")
+            data = await loop.run_in_executor(None, self._job_logs, sid)
+            return web.Response(text=json.dumps(data, default=str),
+                                content_type="application/json")
+
         app = web.Application()
         app.router.add_get("/api/logs", logs)
-        app.router.add_get("/", index)
+        app.router.add_get("/", spa)
+        app.router.add_get("/api", index)
+        app.router.add_static("/ui/", _CLIENT_DIR)
+        app.router.add_get("/api/jobs/logs", job_logs)
+        app.router.add_get("/api/node_stats", json_handler(self._node_stats))
+        app.router.add_get("/api/serve", json_handler(self._serve_status))
         app.router.add_get("/status", status_page)
         app.router.add_get("/api/nodes", json_handler(self._nodes))
         app.router.add_get("/api/actors", json_handler(self._actors))

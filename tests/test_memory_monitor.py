@@ -120,11 +120,11 @@ def test_oom_error_carries_provenance(ray_cluster):
     def hog():
         import time as _t
 
-        _t.sleep(5.0)  # wide window: the kill must land mid-execution
+        _t.sleep(20.0)  # wide window: the kill must land mid-execution
         return 1
 
     ref = hog.options(max_retries=0).remote()
-    deadline = time.monotonic() + 30
+    deadline = time.monotonic() + 60
     killed = False
     while time.monotonic() < deadline and not killed:
         with sched._lock:

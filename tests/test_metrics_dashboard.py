@@ -79,3 +79,41 @@ def test_runtime_metrics_present(cluster):
     text = _get(url + "/metrics")
     assert "ray_tpu_node_workers" in text
     assert "ray_tpu_node_tasks_pending" in text
+
+
+def test_spa_and_static_assets(cluster):
+    """The SPA (dashboard/client/) is served at / with its assets under
+    /ui/ (reference: the React client bundle served by the head)."""
+    url = cluster.dashboard_url
+    index = _get(url + "/")
+    assert "ray_tpu dashboard" in index and "/ui/app.js" in index
+    js = _get(url + "/ui/app.js")
+    assert "viewOverview" in js and "lineChart" in js
+    css = _get(url + "/ui/style.css")
+    assert "--series-1" in css
+    # the JSON API index moved to /api
+    assert "/api/nodes" in _get(url + "/api")
+
+
+def test_node_stats_reporter(cluster):
+    """Per-node agent physical stats: cpu/mem/disk/workers + history ring
+    (reference: dashboard/modules/reporter/ via the per-node agent)."""
+    url = cluster.dashboard_url
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        stats = json.loads(_get(url + "/api/node_stats"))
+        if stats["nodes"] and stats["nodes"][0].get("history"):
+            break
+        time.sleep(0.5)
+    assert stats["nodes"], stats
+    s = stats["nodes"][0]
+    assert s["mem_total"] > 0
+    assert "cpu_percent" in s and "disk" in s
+    assert isinstance(s["workers"], list)
+    assert s["history"] and "ts" in s["history"][0]
+
+
+def test_serve_status_endpoint(cluster):
+    url = cluster.dashboard_url
+    st = json.loads(_get(url + "/api/serve"))
+    assert isinstance(st, dict)  # {} / {"error": ...} / app statuses
