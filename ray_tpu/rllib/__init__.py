@@ -8,6 +8,7 @@ dqn.py, replay_buffers.py).
 
 from ray_tpu.rllib.bc import BC, BCConfig, MARWILConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig
+from ray_tpu.rllib.dreamerv3 import DreamerV3, DreamerV3Config
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.module import MLPConfig, forward, greedy_action, init_mlp
@@ -20,6 +21,8 @@ __all__ = [
     "MARWILConfig",
     "DQN",
     "DQNConfig",
+    "DreamerV3",
+    "DreamerV3Config",
     "EnvRunner",
     "IMPALA",
     "IMPALAConfig",

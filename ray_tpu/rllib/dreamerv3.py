@@ -1,0 +1,596 @@
+"""DreamerV3 (compact, discrete actions): world-model RL in pure JAX.
+
+Counterpart of /root/reference/rllib/algorithms/dreamerv3/ (DreamerV3Config,
+torch/tf RSSM world model + imagination-trained actor-critic).  The
+reference delegates the math to its framework learners; here the entire
+update — RSSM observe, world-model losses, latent imagination, and the
+actor/critic updates — is ONE jitted function over fixed [B, T] shapes
+(TPU stance: the scan over time compiles to a single fused loop, no Python
+in the hot path).
+
+Kept from the DreamerV3 recipe (arXiv:2301.04104):
+  * discrete stochastic latents (vars x classes) with straight-through
+    gradients and 1% uniform mixing,
+  * symlog squashing for observation/reward targets,
+  * KL balancing (dyn 0.5 / rep 0.1) with free bits (1 nat),
+  * imagination horizon rollouts from every posterior state,
+  * lambda-returns over predicted reward/continue,
+  * percentile (5-95) EMA return normalization for the actor,
+  * REINFORCE actor gradients (the discrete-action path) + entropy bonus,
+  * slow critic target (EMA) regularizing the value bootstrap.
+Omitted for compactness (documented, not silently): twohot critail
+distributional heads (symlog MSE instead) and image encoders (vector obs).
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Dict, List, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+
+
+def symlog(x):
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x):
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+
+@dataclass(eq=False)  # identity hash: the config doubles as a jit static arg
+class DreamerV3Config:
+    """Reference: rllib/algorithms/dreamerv3/dreamerv3.py DreamerV3Config.
+    Sizes default far below the paper's XL — sized for CPU-mesh tests; scale
+    `deter/hidden/stoch_*` up for real workloads."""
+
+    env: Union[str, Callable] = "CartPole-v1"
+    num_env_runners: int = 1
+    num_envs_per_runner: int = 1
+    rollout_fragment_length: int = 64
+    buffer_size_steps: int = 20_000
+    batch_size: int = 8            # sequences per world-model batch
+    batch_length: int = 16         # timesteps per sequence
+    train_ratio: int = 32          # replayed steps per env step (paper: 32+)
+    # world model
+    deter: int = 64                # GRU deterministic state
+    stoch_vars: int = 4
+    stoch_classes: int = 8
+    hidden: int = 64
+    embed: int = 32
+    unimix: float = 0.01
+    free_bits: float = 1.0
+    kl_dyn_scale: float = 0.5
+    kl_rep_scale: float = 0.1
+    # behavior
+    horizon: int = 10
+    gamma: float = 0.99
+    lam: float = 0.95
+    entropy_scale: float = 3e-3
+    critic_ema_decay: float = 0.98
+    return_norm_decay: float = 0.99
+    # optim
+    model_lr: float = 1e-3
+    actor_lr: float = 3e-4
+    critic_lr: float = 3e-4
+    grad_clip: float = 100.0
+    seed: int = 0
+
+    def build(self) -> "DreamerV3":
+        if self.batch_length > self.rollout_fragment_length:
+            raise ValueError(
+                f"batch_length ({self.batch_length}) must be <= "
+                f"rollout_fragment_length ({self.rollout_fragment_length}): "
+                "replay windows are cut from single sampled fragments")
+        return DreamerV3(self)
+
+
+def _make_txs(cfg: "DreamerV3Config"):
+    """The three optimizer chains — ONE definition shared by state init
+    (DreamerV3.__init__) and the jitted update, so they can never drift."""
+    import optax
+
+    def chain(lr):
+        return optax.chain(optax.clip_by_global_norm(cfg.grad_clip),
+                           optax.adam(lr))
+
+    return {"model": chain(cfg.model_lr), "actor": chain(cfg.actor_lr),
+            "critic": chain(cfg.critic_lr)}
+
+
+# ---------------------------------------------------------------------------
+# parameters (plain pytrees; linen would add nothing at this size)
+# ---------------------------------------------------------------------------
+
+
+def _dense(key, n_in, n_out):
+    k1, _ = jax.random.split(key)
+    scale = float(np.sqrt(1.0 / n_in))
+    return {"w": jax.random.uniform(k1, (n_in, n_out), jnp.float32,
+                                    -scale, scale),
+            "b": jnp.zeros((n_out,), jnp.float32)}
+
+
+def _apply(p, x):
+    return x @ p["w"] + p["b"]
+
+
+def _mlp(key, n_in, hidden, n_out):
+    k1, k2 = jax.random.split(key)
+    return {"h": _dense(k1, n_in, hidden), "o": _dense(k2, hidden, n_out)}
+
+
+def _mlp_fwd(p, x):
+    return _apply(p["o"], jax.nn.silu(_apply(p["h"], x)))
+
+
+def init_params(cfg: DreamerV3Config, obs_dim: int, n_actions: int, key):
+    zdim = cfg.stoch_vars * cfg.stoch_classes
+    ks = jax.random.split(key, 10)
+    feat = cfg.deter + zdim
+    return {
+        "enc": _mlp(ks[0], obs_dim, cfg.hidden, cfg.embed),
+        # GRU: one fused kernel for reset/update/candidate gates
+        "gru": _dense(ks[1], zdim + n_actions + cfg.deter, 3 * cfg.deter),
+        "prior": _mlp(ks[2], cfg.deter, cfg.hidden, zdim),
+        "post": _mlp(ks[3], cfg.deter + cfg.embed, cfg.hidden, zdim),
+        "dec": _mlp(ks[4], feat, cfg.hidden, obs_dim),
+        "rew": _mlp(ks[5], feat, cfg.hidden, 1),
+        "cont": _mlp(ks[6], feat, cfg.hidden, 1),
+        "actor": _mlp(ks[7], feat, cfg.hidden, n_actions),
+        "critic": _mlp(ks[8], feat, cfg.hidden, 1),
+    }
+
+
+# ---------------------------------------------------------------------------
+# RSSM core
+# ---------------------------------------------------------------------------
+
+
+def _gru(p, x, h):
+    gates = _apply(p["gru"], jnp.concatenate([x, h], -1))
+    r, u, c = jnp.split(gates, 3, -1)
+    r, u = jax.nn.sigmoid(r), jax.nn.sigmoid(u)
+    cand = jnp.tanh(r * c)
+    return u * cand + (1.0 - u) * h
+
+
+def _latent_dist(cfg: DreamerV3Config, logits):
+    """[..., vars*classes] -> unimix log-probs [..., vars, classes]."""
+    logits = logits.reshape(logits.shape[:-1]
+                            + (cfg.stoch_vars, cfg.stoch_classes))
+    probs = jax.nn.softmax(logits, -1)
+    probs = (1.0 - cfg.unimix) * probs + cfg.unimix / cfg.stoch_classes
+    return jnp.log(probs)
+
+
+def _sample_st(logp, key):
+    """Straight-through one-hot sample from categorical log-probs."""
+    idx = jax.random.categorical(key, logp, -1)
+    onehot = jax.nn.one_hot(idx, logp.shape[-1], dtype=jnp.float32)
+    probs = jnp.exp(logp)
+    return onehot + probs - jax.lax.stop_gradient(probs)
+
+
+def _obs_step(cfg, params, h, z, action, embed, is_first, key):
+    """One posterior RSSM step.  is_first masks state to zeros (episode
+    boundary inside a replayed sequence)."""
+    mask = 1.0 - is_first[..., None]
+    h, z = h * mask, z * mask
+    h = _gru(params, jnp.concatenate([z, action * mask], -1), h)
+    prior_logp = _latent_dist(cfg, _mlp_fwd(params["prior"], h))
+    post_logp = _latent_dist(
+        cfg, _mlp_fwd(params["post"], jnp.concatenate([h, embed], -1)))
+    z = _sample_st(post_logp, key).reshape(h.shape[:-1] + (-1,))
+    return h, z, prior_logp, post_logp
+
+
+def _img_step(cfg, params, h, z, action, key):
+    """One prior (imagination) step."""
+    h = _gru(params, jnp.concatenate([z, action], -1), h)
+    prior_logp = _latent_dist(cfg, _mlp_fwd(params["prior"], h))
+    z = _sample_st(prior_logp, key).reshape(h.shape[:-1] + (-1,))
+    return h, z
+
+
+def lambda_returns(rewards, conts, values, bootstrap, gamma, lam):
+    """R_t = r_t + gamma c_t [(1-lam) v_{t+1} + lam R_{t+1}] (paper eq. 7;
+    reference: the same recursion in the DreamerV3 critic loss)."""
+    next_vals = jnp.concatenate([values[1:], bootstrap[None]], 0)
+
+    def step(carry, xs):
+        r, c, nv = xs
+        ret = r + gamma * c * ((1.0 - lam) * nv + lam * carry)
+        return ret, ret
+
+    _, rets = jax.lax.scan(step, bootstrap, (rewards, conts, next_vals),
+                           reverse=True)
+    return rets
+
+
+# ---------------------------------------------------------------------------
+# the fused update: world model + imagination + actor-critic
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _update(cfg: DreamerV3Config, params, critic_target, opts, retnorm,
+            batch, key):
+    import optax
+
+    txs = _make_txs(cfg)
+    model_tx, actor_tx, critic_tx = txs["model"], txs["actor"], txs["critic"]
+    B, T = batch["obs"].shape[:2]
+    zdim = cfg.stoch_vars * cfg.stoch_classes
+    k_obs, k_img, k_act = jax.random.split(key, 3)
+
+    # ---- world model ------------------------------------------------------
+    def wm_loss_fn(wp):
+        embed = _mlp_fwd(wp["enc"], symlog(batch["obs"]))  # [B,T,E]
+        keys = jax.random.split(k_obs, T)
+
+        def scan_fn(carry, xs):
+            h, z = carry
+            a, e, first, kk = xs
+            h, z, prior_logp, post_logp = _obs_step(
+                cfg, wp, h, z, a, e, first, kk)
+            return (h, z), (h, z, prior_logp, post_logp)
+
+        init = (jnp.zeros((B, cfg.deter)), jnp.zeros((B, zdim)))
+        xs = (batch["actions"].swapaxes(0, 1),
+              embed.swapaxes(0, 1),
+              batch["is_first"].swapaxes(0, 1), keys)
+        _, (hs, zs, prior_lp, post_lp) = jax.lax.scan(scan_fn, init, xs)
+        hs, zs = hs.swapaxes(0, 1), zs.swapaxes(0, 1)        # [B,T,...]
+        prior_lp = prior_lp.swapaxes(0, 1)
+        post_lp = post_lp.swapaxes(0, 1)
+        feat = jnp.concatenate([hs, zs], -1)
+
+        recon = _mlp_fwd(wp["dec"], feat)
+        rew = _mlp_fwd(wp["rew"], feat)[..., 0]
+        cont_logit = _mlp_fwd(wp["cont"], feat)[..., 0]
+
+        recon_loss = jnp.mean(
+            jnp.sum((recon - symlog(batch["obs"])) ** 2, -1))
+        rew_loss = jnp.mean((rew - symlog(batch["rewards"])) ** 2)
+        cont_tgt = 1.0 - batch["is_terminal"]
+        cont_loss = jnp.mean(optax.sigmoid_binary_cross_entropy(
+            cont_logit, cont_tgt))
+
+        post_p = jnp.exp(post_lp)
+        kl = lambda lp_a, lp_b, p_a: jnp.sum(p_a * (lp_a - lp_b), (-2, -1))
+        dyn = jnp.maximum(cfg.free_bits, jnp.mean(kl(
+            jax.lax.stop_gradient(post_lp), prior_lp,
+            jax.lax.stop_gradient(post_p))))
+        rep = jnp.maximum(cfg.free_bits, jnp.mean(kl(
+            post_lp, jax.lax.stop_gradient(prior_lp), post_p)))
+        loss = (recon_loss + rew_loss + cont_loss
+                + cfg.kl_dyn_scale * dyn + cfg.kl_rep_scale * rep)
+        return loss, (hs, zs, recon_loss, rew_loss, dyn)
+
+    (wm_loss, (hs, zs, recon_l, rew_l, dyn_kl)), wm_grads = (
+        jax.value_and_grad(wm_loss_fn, has_aux=True)(params))
+    # actor/critic heads get no world-model gradient
+    for head in ("actor", "critic"):
+        wm_grads[head] = jax.tree.map(jnp.zeros_like, wm_grads[head])
+    wm_up, model_opt = model_tx.update(wm_grads, opts["model"], params)
+    params = optax.apply_updates(params, wm_up)
+
+    # ---- imagination from every posterior state --------------------------
+    h0 = jax.lax.stop_gradient(hs.reshape(-1, cfg.deter))
+    z0 = jax.lax.stop_gradient(zs.reshape(-1, zdim))
+    n_actions = params["actor"]["o"]["b"].shape[0]
+
+    def rollout(ap):
+        def step(carry, kk):
+            h, z = carry
+            k_a, k_z = jax.random.split(kk)
+            feat = jnp.concatenate([h, z], -1)
+            logits = _mlp_fwd(ap, feat)
+            a_idx = jax.random.categorical(k_a, logits, -1)
+            a = jax.nn.one_hot(a_idx, n_actions, dtype=jnp.float32)
+            h2, z2 = _img_step(cfg, params, h, z, a, k_z)
+            next_feat = jnp.concatenate([h2, z2], -1)
+            return (h2, z2), (feat, a_idx, next_feat)
+
+        keys = jax.random.split(k_img, cfg.horizon)
+        _, (feats, a_idx, next_feats) = jax.lax.scan(
+            step, (h0, z0), keys)
+        return feats, a_idx, next_feats
+
+    feats, a_idx, next_feats = rollout(params["actor"])  # [H,N,...]
+    # reward/continue predicted at the NEXT imagined state: r[k] is the
+    # direct consequence of a_idx[k] (states carry arrival rewards)
+    rewards = symexp(_mlp_fwd(params["rew"], next_feats)[..., 0])
+    conts = jax.nn.sigmoid(_mlp_fwd(params["cont"], next_feats)[..., 0])
+    # discount weights: imagined states after a predicted episode end stop
+    # contributing (the paper's cumulative continuation product)
+    weights = jnp.cumprod(
+        jnp.concatenate([jnp.ones_like(conts[:1]), conts[:-1]], 0), 0)
+    values = _mlp_fwd(critic_target, feats)[..., 0]
+    bootstrap = _mlp_fwd(critic_target, next_feats[-1])[..., 0]
+    returns = lambda_returns(rewards, conts, values, bootstrap,
+                             cfg.gamma, cfg.lam)
+
+    # percentile return normalization (paper: scale by EMA of the 5-95
+    # percentile range, never amplify below-1 ranges)
+    lo = jnp.percentile(returns, 5.0)
+    hi = jnp.percentile(returns, 95.0)
+    retnorm = cfg.return_norm_decay * retnorm \
+        + (1.0 - cfg.return_norm_decay) * jnp.maximum(hi - lo, 1.0)
+    adv = (returns - values) / retnorm
+
+    def actor_loss_fn(ap):
+        logp_all = jax.nn.log_softmax(
+            _mlp_fwd(ap, jax.lax.stop_gradient(feats)))
+        logp_a = jnp.take_along_axis(
+            logp_all, a_idx[..., None], -1)[..., 0]
+        entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, -1)
+        loss = -jnp.mean(weights * (
+            jax.lax.stop_gradient(adv) * logp_a
+            + cfg.entropy_scale * entropy))
+        return loss, jnp.mean(entropy)
+
+    (a_loss, entropy), a_grads = jax.value_and_grad(
+        actor_loss_fn, has_aux=True)(params["actor"])
+    a_up, actor_opt = actor_tx.update(a_grads, opts["actor"],
+                                      params["actor"])
+    params["actor"] = optax.apply_updates(params["actor"], a_up)
+
+    def critic_loss_fn(cp):
+        v = _mlp_fwd(cp, jax.lax.stop_gradient(feats))[..., 0]
+        return jnp.mean(weights * (
+            v - jax.lax.stop_gradient(returns)) ** 2)
+
+    c_loss, c_grads = jax.value_and_grad(critic_loss_fn)(params["critic"])
+    c_up, critic_opt = critic_tx.update(c_grads, opts["critic"],
+                                        params["critic"])
+    params["critic"] = optax.apply_updates(params["critic"], c_up)
+    critic_target = jax.tree.map(
+        lambda t, s: cfg.critic_ema_decay * t + (1 - cfg.critic_ema_decay)
+        * s, critic_target, params["critic"])
+
+    opts = {"model": model_opt, "actor": actor_opt, "critic": critic_opt}
+    metrics = {"wm_loss": wm_loss, "recon_loss": recon_l,
+               "rew_loss": rew_l, "dyn_kl": dyn_kl, "actor_loss": a_loss,
+               "critic_loss": c_loss, "entropy": entropy,
+               "return_mean": jnp.mean(returns)}
+    return params, critic_target, opts, retnorm, metrics
+
+
+# ---------------------------------------------------------------------------
+# acting + replay
+# ---------------------------------------------------------------------------
+
+
+class DreamerEnvRunner:
+    """Sampling actor with recurrent world-model filtering state: acting
+    requires carrying (h, z) across env steps (reference: the DreamerV3
+    EnvRunner keeps per-env RSSM states the same way)."""
+
+    def __init__(self, cfg: DreamerV3Config, seed: int = 0):
+        self.cfg = cfg
+        if isinstance(cfg.env, str):
+            import gymnasium as gym
+
+            self._env = gym.make(cfg.env)
+        else:
+            self._env = cfg.env()
+        self._obs, _ = self._env.reset(seed=seed)
+        self._first = True
+        self._h = self._z = None  # lazily zero-init once sizes are known
+        self._seed = seed
+        self._t = 0
+        self._ep_ret = 0.0
+        self._returns: List[float] = []
+
+    def env_spec(self):
+        return {"obs_dim": int(np.prod(self._env.observation_space.shape)),
+                "n_actions": int(self._env.action_space.n)}
+
+    def sample(self, params, num_steps: int) -> Dict[str, np.ndarray]:
+        """Sequence convention (matches the DreamerV3 replay layout):
+        ``actions[t]`` is the action that LED TO ``obs[t]`` (zeros on
+        is_first) and ``rewards[t]`` is the reward received on arriving at
+        ``obs[t]`` — so the world model's ``feat[t]`` (which saw
+        actions[<=t]) can predict rewards[t]."""
+        cfg = self.cfg
+        zdim = cfg.stoch_vars * cfg.stoch_classes
+        n_actions = params["actor"]["o"]["b"].shape[0]
+        if self._h is None:
+            self._h = jnp.zeros((1, cfg.deter))
+            self._z = jnp.zeros((1, zdim))
+            self._prev_a = np.zeros(n_actions, np.float32)
+            self._prev_r = 0.0
+            self._terminal = False
+            self._truncated = False
+        out = {k: [] for k in ("obs", "actions", "rewards", "is_first",
+                               "is_terminal")}
+        for _ in range(num_steps):
+            obs = np.asarray(self._obs, np.float32).reshape(-1)
+            out["obs"].append(obs)
+            out["actions"].append(self._prev_a.copy())
+            out["rewards"].append(np.float32(self._prev_r))
+            out["is_first"].append(np.float32(self._first))
+            out["is_terminal"].append(np.float32(self._terminal))
+            self._t += 1
+            if self._terminal or self._truncated:
+                self._returns.append(self._ep_ret)
+                self._ep_ret = 0.0
+                self._obs, _ = self._env.reset()
+                self._first = True
+                self._prev_a = np.zeros(n_actions, np.float32)
+                self._prev_r = 0.0
+                self._terminal = self._truncated = False
+                continue
+            key = jax.random.PRNGKey(
+                (self._seed * 1_000_003 + self._t) & 0x7FFFFFFF)
+            k_post, k_act = jax.random.split(key)
+            embed = _mlp_fwd(params["enc"],
+                             symlog(jnp.asarray(obs[None])))
+            h, z, _, _ = _obs_step(
+                cfg, params, self._h, self._z,
+                jnp.asarray(self._prev_a[None]), embed,
+                jnp.asarray([float(self._first)]), k_post)
+            logits = _mlp_fwd(params["actor"],
+                              jnp.concatenate([h, z], -1))
+            a = int(jax.random.categorical(k_act, logits, -1)[0])
+            nobs, r, term, trunc, _ = self._env.step(a)
+            self._h, self._z = h, z
+            self._prev_a = np.eye(n_actions, dtype=np.float32)[a]
+            self._prev_r = float(r)
+            self._first = False
+            self._terminal = bool(term)
+            self._truncated = bool(trunc)
+            self._ep_ret += float(r)
+            self._obs = nobs
+        return {k: np.stack(v) for k, v in out.items()}
+
+    def get_metrics(self):
+        rets, self._returns = self._returns, []
+        return {"episode_returns": rets}
+
+
+class SequenceReplay:
+    """Uniform random windows over contiguous sampled fragments."""
+
+    def __init__(self, capacity_steps: int, seed: int = 0):
+        self._frags: List[Dict[str, np.ndarray]] = []
+        self._steps = 0
+        self._cap = capacity_steps
+        self._rng = np.random.default_rng(seed)
+
+    def add(self, frag: Dict[str, np.ndarray]):
+        self._frags.append(frag)
+        self._steps += len(frag["rewards"])
+        while self._steps > self._cap and len(self._frags) > 1:
+            old = self._frags.pop(0)
+            self._steps -= len(old["rewards"])
+
+    def __len__(self):
+        return self._steps
+
+    def sample(self, batch_size: int, length: int) -> Dict[str, np.ndarray]:
+        out: List[Dict[str, np.ndarray]] = []
+        eligible = [f for f in self._frags if len(f["rewards"]) >= length]
+        for _ in range(batch_size):
+            f = eligible[self._rng.integers(len(eligible))]
+            t0 = self._rng.integers(len(f["rewards"]) - length + 1)
+            out.append({k: v[t0:t0 + length] for k, v in f.items()})
+        return {k: np.stack([o[k] for o in out]) for k in out[0]}
+
+
+# ---------------------------------------------------------------------------
+# algorithm
+# ---------------------------------------------------------------------------
+
+
+class DreamerV3:
+    """Tune-compatible trainable: train() -> result dict."""
+
+    def __init__(self, config: DreamerV3Config):
+        self.config = config
+        Runner = ray_tpu.remote(DreamerEnvRunner)
+        self._runners = [Runner.remote(config, seed=config.seed + 997 * i)
+                         for i in range(config.num_env_runners)]
+        spec = ray_tpu.get(self._runners[0].env_spec.remote())
+        self._spec = spec
+        key = jax.random.PRNGKey(config.seed)
+        self.params = init_params(config, spec["obs_dim"],
+                                  spec["n_actions"], key)
+        self.critic_target = jax.tree.map(jnp.copy, self.params["critic"])
+        txs = _make_txs(config)
+        self.opts = {"model": txs["model"].init(self.params),
+                     "actor": txs["actor"].init(self.params["actor"]),
+                     "critic": txs["critic"].init(self.params["critic"])}
+        self.retnorm = jnp.asarray(1.0)
+        self.buffer = SequenceReplay(config.buffer_size_steps,
+                                     seed=config.seed)
+        self._env_steps = 0
+        self._updates = 0
+        self._iter = 0
+        self._key = jax.random.PRNGKey(config.seed + 1)
+
+    def train(self) -> Dict[str, Any]:
+        c = self.config
+        t0 = time.perf_counter()
+        frags = ray_tpu.get([
+            r.sample.remote(self.params, c.rollout_fragment_length)
+            for r in self._runners])
+        new_steps = 0
+        for f in frags:
+            self.buffer.add(f)
+            new_steps += len(f["rewards"])
+        self._env_steps += new_steps
+
+        metrics_acc: Dict[str, list] = {}
+        min_steps = c.batch_size * c.batch_length
+        if len(self.buffer) >= min_steps:
+            # hold the replayed-steps : env-steps ratio at train_ratio
+            target_updates = (self._env_steps * c.train_ratio) \
+                // (c.batch_size * c.batch_length)
+            n = int(np.clip(target_updates - self._updates, 1, 16))
+            for _ in range(n):
+                batch_np = self.buffer.sample(c.batch_size, c.batch_length)
+                batch = {k: jnp.asarray(v) for k, v in batch_np.items()}
+                self._key, sub = jax.random.split(self._key)
+                (self.params, self.critic_target, self.opts,
+                 self.retnorm, m) = _update(
+                    c, self.params, self.critic_target, self.opts,
+                    self.retnorm, batch, sub)
+                self._updates += 1
+                for k, v in m.items():
+                    metrics_acc.setdefault(k, []).append(float(v))
+
+        runner_metrics = ray_tpu.get(
+            [r.get_metrics.remote() for r in self._runners])
+        returns = [x for m in runner_metrics for x in m["episode_returns"]]
+        self._iter += 1
+        out: Dict[str, Any] = {
+            "training_iteration": self._iter,
+            "env_steps_sampled": self._env_steps,
+            "num_updates": self._updates,
+            "episode_return_mean": (float(np.mean(returns))
+                                    if returns else None),
+            "buffer_size": len(self.buffer),
+            "time_this_iter_s": time.perf_counter() - t0,
+        }
+        out.update({k: float(np.mean(v))
+                    for k, v in metrics_acc.items()})
+        return out
+
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            pickle.dump({"params": self.params,
+                         "critic_target": self.critic_target,
+                         "opts": self.opts, "retnorm": self.retnorm,
+                         "env_steps": self._env_steps,
+                         "updates": self._updates, "iter": self._iter}, f)
+
+    def restore(self, path: str) -> None:
+        with open(path, "rb") as f:
+            st = pickle.load(f)
+        self.params = st["params"]
+        self.critic_target = st["critic_target"]
+        self.opts, self.retnorm = st["opts"], st["retnorm"]
+        self._env_steps = st["env_steps"]
+        self._updates, self._iter = st["updates"], st["iter"]
+
+    def stop(self) -> None:
+        for r in self._runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

@@ -1,0 +1,109 @@
+"""DreamerV3: unit math + end-to-end learning on a world-model-learnable env.
+
+Mirrors the reference's algorithm tests
+(/root/reference/rllib/algorithms/dreamerv3/tests/test_dreamerv3.py): a
+small-scale training run asserting learning progress, plus exact checks on
+the pieces that are pure math (symlog, lambda-returns).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib.dreamerv3 import (
+    DreamerV3Config,
+    lambda_returns,
+    symexp,
+    symlog,
+)
+from ray_tpu.rllib.examples import OneHotBanditEnv
+
+
+def test_symlog_roundtrip():
+    import jax.numpy as jnp
+
+    x = jnp.asarray([-100.0, -1.0, 0.0, 0.5, 10.0, 1e4])
+    np.testing.assert_allclose(np.asarray(symexp(symlog(x))), np.asarray(x),
+                               rtol=1e-4)
+
+
+def test_lambda_returns_math():
+    """Hand-computed 3-step recursion, gamma=0.9, lam=0.8."""
+    import jax.numpy as jnp
+
+    r = jnp.asarray([[1.0], [2.0], [3.0]])
+    c = jnp.ones((3, 1))
+    v = jnp.asarray([[10.0], [20.0], [30.0]])
+    boot = jnp.asarray([40.0])
+    got = np.asarray(lambda_returns(r, c, v, boot, 0.9, 0.8))[:, 0]
+    # backwards: R2 = 3 + .9*((1-.8)*40 + .8*40) = 3 + 36 = 39
+    # R1 = 2 + .9*((1-.8)*30 + .8*39) = 2 + .9*(6+31.2) = 35.48
+    # R0 = 1 + .9*((1-.8)*20 + .8*35.48) = 1 + .9*(4+28.384) = 30.1456
+    np.testing.assert_allclose(got, [30.1456, 35.48, 39.0], rtol=1e-5)
+
+
+def test_config_is_jit_static():
+    """The config doubles as a jit static arg (identity hash)."""
+    cfg = DreamerV3Config()
+    assert hash(cfg) == hash(cfg)
+    d = {cfg: 1}
+    assert d[cfg] == 1
+
+
+def test_dreamer_learns_onehot_bandit(ray_cluster):
+    """World model learns reward(obs, action); imagination teaches the
+    actor to exploit it.  Random play scores ~4/16 per episode."""
+    cfg = DreamerV3Config(
+        env=OneHotBanditEnv, num_env_runners=1,
+        rollout_fragment_length=68,  # 4 episodes incl. boundary rows
+        batch_size=8, batch_length=16, train_ratio=48,
+        deter=128, hidden=128, model_lr=3e-3,  # capacity that cracks the
+        horizon=6, gamma=0.95, entropy_scale=0.03,  # reward XOR (see probe
+        seed=0)                                     # history in git log)
+    algo = cfg.build()
+    try:
+        best = 0.0
+        wm_first = wm_last = None
+        for i in range(80):
+            result = algo.train()
+            if result.get("wm_loss") is not None:
+                if wm_first is None:
+                    wm_first = result["wm_loss"]
+                wm_last = result["wm_loss"]
+            if result["episode_return_mean"] is not None:
+                best = max(best, result["episode_return_mean"])
+            if best >= 10.0:
+                break
+        assert best >= 10.0, f"best episode return {best} < 10 (random ~4)"
+        assert wm_first is not None and wm_last < wm_first, (
+            f"world-model loss did not decrease: {wm_first} -> {wm_last}")
+    finally:
+        algo.stop()
+
+
+def test_dreamer_checkpoint_roundtrip(ray_cluster, tmp_path):
+    cfg = DreamerV3Config(env=OneHotBanditEnv, num_env_runners=1,
+                          rollout_fragment_length=34, batch_size=4,
+                          batch_length=8, horizon=4, seed=1)
+    algo = cfg.build()
+    try:
+        algo.train()
+        path = str(tmp_path / "ckpt.pkl")
+        algo.save(path)
+        steps = algo._env_steps
+        algo2 = DreamerV3Config(env=OneHotBanditEnv, num_env_runners=1,
+                                rollout_fragment_length=34, batch_size=4,
+                                batch_length=8, horizon=4, seed=2).build()
+        try:
+            algo2.restore(path)
+            assert algo2._env_steps == steps
+            import jax
+
+            leaves1 = jax.tree.leaves(algo.params)
+            leaves2 = jax.tree.leaves(algo2.params)
+            for a, b in zip(leaves1, leaves2):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        finally:
+            algo2.stop()
+    finally:
+        algo.stop()
