@@ -377,6 +377,9 @@ class Dataset:
     def write_csv(self, path: str, **kw) -> None:
         self._write(path, "csv", **kw)
 
+    def write_tfrecords(self, path: str, **kw) -> None:
+        self._write(path, "tfrecords", **kw)
+
     def write_json(self, path: str, **kw) -> None:
         self._write(path, "json", **kw)
 

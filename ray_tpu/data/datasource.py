@@ -270,6 +270,9 @@ def make_write_fn(path: str, fmt: str, write_kwargs: Optional[dict] = None):
 
                     for row in b.to_pylist():
                         fh.write(json_mod.dumps(row, default=str) + "\n")
+            elif fmt == "tfrecords":
+                out = os.path.join(path, name + ".tfrecords")
+                write_tfrecord_file(b.to_pylist(), out)
             else:
                 raise ValueError(f"unknown write format {fmt!r}")
             yield pa.table({"path": [out], "num_rows": [b.num_rows]})
@@ -421,3 +424,273 @@ def sql_tasks(sql: str, connection_factory: Callable[[], Any],
             conn.close()
 
     return [read]
+
+
+# -- avro --------------------------------------------------------------------
+
+class _AvroDecoder:
+    """Minimal Avro binary decoder (spec: container file + core types).
+    reference: _internal/datasource/avro_datasource.py delegates to the
+    `fastavro` wheel; this image has none, so the codec is implemented
+    directly — null/deflate codecs, all core schema types, named-type
+    references.  Logical types decode as their base type."""
+
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def read(self, n: int) -> bytes:
+        b = self.buf[self.pos:self.pos + n]
+        if len(b) < n:
+            raise EOFError("truncated avro data")
+        self.pos += n
+        return b
+
+    def long(self) -> int:
+        shift = 0
+        acc = 0
+        while True:
+            if self.pos >= len(self.buf):
+                raise EOFError("truncated avro data")
+            b = self.buf[self.pos]
+            self.pos += 1
+            acc |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        return (acc >> 1) ^ -(acc & 1)  # zigzag
+
+    def decode(self, schema, names: Dict[str, Any]):
+        import struct as _struct
+
+        if isinstance(schema, list):  # union
+            return self.decode(schema[self.long()], names)
+        if isinstance(schema, dict):
+            t = schema["type"]
+            if t == "record":
+                return {f["name"]: self.decode(f["type"], names)
+                        for f in schema["fields"]}
+            if t == "enum":
+                return schema["symbols"][self.long()]
+            if t == "array":
+                out = []
+                while True:
+                    n = self.long()
+                    if n == 0:
+                        break
+                    if n < 0:
+                        n = -n
+                        self.long()  # block byte size, unused
+                    out.extend(self.decode(schema["items"], names)
+                               for _ in range(n))
+                return out
+            if t == "map":
+                out = {}
+                while True:
+                    n = self.long()
+                    if n == 0:
+                        break
+                    if n < 0:
+                        n = -n
+                        self.long()
+                    for _ in range(n):
+                        k = self.read(self.long()).decode()
+                        out[k] = self.decode(schema["values"], names)
+                return out
+            if t == "fixed":
+                return self.read(schema["size"])
+            return self.decode(t, names)  # {"type": "string", ...} wrapper
+        if schema == "null":
+            return None
+        if schema == "boolean":
+            return self.read(1) != b"\x00"
+        if schema in ("int", "long"):
+            return self.long()
+        if schema == "float":
+            return _struct.unpack("<f", self.read(4))[0]
+        if schema == "double":
+            return _struct.unpack("<d", self.read(8))[0]
+        if schema == "bytes":
+            return self.read(self.long())
+        if schema == "string":
+            return self.read(self.long()).decode()
+        if schema in names:  # named-type reference
+            return self.decode(names[schema], names)
+        raise ValueError(f"unsupported avro schema {schema!r}")
+
+
+def _collect_named(schema, names: Dict[str, Any], namespace: str = ""):
+    """Register record/enum/fixed types under BOTH short name and fullname
+    (avro spec: a name in a namespaced schema may be referenced either
+    way; nested names inherit the enclosing namespace)."""
+    if isinstance(schema, dict):
+        ns = schema.get("namespace", namespace)
+        if schema.get("type") in ("record", "enum", "fixed"):
+            name = schema["name"]
+            names[name] = schema
+            if "." in name:  # name given as fullname
+                ns, _, short = name.rpartition(".")
+                names[short] = schema
+            elif ns:
+                names[f"{ns}.{name}"] = schema
+        for f in schema.get("fields", []):
+            _collect_named(f["type"], names, ns)
+        for k in ("items", "values"):
+            if k in schema:
+                _collect_named(schema[k], names, ns)
+    elif isinstance(schema, list):
+        for s in schema:
+            _collect_named(s, names, namespace)
+
+
+def avro_tasks(paths, parallelism: int) -> List[Callable]:
+    """Avro Object Container Files → rows (one per record)."""
+    files = expand_paths(paths, [".avro"])
+
+    def read_file(f: str) -> Iterator[Block]:
+        import json as json_mod
+        import zlib
+
+        with open(f, "rb") as fh:
+            data = fh.read()
+        if data[:4] != b"Obj\x01":
+            raise ValueError(f"{f}: not an avro container file")
+        d = _AvroDecoder(data)
+        d.pos = 4
+        meta: Dict[str, bytes] = {}
+        while True:
+            n = d.long()
+            if n == 0:
+                break
+            if n < 0:
+                n = -n
+                d.long()
+            for _ in range(n):
+                k = d.read(d.long()).decode()
+                meta[k] = d.read(d.long())
+        schema = json_mod.loads(meta["avro.schema"])
+        codec = meta.get("avro.codec", b"null").decode()
+        names: Dict[str, Any] = {}
+        _collect_named(schema, names)
+        sync = d.read(16)
+        while d.pos < len(d.buf):
+            count = d.long()
+            size = d.long()
+            payload = d.read(size)
+            if codec == "deflate":
+                payload = zlib.decompress(payload, -15)
+            elif codec != "null":
+                raise ValueError(f"unsupported avro codec {codec!r}")
+            bd = _AvroDecoder(payload)
+            rows = [bd.decode(schema, names) for _ in range(count)]
+            if rows and not isinstance(rows[0], dict):
+                rows = [{"value": r} for r in rows]  # non-record schema
+            if rows:
+                yield block_mod.from_rows(rows)
+            if d.read(16) != sync:
+                raise ValueError(f"{f}: sync marker mismatch")
+
+    return _file_tasks(files, parallelism, read_file)
+
+
+# -- torch / tf ingestion ----------------------------------------------------
+
+def torch_tasks(torch_dataset, parallelism: int) -> List[Callable]:
+    """reference: read_api.py from_torch (:3334) — map-style datasets are
+    index-sharded across tasks; iterable datasets read in one task."""
+    if hasattr(torch_dataset, "__len__") and hasattr(torch_dataset,
+                                                     "__getitem__"):
+        indices = list(range(len(torch_dataset)))
+
+        def make(idx_group):
+            def read() -> Iterator[Block]:
+                rows = [{"item": torch_dataset[i]} for i in idx_group]
+                if rows:
+                    yield block_mod.from_rows(rows)
+            return read
+
+        return [make(g) for g in _chunk(indices, parallelism)]
+
+    def read_iterable() -> Iterator[Block]:
+        rows = []
+        for item in torch_dataset:
+            rows.append({"item": item})
+            if len(rows) >= 4096:
+                yield block_mod.from_rows(rows)
+                rows = []
+        if rows:
+            yield block_mod.from_rows(rows)
+
+    return [read_iterable]
+
+
+# -- tfrecord writing --------------------------------------------------------
+
+_CRC32C_TABLE: Optional[List[int]] = None
+
+
+def _crc32c(data: bytes) -> int:
+    """CRC-32C (Castagnoli), table-driven — the checksum TFRecord framing
+    requires (reference relies on crc32c via tf; pure python here)."""
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        poly = 0x82F63B78
+        table = []
+        for i in range(256):
+            crc = i
+            for _ in range(8):
+                crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+            table.append(crc)
+        _CRC32C_TABLE = table
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC32C_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def _row_to_example_bytes(row: Dict[str, Any]) -> bytes:
+    """Encode one row as a tf.train.Example (tensorflow is baked in)."""
+    import numpy as np
+    import tensorflow as tf
+
+    feats = {}
+    for k, v in row.items():
+        vals = v if isinstance(v, (list, np.ndarray)) else [v]
+        if any(x is None for x in vals):
+            raise ValueError(
+                f"write_tfrecords: column {k!r} contains a null; "
+                f"tf.train.Example has no null representation — drop or "
+                f"impute the column first (e.g. SimpleImputer)")
+        first = vals[0] if len(vals) else 0
+        if isinstance(first, (bytes, str)):
+            bs = [x.encode() if isinstance(x, str) else bytes(x)
+                  for x in vals]
+            feats[k] = tf.train.Feature(
+                bytes_list=tf.train.BytesList(value=bs))
+        elif isinstance(first, (int, np.integer)):
+            feats[k] = tf.train.Feature(
+                int64_list=tf.train.Int64List(value=[int(x) for x in vals]))
+        else:
+            feats[k] = tf.train.Feature(
+                float_list=tf.train.FloatList(
+                    value=[float(x) for x in vals]))
+    ex = tf.train.Example(features=tf.train.Features(feature=feats))
+    return ex.SerializeToString()
+
+
+def write_tfrecord_file(rows: List[Dict[str, Any]], out: str) -> None:
+    import struct as _struct
+
+    with open(out, "wb") as fh:
+        for row in rows:
+            payload = _row_to_example_bytes(row)
+            length = _struct.pack("<Q", len(payload))
+            fh.write(length)
+            fh.write(_struct.pack("<I", _masked_crc(length)))
+            fh.write(payload)
+            fh.write(_struct.pack("<I", _masked_crc(payload)))

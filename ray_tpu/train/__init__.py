@@ -28,6 +28,7 @@ from ray_tpu.train.context import (
     report,
 )
 from ray_tpu.train.controller import Result, TrainController, TrainingFailedError
+from ray_tpu.train.gbdt import LightGBMTrainer, XGBoostTrainer
 from ray_tpu.train.torch import TorchConfig, TorchTrainer
 from ray_tpu.train.step import (
     create_train_state,
@@ -39,8 +40,10 @@ from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
 from ray_tpu.train.worker_group import TrainWorker, WorkerGroup
 
 __all__ = [
+    "LightGBMTrainer",
     "TorchConfig",
     "TorchTrainer",
+    "XGBoostTrainer",
     "Checkpoint", "CheckpointConfig", "CheckpointManager", "DataParallelTrainer",
     "FailureConfig", "JaxTrainer", "Result", "RunConfig", "ScalingConfig",
     "TrainContext", "TrainController", "TrainWorker", "TrainingFailedError",
