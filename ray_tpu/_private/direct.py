@@ -196,11 +196,16 @@ _MEMSTORE_MAX_BYTES = int(os.environ.get("RTPU_MEMSTORE_BYTES", 256 << 20))
 
 
 class _Entry:
-    __slots__ = ("event", "payload", "in_store", "promoted", "escaped",
-                 "orphaned")
+    __slots__ = ("done", "event", "payload", "in_store", "promoted",
+                 "escaped", "orphaned")
 
     def __init__(self):
-        self.event = threading.Event()
+        # ``done`` is the fulfillment flag; the Event is created LAZILY by
+        # the first waiter (memstore.wait_done).  Most direct-call replies
+        # land before anyone blocks, so the common path never pays for an
+        # Event+Condition+lock allocation.
+        self.done = False
+        self.event: Optional[threading.Event] = None
         self.payload: Optional[bytes] = None  # store-format payload
         self.in_store = False  # result went to the shm store instead
         self.promoted = False  # payload was copied to the shm store too
@@ -244,14 +249,16 @@ class MemoryStore:
                 # no expect()ed entry: the last local ref was dropped
                 # (fire-and-forget call) — nobody can ever read this
                 return
-            if e.event.is_set():
+            if e.done:
                 return  # first fulfillment wins (retried call)
             e.payload = payload
             self._bytes += len(payload)
             escaped = e.escaped and not e.promoted
             if escaped:
                 e.promoted = True
-            e.event.set()
+            e.done = True
+            if e.event is not None:
+                e.event.set()
             if e.orphaned:
                 # all local refs died mid-flight; the entry only survived
                 # for its promotion duty — drop it now
@@ -281,7 +288,7 @@ class MemoryStore:
             e = self._entries.get(oid)
             if e is None or e.in_store or e.promoted:
                 return None
-            if not e.event.is_set():
+            if not e.done:
                 e.escaped = True
                 return None
             e.promoted = True
@@ -292,9 +299,11 @@ class MemoryStore:
             e = self._entries.get(oid)
             if e is None:
                 return  # last local ref dropped; store copy stands alone
-            if not e.event.is_set():
+            if not e.done:
                 e.in_store = True
-                e.event.set()
+                e.done = True
+                if e.event is not None:
+                    e.event.set()
             if e.orphaned:
                 self._entries.pop(oid, None)
 
@@ -306,7 +315,7 @@ class MemoryStore:
                or self._bytes > _MEMSTORE_MAX_BYTES):
             victim = None
             for oid, e in self._entries.items():
-                if e.event.is_set():
+                if e.done:
                     victim = (oid, e)
                     break
             if victim is None:
@@ -318,6 +327,18 @@ class MemoryStore:
                 evict.append((oid, e.payload))
         return evict
 
+    def wait_done(self, e: _Entry, timeout: Optional[float]) -> bool:
+        """Block until the entry fulfills; creates its Event on demand."""
+        if e.done:
+            return True
+        with self._lock:
+            if e.done:
+                return True
+            if e.event is None:
+                e.event = threading.Event()
+            ev = e.event
+        return ev.wait(timeout)
+
     def lookup(self, oid: bytes) -> Optional[_Entry]:
         with self._lock:
             e = self._entries.get(oid)
@@ -328,7 +349,7 @@ class MemoryStore:
     def contains_value(self, oid: bytes) -> bool:
         """True if a payload is present RIGHT NOW (for wait())."""
         e = self.lookup(oid)
-        return e is not None and e.event.is_set() and not e.in_store
+        return e is not None and e.done and not e.in_store
 
     def discard(self, oid: bytes) -> None:
         """Last local ref died.  A pending ESCAPED entry is kept (marked
@@ -338,7 +359,7 @@ class MemoryStore:
             e = self._entries.get(oid)
             if e is None:
                 return
-            if not e.event.is_set() and e.escaped:
+            if not e.done and e.escaped:
                 e.orphaned = True
                 return
             self._entries.pop(oid, None)
@@ -392,6 +413,10 @@ class _ChannelBase:
 
     def _reconnect_resend(self) -> bool:
         raise NotImplementedError
+
+    def flush(self) -> None:
+        """Push any coalesced frames to the wire (no-op transports that
+        send eagerly override nothing)."""
 
     def _on_broken(self, epoch: int):
         """Transport lost (EOF, reset, or injected chaos): repair in
@@ -509,6 +534,7 @@ class _NativeChannel(_ChannelBase):
                          name="direct-drain", daemon=True).start()
 
     def call(self, spec) -> bool:
+        buffered = False
         with self._lock:
             if self.dead:
                 return False
@@ -516,22 +542,45 @@ class _NativeChannel(_ChannelBase):
             for oid in spec.return_ids:
                 self._client.memstore.expect(oid)
             try:
-                self._ch.submit(pack_call_frame(spec))
+                if len(self._outstanding) == 1:
+                    # Nothing else in flight: a sync caller is about to
+                    # block on this very result — send now (drains any
+                    # buffered frames first, so order holds).
+                    self._ch.submit(pack_call_frame(spec))
+                else:
+                    # Fan-out burst: coalesce with no syscall.  The frames
+                    # go out on the next flush — the caller's own get/wait
+                    # (worker.py flushes before blocking), the client's
+                    # safety flusher (~1ms), or the 256KB channel cap.
+                    self._ch.submit_buffered(pack_call_frame(spec))
+                    buffered = True
             except Exception:
                 pass  # drain thread observes the dead channel and repairs
-            return True
+        if buffered:
+            self._client._mark_dirty(self)
+        return True
+
+    def flush(self) -> None:
+        try:
+            self._ch.flush()
+        except Exception:
+            pass  # broken transport: the drain thread repairs
 
     def _drain_loop(self, ch, epoch: int):
+        deliver = self._deliver
         while True:
             try:
-                item = ch.recv_reply(30000)
+                items = ch.recv_replies(30000)
             except ConnectionError:
                 self._on_broken(epoch)
                 return
-            if item is None:
+            if items is None:
                 continue  # idle wakeup
-            tid, flags, payload = item
-            self._deliver(tid, bool(flags & REPLY_IN_STORE), payload)
+            for item in items:
+                if item is None:
+                    continue  # malformed reply frame: skip
+                tid, flags, payload = item
+                deliver(tid, bool(flags & REPLY_IN_STORE), payload)
 
     def _reconnect_resend(self) -> bool:
         fresh = self._connect()
@@ -554,6 +603,43 @@ class DirectClient:
         self._channels: dict[bytes, _Channel] = {}
         self._addr_cache: dict[bytes, tuple[float, str, Optional[str]]] = {}
         self._lock = threading.Lock()
+        # Channels holding coalesced (unsent) frames.  flush_all() runs
+        # before any blocking wait; the safety flusher bounds the latency
+        # of fire-and-forget calls that are never followed by a get.
+        self._dirty: set = set()
+        self._dirty_evt = threading.Event()
+        self._flusher_started = False
+
+    def _mark_dirty(self, chan) -> None:
+        dirty = self._dirty
+        if chan in dirty:
+            return  # burst on one channel: first mark armed the flusher
+        dirty.add(chan)
+        if not self._flusher_started:
+            with self._lock:
+                if not self._flusher_started:
+                    self._flusher_started = True
+                    threading.Thread(target=self._flush_loop,
+                                     name="direct-flush", daemon=True
+                                     ).start()
+        self._dirty_evt.set()
+
+    def flush_all(self) -> None:
+        while self._dirty:
+            try:
+                chan = self._dirty.pop()
+            except KeyError:
+                break
+            chan.flush()
+
+    def _flush_loop(self) -> None:
+        while True:
+            self._dirty_evt.wait()
+            self._dirty_evt.clear()
+            # let the submitting burst finish; its own get usually flushes
+            # first and this pass finds nothing
+            time.sleep(0.001)
+            self.flush_all()
 
     def resolve(self, actor_id: bytes,
                 use_cache: bool = True) -> tuple[Optional[str], Optional[str]]:

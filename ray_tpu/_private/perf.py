@@ -101,7 +101,8 @@ def main():
         "n:n actor calls async (4 actors, batch 200)",
         lambda: ray_tpu.get([b.ping.remote() for b in actors
                              for _ in range(50)]),
-        multiplier=200))
+        multiplier=200, reps=6))  # 5 runnable procs: noisiest metric on a
+    # shared VM — more windows for an honest best
 
     conc = SinkCls.options(max_concurrency=8).remote()
     ray_tpu.get(conc.ping.remote())
@@ -128,6 +129,42 @@ def main():
     results.append(timeit("single client get <- plasma (10MB)",
                           lambda: ray_tpu.get(consume.remote(ref))))
 
+    # Multi-client puts (reference rows: "multi client put calls/s" with
+    # 1KB and "multi client put gigabytes" with 10MB, ray_perf.py): N
+    # worker processes hammer the one shm store daemon concurrently.
+    class PutClient:
+        def do_puts(self, n: int, size: int) -> float:
+            import numpy as _np
+            import time as _t
+
+            import ray_tpu as _rt
+
+            data = _np.zeros(size, _np.uint8)
+            t0 = _t.perf_counter()
+            for _ in range(n):
+                _rt.put(data)  # ref drops immediately (owner-delete path)
+            return n / (_t.perf_counter() - t0)
+
+    PutCls = ray_tpu.remote(PutClient)
+    putters = [PutCls.remote() for _ in range(4)]
+    ray_tpu.get([p.do_puts.remote(2, 1024) for p in putters])
+    _settle_pool()
+    for label, n, size in (("multi client put (1KB, 4 clients)", 200, 1024),
+                           ("multi client put (10MB, 4 clients)", 10,
+                            10 * 1024 * 1024)):
+        best = 0.0
+        for _ in range(3):
+            rates = ray_tpu.get(
+                [p.do_puts.remote(n, size) for p in putters])
+            best = max(best, sum(rates))
+        print(f"{label:48s} {best:12.1f} /s")
+        results.append({"name": label, "rate_per_s": best})
+        if size >= 1 << 20:
+            print(f"{'  -> aggregate put bandwidth':48s} "
+                  f"{best * size / (1 << 30):12.2f} GB/s")
+    for p in putters:
+        ray_tpu.kill(p)
+
     summary = {r["name"]: round(r["rate_per_s"], 1) for r in results}
     print(json.dumps({"microbenchmark": summary}))
 
@@ -138,6 +175,10 @@ def main():
         "1:1 actor calls sync": 2020.0,
         "1:1 actor calls async (batch 50)": 7484.0,
         "n:n actor calls async (4 actors, batch 200)": 27465.0,
+        "multi client put (1KB, 4 clients)": 15797.0,
+        # 39.9 GB/s over 10MB objects (microbenchmark.json
+        # "multi client put gigabytes")
+        "multi client put (10MB, 4 clients)": 39.9 * 1024 / 10,
     }
     record = {
         "results_per_s": summary,

@@ -12,6 +12,7 @@ the current global context, so user code behaves identically in both.
 from __future__ import annotations
 
 import os
+import struct
 import threading
 import time
 from typing import Callable, Optional
@@ -25,6 +26,7 @@ from ray_tpu.core.store_client import ObjectEvictedError, StoreClient
 from ray_tpu.exceptions import GetTimeoutError, ObjectLostError
 
 _GET_CHUNK_MS = 500  # blocking-get slice so Ctrl-C stays responsive
+_EAGER_DELETE_MIN = int(os.environ.get("RTPU_EAGER_DELETE_MIN", 64 * 1024))
 
 
 class WorkerContext:
@@ -98,6 +100,17 @@ class WorkerContext:
         # promoted) — small direct-call results don't pile garbage into
         # the shm store.
         self._ref_counts: dict[bytes, int] = {}
+        # oids this process put() locally whose refs NEVER left it: when
+        # the last local ref dies the object is unreachable cluster-wide,
+        # so delete it from the shm store immediately instead of letting
+        # it rot until LRU eviction — which would SPILL dead bytes to disk
+        # (reference semantics: the owner's ref count going to zero frees
+        # the primary copy, reference_count.cc).  Escaped refs leave the
+        # set and fall back to eviction.  Only objects >= the threshold
+        # delete eagerly: the delete is a store round-trip, which would
+        # dominate small-put throughput, and a small dead object costs
+        # little to carry until LRU.
+        self._owned_puts: dict[bytes, int] = {}
         # RLock: __del__ hooks can fire via GC while this thread is inside
         # _on_ref_created holding the lock.
         self._ref_lock = threading.RLock()
@@ -115,9 +128,15 @@ class WorkerContext:
                 self._ref_counts[oid] = n
                 return
             self._ref_counts.pop(oid, None)
+            owned = self._owned_puts.pop(oid, 0) >= _EAGER_DELETE_MIN
         ms = self.memstore
         if ms is not None:
             ms.discard(oid)
+        if owned:
+            try:
+                self.store.delete(oid)
+            except Exception:
+                pass  # interpreter shutdown / store already gone
 
     def _promote_payload(self, oid: bytes, payload: bytes) -> None:
         """Copy a memory-store payload into the shm store (so other
@@ -144,6 +163,9 @@ class WorkerContext:
         flagged instead — the delivery path promotes it the moment the
         direct reply lands (another process may already be blocking on the
         shm store for it)."""
+        owned = getattr(self, "_owned_puts", None)
+        if owned is not None:
+            owned.pop(oid, None)  # other processes may now hold refs
         ms = self.memstore
         if ms is None:
             return
@@ -168,6 +190,57 @@ class WorkerContext:
         self._tls.actor_id = value
 
     # -- actor calls --------------------------------------------------------
+    def actor_fastlane(self, actor_id: bytes, method_name: str,
+                       label: str):
+        """A fused per-(actor, method) submit closure for the hot path, or
+        None when this context can't serve one.  Returns-None-per-call
+        means "take the slow path" (channel missing/dead, or a scheduler-
+        path fallback is still draining — the unlocked read of
+        _fallback_pending is exact for the submitting thread itself, which
+        is the ordering the per-caller FIFO guarantee covers).
+
+        Counterpart of the reference's direct actor submit fast path
+        (ActorTaskSubmitter caching the RPC client per handle,
+        core_worker.cc SubmitActorTask): the layers ActorMethod.remote →
+        _submit_method → submit_actor_method → DirectClient.submit →
+        channel.call collapse into one frame over the cached channel."""
+        direct = self._direct
+        if direct is None:
+            return None
+        import pickle as _p
+
+        from ray_tpu._private.direct import _fast_method_spec
+        from ray_tpu.core.object_ref import ObjectRef as _Ref
+
+        channels = direct._channels
+        pending = self._fallback_pending
+        new_task_id = ids.new_task_id
+        dumps = _p.dumps
+        suffix = struct.pack("<I", 0)
+
+        def fast(args, kwargs):
+            if pending.get(actor_id):
+                return None
+            chan = channels.get(actor_id)
+            if chan is None or chan.dead:
+                return None
+            payload = (list(args), dict(kwargs))
+            try:
+                blob = dumps(payload, 5)
+                if b"__main__" in blob:
+                    blob = cloudpickle.dumps(payload)
+            except Exception:
+                blob = cloudpickle.dumps(payload)
+            tid = new_task_id()
+            rid = tid + suffix
+            spec = _fast_method_spec(tid, rid, actor_id, method_name, blob)
+            spec.name = label
+            if not chan.call(spec):
+                return None
+            return _Ref(rid)
+
+        return fast
+
     def submit_actor_method(self, spec) -> None:
         """Submit an actor method: direct push when the actor is ALIVE and
         this caller has no scheduler-path calls still in flight to it
@@ -279,6 +352,8 @@ class WorkerContext:
     def put_object(self, value, oid: Optional[bytes] = None) -> ObjectRef:
         if isinstance(value, ObjectRef):
             raise TypeError("passing an ObjectRef to put is not allowed")
+        track_owned = oid is None and getattr(self, "_owned_puts",
+                                              None) is not None
         oid = oid or ids.random_object_id()
         size, token = serialized_size(value)
         buf = self.store.create(oid, size)
@@ -295,6 +370,9 @@ class WorkerContext:
             raise
         if self._seal_notify is not None:
             self._seal_notify(oid)
+        if track_owned:
+            with self._ref_lock:
+                self._owned_puts[oid] = size
         return ObjectRef(oid)
 
     def get_object(self, ref: ObjectRef, timeout: Optional[float] = None):
@@ -395,15 +473,16 @@ class WorkerContext:
         through when the result went to the shm store."""
         from ray_tpu._private.serialization import deserialize
 
-        if not entry.event.is_set():
+        if not entry.done:
+            self._direct.flush_all()  # coalesced submits go out before we block
             # Short grace before declaring this worker blocked: sub-ms
             # replies (the common case) skip the scheduler notification.
-            if not entry.event.wait(0.005):
+            if not self.memstore.wait_done(entry, 0.005):
                 blocked = self._block_notify is not None
                 if blocked:
                     self._block_notify(True)
                 try:
-                    if not entry.event.wait(timeout):
+                    if not self.memstore.wait_done(entry, timeout):
                         raise GetTimeoutError(
                             f"get timed out after {timeout}s waiting for a "
                             f"direct actor-call result")
@@ -471,6 +550,7 @@ class WorkerContext:
         return self.store.contains(oid)
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        self._direct.flush_all()  # coalesced submits go out before waiting
         pending = list(refs)
         ready: list[ObjectRef] = []
         deadline = None if timeout is None else time.monotonic() + timeout

@@ -26,10 +26,30 @@ class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str):
         self._handle = handle
         self._method_name = method_name
+        self._fast = None  # worker.actor_fastlane closure, installed lazily
 
     def remote(self, *args, **kwargs):
-        return self._handle._submit_method(
+        # Hot path: a fused submit over the cached direct channel
+        # (worker.actor_fastlane).  A None result means "not eligible
+        # right now" (no channel yet, channel dead, scheduler-path calls
+        # draining) — drop to the full path, which handles every case,
+        # and re-install on the next call in case the worker changed.
+        fast = self._fast
+        if fast is not None:
+            ref = fast(args, kwargs)
+            if ref is not None:
+                return ref
+            self._fast = None
+        ref = self._handle._submit_method(
             self._method_name, args, kwargs, num_returns=1)
+        if self._fast is None:
+            make = getattr(global_worker(), "actor_fastlane", None)
+            if make is not None:
+                h = self._handle
+                self._fast = make(
+                    h._actor_id, self._method_name,
+                    f"{h._class_name}.{self._method_name}")
+        return ref
 
     def bind(self, *args, **kwargs):
         """Build a lazy DAG node (reference: python/ray/dag class_node)."""

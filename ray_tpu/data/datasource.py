@@ -626,33 +626,6 @@ def torch_tasks(torch_dataset, parallelism: int) -> List[Callable]:
 
 # -- tfrecord writing --------------------------------------------------------
 
-_CRC32C_TABLE: Optional[List[int]] = None
-
-
-def _crc32c(data: bytes) -> int:
-    """CRC-32C (Castagnoli), table-driven — the checksum TFRecord framing
-    requires (reference relies on crc32c via tf; pure python here)."""
-    global _CRC32C_TABLE
-    if _CRC32C_TABLE is None:
-        poly = 0x82F63B78
-        table = []
-        for i in range(256):
-            crc = i
-            for _ in range(8):
-                crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
-            table.append(crc)
-        _CRC32C_TABLE = table
-    crc = 0xFFFFFFFF
-    for b in data:
-        crc = _CRC32C_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
-    return crc ^ 0xFFFFFFFF
-
-
-def _masked_crc(data: bytes) -> int:
-    crc = _crc32c(data)
-    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
-
-
 def _row_to_example_bytes(row: Dict[str, Any]) -> bytes:
     """Encode one row as a tf.train.Example (tensorflow is baked in)."""
     import numpy as np
@@ -684,13 +657,11 @@ def _row_to_example_bytes(row: Dict[str, Any]) -> bytes:
 
 
 def write_tfrecord_file(rows: List[Dict[str, Any]], out: str) -> None:
-    import struct as _struct
+    # tf.io.TFRecordWriter does the framing (length + masked CRC32C) with
+    # native checksums — _row_to_example_bytes already requires tensorflow
+    # for the proto encode, so there is no extra dependency.
+    import tensorflow as tf
 
-    with open(out, "wb") as fh:
+    with tf.io.TFRecordWriter(out) as w:
         for row in rows:
-            payload = _row_to_example_bytes(row)
-            length = _struct.pack("<Q", len(payload))
-            fh.write(length)
-            fh.write(_struct.pack("<I", _masked_crc(length)))
-            fh.write(payload)
-            fh.write(_struct.pack("<I", _masked_crc(payload)))
+            w.write(_row_to_example_bytes(row))

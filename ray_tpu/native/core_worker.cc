@@ -111,8 +111,13 @@ struct ChannelCore {
   int fd = -1;
   std::mutex send_mu;
   std::string in;  // recv accumulation (single reader thread by contract)
+  std::string out;  // submit_buffered coalescing (flushed by flush())
   bool dead = false;
 };
+
+// The coalescing cap: past this, submit_buffered flushes inline so a
+// burst of large frames cannot balloon the buffer.
+constexpr size_t kSubmitBufferCap = 256 * 1024;
 
 typedef struct {
   PyObject_HEAD
@@ -146,12 +151,82 @@ static PyObject* Channel_submit(ChannelObject* self, PyObject* args) {
   Py_buffer frame;
   if (!PyArg_ParseTuple(args, "y*", &frame)) return nullptr;
   ChannelCore* c = self->core;
-  bool ok;
+  bool ok = true;
   Py_BEGIN_ALLOW_THREADS
-  ok = !c->dead && send_frame(c->fd, c->send_mu, (const char*)frame.buf,
-                              size_t(frame.len));
+  {
+    std::lock_guard<std::mutex> g(c->send_mu);
+    if (c->dead) {
+      ok = false;
+    } else {
+      // drain any coalesced frames first: mixing submit_buffered and
+      // submit on one channel must preserve submission order
+      if (!c->out.empty()) {
+        ok = send_all(c->fd, c->out.data(), c->out.size());
+        c->out.clear();
+      }
+      if (ok) {
+        uint32_t len = uint32_t(frame.len);
+        if (size_t(frame.len) <= 65536 - 4) {
+          char buf[65536];
+          memcpy(buf, &len, 4);
+          memcpy(buf + 4, frame.buf, size_t(frame.len));
+          ok = send_all(c->fd, buf, size_t(frame.len) + 4);
+        } else {
+          char hdr[4];
+          memcpy(hdr, &len, 4);
+          ok = send_all(c->fd, hdr, 4) &&
+               send_all(c->fd, (const char*)frame.buf, size_t(frame.len));
+        }
+      }
+    }
+  }
   Py_END_ALLOW_THREADS
   PyBuffer_Release(&frame);
+  return PyBool_FromLong(ok);
+}
+
+// submit_buffered(frame) -> bool: append to the coalescing buffer with NO
+// syscall; a later flush() (or hitting the cap) writes every pending
+// frame in one send.  Halves the per-call syscall budget on the n:n
+// fan-in path (reference batches the same way via gRPC streams).
+static PyObject* Channel_submit_buffered(ChannelObject* self,
+                                         PyObject* args) {
+  Py_buffer frame;
+  if (!PyArg_ParseTuple(args, "y*", &frame)) return nullptr;
+  ChannelCore* c = self->core;
+  bool ok = true;
+  Py_BEGIN_ALLOW_THREADS
+  {
+    std::lock_guard<std::mutex> g(c->send_mu);
+    if (c->dead) {
+      ok = false;
+    } else {
+      uint32_t len = uint32_t(frame.len);
+      c->out.append((const char*)&len, 4);
+      c->out.append((const char*)frame.buf, size_t(frame.len));
+      if (c->out.size() >= kSubmitBufferCap) {
+        ok = send_all(c->fd, c->out.data(), c->out.size());
+        c->out.clear();
+      }
+    }
+  }
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&frame);
+  return PyBool_FromLong(ok);
+}
+
+static PyObject* Channel_flush(ChannelObject* self, PyObject*) {
+  ChannelCore* c = self->core;
+  bool ok = true;
+  Py_BEGIN_ALLOW_THREADS
+  {
+    std::lock_guard<std::mutex> g(c->send_mu);
+    if (!c->out.empty()) {
+      ok = !c->dead && send_all(c->fd, c->out.data(), c->out.size());
+      c->out.clear();
+    }
+  }
+  Py_END_ALLOW_THREADS
   return PyBool_FromLong(ok);
 }
 
@@ -212,6 +287,85 @@ static PyObject* Channel_recv_reply(ChannelObject* self, PyObject* args) {
   Py_RETURN_NONE;
 }
 
+// recv_replies(timeout_ms) -> [(task_id, flags, payload), ...] | None on
+// timeout.  Blocks for the FIRST reply, then drains every further frame
+// already buffered/readable without blocking — one Python call (and one
+// GIL acquisition) per burst instead of per reply.
+static PyObject* Channel_recv_replies(ChannelObject* self, PyObject* args) {
+  long timeout_ms;
+  if (!PyArg_ParseTuple(args, "l", &timeout_ms)) return nullptr;
+  ChannelCore* c = self->core;
+  std::deque<std::string> frames;
+  Py_BEGIN_ALLOW_THREADS
+  bool blocking_done = false;
+  for (;;) {
+    std::string frame;
+    int fr = extract_frame(c->in, &frame);
+    if (fr < 0) {
+      c->dead = true;
+      ::shutdown(c->fd, SHUT_RDWR);
+      break;
+    }
+    if (fr > 0) {
+      if (frame.size() >= 3 && uint8_t(frame[0]) == 0x02)
+        frames.push_back(std::move(frame));
+      continue;
+    }
+    if (c->dead) break;
+    // buffer exhausted: block only while we have nothing to hand back
+    int wait_ms = frames.empty() && !blocking_done ? int(timeout_ms) : 0;
+    struct pollfd pfd{c->fd, POLLIN, 0};
+    int pr = ::poll(&pfd, 1, wait_ms);
+    if (pr == 0) {
+      if (wait_ms != 0) blocking_done = true;
+      break;
+    }
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      c->dead = true;
+      break;
+    }
+    char buf[1 << 16];
+    ssize_t k = ::recv(c->fd, buf, sizeof buf, 0);
+    if (k <= 0) {
+      if (k < 0 && errno == EINTR) continue;
+      c->dead = true;
+      break;
+    }
+    c->in.append(buf, size_t(k));
+  }
+  Py_END_ALLOW_THREADS
+  if (!frames.empty()) {
+    PyObject* list = PyList_New(Py_ssize_t(frames.size()));
+    if (!list) return nullptr;
+    Py_ssize_t i = 0;
+    for (const std::string& frame : frames) {
+      uint8_t tl = uint8_t(frame[1]);
+      PyObject* item;
+      if (frame.size() < size_t(2 + tl + 1)) {
+        item = Py_None;
+        Py_INCREF(item);
+      } else {
+        uint8_t flags = uint8_t(frame[2 + tl]);
+        item = Py_BuildValue("(y#iy#)", frame.data() + 2, Py_ssize_t(tl),
+                             int(flags), frame.data() + 2 + tl + 1,
+                             Py_ssize_t(frame.size() - 2 - tl - 1));
+        if (!item) {
+          Py_DECREF(list);
+          return nullptr;
+        }
+      }
+      PyList_SET_ITEM(list, i++, item);
+    }
+    return list;
+  }
+  if (c->dead) {
+    PyErr_SetString(PyExc_ConnectionError, "direct channel lost");
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
 static PyObject* Channel_is_dead(ChannelObject* self, PyObject*) {
   return PyBool_FromLong(self->core->dead);
 }
@@ -225,9 +379,16 @@ static PyObject* Channel_close(ChannelObject* self, PyObject*) {
 static PyMethodDef Channel_methods[] = {
     {"submit", (PyCFunction)Channel_submit, METH_VARARGS,
      "submit(frame) -> bool (False when the connection is gone)"},
+    {"submit_buffered", (PyCFunction)Channel_submit_buffered, METH_VARARGS,
+     "submit_buffered(frame) -> bool (no syscall until flush/cap)"},
+    {"flush", (PyCFunction)Channel_flush, METH_NOARGS,
+     "flush() -> bool: one send for every buffered frame"},
     {"recv_reply", (PyCFunction)Channel_recv_reply, METH_VARARGS,
      "recv_reply(timeout_ms) -> (task_id, flags, payload) | None; raises "
      "ConnectionError when the channel is dead"},
+    {"recv_replies", (PyCFunction)Channel_recv_replies, METH_VARARGS,
+     "recv_replies(timeout_ms) -> list of replies | None; drains the "
+     "whole readable burst per call"},
     {"is_dead", (PyCFunction)Channel_is_dead, METH_NOARGS, ""},
     {"close", (PyCFunction)Channel_close, METH_NOARGS, ""},
     {nullptr, nullptr, 0, nullptr}};

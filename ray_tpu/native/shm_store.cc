@@ -85,6 +85,10 @@ struct ObjectEntry {
   uint64_t size = 0;
   bool sealed = false;
   int refcount = 0;  // pinned while > 0 (creator or active getters)
+  // Delete() arrived while pinned: the extent is freed on the LAST
+  // Release instead — freeing under an active zero-copy Get view would
+  // let the next Create scribble over live reader memory.
+  bool delete_pending = false;
   std::list<ObjectId>::iterator lru_it;
   bool in_lru = false;
 };
@@ -235,6 +239,17 @@ class Store {
     // (no DropSpilled here: an id is never resident AND spilled at once)
     auto it = objects_.find(id);
     if (it == objects_.end()) return ST_NOT_FOUND;
+    if (it->second.refcount > 0) {
+      // pinned by an active getter's zero-copy view: defer the free to
+      // the last Release (the id is tombstoned NOW so new Gets miss)
+      it->second.delete_pending = true;
+      if (it->second.in_lru) {
+        lru_.erase(it->second.lru_it);
+        it->second.in_lru = false;
+      }
+      RecordEvictedLocked(id);
+      return ST_OK;
+    }
     if (it->second.in_lru) lru_.erase(it->second.lru_it);
     alloc_.Free(it->second.offset);
     objects_.erase(it);
@@ -279,6 +294,11 @@ class Store {
  private:
   void DecrefLocked(ObjectEntry& e, const ObjectId& id) {
     if (e.refcount > 0) e.refcount--;
+    if (e.refcount == 0 && e.delete_pending) {
+      alloc_.Free(e.offset);
+      objects_.erase(id);  // e is dangling after this — return at once
+      return;
+    }
     if (e.refcount == 0 && e.sealed && !e.in_lru) {
       lru_.push_back(id);
       e.lru_it = std::prev(lru_.end());

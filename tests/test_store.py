@@ -141,3 +141,39 @@ def test_get_evicted_raises(store):
     store.put(oid, b"retry")
     assert bytes(store.get(oid, 100)) == b"retry"
     store.release(oid)
+
+
+def test_delete_defers_while_pinned(store):
+    """Delete during an active zero-copy Get view must not free the
+    extent under the reader: the view's bytes stay intact and the free
+    happens at the last release (round-3 owner-delete path)."""
+    import numpy as np
+
+    oid = b"P" * 28
+    data = np.full(256 * 1024, 7, np.uint8)
+    buf = store.create(oid, data.nbytes)
+    buf[:] = data.data
+    buf.release()
+    store.seal(oid)
+    view = store.get(oid, 0)          # pins the extent
+    assert view is not None
+    store.delete(oid)                 # arrives while pinned: deferred
+    # new gets see a tombstone, not the live object
+    import pytest as _pytest
+
+    from ray_tpu.core.store_client import ObjectEvictedError
+
+    with _pytest.raises(ObjectEvictedError):
+        store.get(oid, 0)
+    # hammer allocations that would reuse the extent were it freed
+    for i in range(8):
+        o2 = bytes([i]) * 28
+        b2 = store.create(o2, data.nbytes)
+        b2[:] = b"\xff" * data.nbytes
+        b2.release()
+        store.seal(o2)
+        store.delete(o2)
+    assert bytes(view[:16]) == bytes([7] * 16)  # reader unharmed
+    assert np.frombuffer(view, np.uint8).sum() == data.sum()
+    view.release()
+    store.release(oid)                # last release frees the extent
