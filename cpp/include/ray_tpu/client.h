@@ -1,0 +1,139 @@
+// ray_tpu C++ worker API.
+//
+// Counterpart of the reference's C++ worker (/root/reference/cpp/include/
+// ray/api/*.h — ray::Init, ray::Get/Put, actor handles) scaled to this
+// runtime's protocols: the client speaks
+//
+//   * the versioned wire codec (ray_tpu/native/wire.h) to the GCS —
+//     KV, node listing, actor registry — exactly like a Python node;
+//   * the binary direct-call dialect (0x01 call / 0x02 reply frames,
+//     _private/direct.py) to actor workers, with method arguments encoded
+//     as a plain-data pickle the Python side unpickles natively and
+//     results decoded from the store payload format (pickle subset or
+//     raw-array tag).
+//
+// Values cross the boundary as wire::Value (None/bool/int/float/str/
+// bytes/list/dict/tuple) — the plain-data subset.  Tasks defined in C++
+// are out of scope (the runtime executes Python functions); what this API
+// gives a C++ process is full *client* standing: cluster state, KV
+// coordination, and calling into any named Python actor.
+//
+// Build (no extra deps):
+//   g++ -std=c++17 -I<repo>/ray_tpu/native -I<repo>/cpp/include \
+//       <repo>/cpp/src/client.cc your_app.cc -o your_app
+
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "wire.h"
+
+namespace rtpu {
+
+// One framed connection (4-byte LE length prefix), with the cluster-token
+// handshake on TCP addresses ("host:port" or "token@host:port").
+class Connection {
+ public:
+  ~Connection();
+  static std::unique_ptr<Connection> Dial(const std::string& addr,
+                                          const std::string& token = "");
+  bool SendFrame(const std::string& body);
+  // nullopt on EOF/error.
+  std::optional<std::string> RecvFrame();
+  bool ok() const { return fd_ >= 0; }
+
+ private:
+  explicit Connection(int fd) : fd_(fd) {}
+  int fd_ = -1;
+};
+
+struct NodeInfo {
+  std::string node_id;  // raw bytes
+  bool alive = false;
+  bool is_head = false;
+};
+
+struct ActorInfo {
+  std::string actor_id;  // raw bytes
+  std::string state;     // "ALIVE" | ...
+  std::string addr;      // direct-call endpoint ("" until ALIVE)
+  std::string class_name;
+};
+
+// The result of an actor method call.
+struct CallResult {
+  bool ok = false;          // method returned without raising
+  wire::Value value;        // decoded return (plain-data subset)
+  bool in_store = false;    // large result went to the shm store (value
+                            // empty; fetch via a Python peer)
+  bool raw = false;         // payload could not be decoded into the
+                            // subset; bytes kept verbatim in `value`
+  std::string error;        // transport or remote-exception description
+};
+
+// A direct channel to one actor (per-caller FIFO, like any Python caller).
+class ActorHandle {
+ public:
+  ActorHandle(ActorInfo info, std::unique_ptr<Connection> conn)
+      : info_(std::move(info)), conn_(std::move(conn)) {}
+
+  const ActorInfo& info() const { return info_; }
+
+  // Blocking call: pickles `args`, pushes a 0x01 frame, waits for the
+  // matching 0x02 reply (out-of-order replies for earlier in-flight calls
+  // are drained in order — the channel is FIFO).
+  CallResult Call(const std::string& method,
+                  const std::vector<wire::Value>& args);
+
+ private:
+  ActorInfo info_;
+  std::unique_ptr<Connection> conn_;
+  uint64_t seq_ = 0;
+};
+
+class Client {
+ public:
+  // addr: the GCS address (unix path, "host:port", or "token@host:port").
+  static std::unique_ptr<Client> Connect(const std::string& addr);
+
+  // -- KV (GCS kv table, shared with Python ray_tpu) --------------------
+  bool KvPut(const std::string& ns, const std::string& key,
+             const std::string& value);
+  std::optional<std::string> KvGet(const std::string& ns,
+                                   const std::string& key);
+  bool KvDel(const std::string& ns, const std::string& key);
+  std::vector<std::string> KvKeys(const std::string& ns);
+
+  // -- cluster state ----------------------------------------------------
+  std::vector<NodeInfo> ListNodes();
+
+  // -- actors ------------------------------------------------------------
+  std::optional<ActorInfo> GetActorByName(const std::string& name);
+  // Resolve + open a direct channel; nullptr when the actor is not ALIVE.
+  std::unique_ptr<ActorHandle> GetActorHandle(const std::string& name);
+
+  // One wire-codec RPC against the GCS (public: escape hatch for methods
+  // without a typed wrapper).  Throws wire::WireError on protocol errors,
+  // std::runtime_error on a remote error response.
+  wire::Value CallGcs(const std::string& method,
+                      const std::vector<wire::Value>& args);
+
+ private:
+  Client(std::unique_ptr<Connection> conn, std::string token)
+      : conn_(std::move(conn)), token_(std::move(token)) {}
+  std::unique_ptr<Connection> conn_;
+  std::string token_;
+};
+
+// Plain-data pickle codec (exposed for tests).
+// Pickles (list(args), {}) the way actor args travel.
+std::string PickleArgs(const std::vector<wire::Value>& args);
+// Decode a pickle of plain data into the wire::Value subset.  Returns
+// false when the stream uses opcodes outside the subset.
+bool UnpickleValue(const std::string& data, wire::Value* out);
+
+}  // namespace rtpu

@@ -128,7 +128,7 @@ class WorkerContext:
                 self._ref_counts[oid] = n
                 return
             self._ref_counts.pop(oid, None)
-            owned = self._owned_puts.pop(oid, 0) >= _EAGER_DELETE_MIN
+            owned = self._owned_puts.pop(oid, None) is not None
         ms = self.memstore
         if ms is not None:
             ms.discard(oid)
@@ -207,15 +207,13 @@ class WorkerContext:
         direct = self._direct
         if direct is None:
             return None
-        import pickle as _p
-
         from ray_tpu._private.direct import _fast_method_spec
+        from ray_tpu.core.actor import dumps_args
         from ray_tpu.core.object_ref import ObjectRef as _Ref
 
         channels = direct._channels
         pending = self._fallback_pending
         new_task_id = ids.new_task_id
-        dumps = _p.dumps
         suffix = struct.pack("<I", 0)
 
         def fast(args, kwargs):
@@ -224,13 +222,7 @@ class WorkerContext:
             chan = channels.get(actor_id)
             if chan is None or chan.dead:
                 return None
-            payload = (list(args), dict(kwargs))
-            try:
-                blob = dumps(payload, 5)
-                if b"__main__" in blob:
-                    blob = cloudpickle.dumps(payload)
-            except Exception:
-                blob = cloudpickle.dumps(payload)
+            blob = dumps_args((list(args), dict(kwargs)))
             tid = new_task_id()
             rid = tid + suffix
             spec = _fast_method_spec(tid, rid, actor_id, method_name, blob)
@@ -356,6 +348,7 @@ class WorkerContext:
                                               None) is not None
         oid = oid or ids.random_object_id()
         size, token = serialized_size(value)
+        track_owned = track_owned and size >= _EAGER_DELETE_MIN
         buf = self.store.create(oid, size)
         try:
             try:
@@ -372,7 +365,7 @@ class WorkerContext:
             self._seal_notify(oid)
         if track_owned:
             with self._ref_lock:
-                self._owned_puts[oid] = size
+                self._owned_puts[oid] = size  # only >= _EAGER_DELETE_MIN
         return ObjectRef(oid)
 
     def get_object(self, ref: ObjectRef, timeout: Optional[float] = None):

@@ -22,6 +22,25 @@ from ray_tpu._private.runtime_env import package as package_runtime_env
 from ray_tpu.core.remote_function import resolve_resources, strategy_fields
 
 
+def dumps_args(payload) -> bytes:
+    """The argument-serialization policy, shared by the full submit path
+    and the worker's actor fastlane: stdlib pickle first (its C
+    implementation is ~3x cloudpickle for plain-data args and runs the
+    same ObjectRef escape hooks via __reduce__), cloudpickle when pickle
+    can't (closures/lambdas) or when the blob references __main__ —
+    stdlib pickles driver-script classes BY REFERENCE, which a worker
+    process cannot resolve (cloudpickle ships them by value).  The
+    b"__main__" scan is conservative: a false positive merely costs the
+    cloudpickle path."""
+    try:
+        blob = pickle.dumps(payload, protocol=5)
+        if b"__main__" in blob:
+            return cloudpickle.dumps(payload)
+        return blob
+    except Exception:
+        return cloudpickle.dumps(payload)
+
+
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str):
         self._handle = handle
@@ -101,21 +120,7 @@ class ActorHandle:
         task_id = ids.new_task_id()
         return_ids = [ids.object_id_for_return(task_id, i)
                       for i in range(num_returns)]
-        # stdlib pickle first: its C implementation is ~3x cloudpickle for
-        # plain-data args (the overwhelmingly common case) and runs the
-        # same ObjectRef escape hooks via __reduce__.  Fall back to
-        # cloudpickle when pickle can't (closures/lambdas) or when the
-        # blob references __main__ — stdlib pickles driver-script classes
-        # BY REFERENCE, which a worker process cannot resolve (cloudpickle
-        # ships them by value).  The b"__main__" scan is conservative: a
-        # false positive merely costs the cloudpickle path.
-        payload = (list(args), dict(kwargs))
-        try:
-            args_blob = pickle.dumps(payload, protocol=5)
-            if b"__main__" in args_blob:
-                args_blob = cloudpickle.dumps(payload)
-        except Exception:
-            args_blob = cloudpickle.dumps(payload)
+        args_blob = dumps_args((list(args), dict(kwargs)))
         spec = TaskSpec(
             task_id=task_id,
             kind=ACTOR_METHOD,

@@ -75,10 +75,10 @@ bool send_all(int fd, const char* p, size_t n) {
   return true;
 }
 
-// Framed send: one writev-ish call (header copied into a stack prefix for
-// small frames to keep it a single syscall).
-bool send_frame(int fd, std::mutex& mu, const char* body, size_t n) {
-  std::lock_guard<std::mutex> g(mu);
+// Framed send, caller already holds the send lock: one writev-ish call
+// (header copied into a stack prefix for small frames to keep it a
+// single syscall).
+bool send_frame_locked(int fd, const char* body, size_t n) {
   uint32_t len = uint32_t(n);
   if (n <= 65536 - 4) {
     char buf[65536];
@@ -89,6 +89,11 @@ bool send_frame(int fd, std::mutex& mu, const char* body, size_t n) {
   char hdr[4];
   memcpy(hdr, &len, 4);
   return send_all(fd, hdr, 4) && send_all(fd, body, n);
+}
+
+bool send_frame(int fd, std::mutex& mu, const char* body, size_t n) {
+  std::lock_guard<std::mutex> g(mu);
+  return send_frame_locked(fd, body, n);
 }
 
 // Incremental frame extraction: 1 = frame out, 0 = need more bytes,
@@ -164,20 +169,9 @@ static PyObject* Channel_submit(ChannelObject* self, PyObject* args) {
         ok = send_all(c->fd, c->out.data(), c->out.size());
         c->out.clear();
       }
-      if (ok) {
-        uint32_t len = uint32_t(frame.len);
-        if (size_t(frame.len) <= 65536 - 4) {
-          char buf[65536];
-          memcpy(buf, &len, 4);
-          memcpy(buf + 4, frame.buf, size_t(frame.len));
-          ok = send_all(c->fd, buf, size_t(frame.len) + 4);
-        } else {
-          char hdr[4];
-          memcpy(hdr, &len, 4);
-          ok = send_all(c->fd, hdr, 4) &&
-               send_all(c->fd, (const char*)frame.buf, size_t(frame.len));
-        }
-      }
+      if (ok)
+        ok = send_frame_locked(c->fd, (const char*)frame.buf,
+                               size_t(frame.len));
     }
   }
   Py_END_ALLOW_THREADS

@@ -271,6 +271,11 @@ class Store {
   uint8_t Contains(const ObjectId& id, uint64_t* sealed, uint64_t* size) {
     std::unique_lock<std::mutex> lk(mu_);
     auto it = objects_.find(id);
+    // a deferred Delete (extent pinned by a reader) keeps the entry until
+    // the last Release, but the object is GONE to new observers — report
+    // what Get would (the evicted tombstone), not "present"
+    if (it != objects_.end() && it->second.delete_pending)
+      return ST_NOT_FOUND;
     if (it == objects_.end()) {
       auto sp = spilled_.find(id);
       if (sp != spilled_.end()) {  // spilled objects are still "present"

@@ -1,0 +1,88 @@
+"""C++ worker API: build cpp/ and drive it against a live cluster.
+
+Counterpart of the reference's C++ worker tests (cpp/src/ray/test/) — a
+C++ process connects to the GCS (wire codec), uses the shared KV, lists
+nodes, and calls a named Python actor over the binary direct-call dialect.
+"""
+
+import os
+import subprocess
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_BIN = "/tmp/ray_tpu/cpp_demo"
+
+
+@pytest.fixture(scope="module")
+def demo_binary():
+    import hashlib
+
+    srcs = [os.path.join(REPO, p) for p in (
+        "cpp/src/client.cc", "cpp/examples/demo.cc",
+        "cpp/include/ray_tpu/client.h", "ray_tpu/native/wire.h")]
+    h = hashlib.sha256()
+    for p in srcs:
+        h.update(open(p, "rb").read())
+    out = f"{_BIN}_{h.hexdigest()[:12]}"
+    if not os.path.exists(out):
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        subprocess.run(
+            ["g++", "-std=c++17", "-O2",
+             "-I", os.path.join(REPO, "ray_tpu/native"),
+             "-I", os.path.join(REPO, "cpp/include"),
+             os.path.join(REPO, "cpp/src/client.cc"),
+             os.path.join(REPO, "cpp/examples/demo.cc"),
+             "-o", out],
+            check=True, capture_output=True, text=True)
+    return out
+
+
+def test_cpp_client_against_cluster(ray_cluster, demo_binary):
+    import ray_tpu
+    import ray_tpu.api as api
+
+    class CppDemo:  # in-function: ships by value into the worker
+        def echo(self, x):
+            return x + 1
+
+        def concat(self, a, b):
+            return f"{a}:{b}"
+
+        def stats(self, xs):
+            return {"n": len(xs), "sum": sum(xs)}
+
+        def roundtrip(self, d):
+            return {"f": d["f"] * 2, "b": d["b"], "none": d["none"]}
+
+        def boom(self):
+            raise ValueError("from python")
+
+    actor = ray_tpu.remote(CppDemo).options(name="cppdemo").remote()
+    ray_tpu.get(actor.echo.remote(0))  # ALIVE + direct server up
+    gcs_addr = api._global_node.gcs_address
+    proc = subprocess.run([demo_binary, gcs_addr, "cppdemo"],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "DEMO-OK" in proc.stdout
+    assert "actor=CppDemo" in proc.stdout
+    # the KV write from C++ is visible from Python
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    assert w.rpc("kv_get", {"namespace": "cppdemo",
+                            "key": b"greeting"}) == b"hello-from-cpp"
+    ray_tpu.kill(actor)
+
+
+def test_pickle_codec_roundtrip(demo_binary):
+    """The C++ mini-pickler emits pickles Python loads exactly, and the
+    C++ unpickler reads Python's protocol-5 plain-data output (checked in
+    the demo binary; here the Python side of the contract)."""
+    import pickle
+
+    # what PickleArgs(42, "s") produces, byte-for-byte
+    blob = (b"\x80\x03(](J*\x00\x00\x00X\x01\x00\x00\x00se}t.")
+    args, kwargs = pickle.loads(blob)
+    assert args == [42, "s"] and kwargs == {}
