@@ -135,5 +135,6 @@ std::string PickleArgs(const std::vector<wire::Value>& args);
 // Decode a pickle of plain data into the wire::Value subset.  Returns
 // false when the stream uses opcodes outside the subset.
 bool UnpickleValue(const std::string& data, wire::Value* out);
+bool UnpickleValue(const char* data, size_t n, wire::Value* out);
 
 }  // namespace rtpu

@@ -519,13 +519,17 @@ struct Unpickler {
 
 }  // namespace
 
-bool UnpickleValue(const std::string& data, wire::Value* out) {
+bool UnpickleValue(const char* data, size_t n, wire::Value* out) {
   Unpickler u;
-  u.p = reinterpret_cast<const uint8_t*>(data.data());
-  u.end = u.p + data.size();
+  u.p = reinterpret_cast<const uint8_t*>(data);
+  u.end = u.p + n;
   if (!u.run() || u.stack.size() != 1) return false;
   *out = std::move(u.stack.back());
   return true;
+}
+
+bool UnpickleValue(const std::string& data, wire::Value* out) {
+  return UnpickleValue(data.data(), data.size(), out);
 }
 
 // ---------------------------------------------------------------------------
@@ -542,18 +546,21 @@ std::string random_bytes(size_t n) {
 }
 
 // Decode a store-format payload (serialization.py): tag 0 pickle, tag 1
-// error pickle, tag 2 raw array.
-void decode_payload(const std::string& payload, CallResult* r) {
-  if (payload.empty()) {
+// error pickle, tag 2 raw array.  Works on (frame, offset) so large
+// results are copied exactly once, into the final CallResult bytes.
+void decode_payload(const std::string& frame, size_t off, CallResult* r) {
+  size_t n = frame.size() - off;
+  if (n == 0) {
     r->value = wire::Value::None();
     return;
   }
-  uint8_t tag = uint8_t(payload[0]);
-  std::string body = payload.substr(1);
+  uint8_t tag = uint8_t(frame[off]);
+  const char* body = frame.data() + off + 1;
+  size_t body_n = n - 1;
   if (tag == 0) {
-    if (!UnpickleValue(body, &r->value)) {
+    if (!UnpickleValue(body, body_n, &r->value)) {
       r->raw = true;
-      r->value = wire::Value::Bytes(std::move(body));
+      r->value = wire::Value::Bytes(std::string(body, body_n));
     }
     return;
   }
@@ -561,34 +568,29 @@ void decode_payload(const std::string& payload, CallResult* r) {
     r->ok = false;
     r->error = "remote exception (payload is a pickled Python exception; "
                "inspect from a Python peer)";
-    // best effort: surface any printable text from the pickle
     return;
   }
   if (tag == 2) {  // array: u32 meta_len | pickle((dtype, shape)) | data
-    if (body.size() < 4) {
-      r->raw = true;
-      r->value = wire::Value::Bytes(std::move(body));
-      return;
-    }
-    uint32_t meta_len;
-    memcpy(&meta_len, body.data(), 4);
+    uint32_t meta_len = 0;
     wire::Value meta;
     wire::Value arr = wire::Value::Dict();
-    if (4 + size_t(meta_len) <= body.size() &&
-        UnpickleValue(body.substr(4, meta_len), &meta) &&
-        meta.items && meta.items->size() == 2) {
+    if (body_n >= 4) memcpy(&meta_len, body, 4);
+    if (body_n >= 4 && 4 + size_t(meta_len) <= body_n &&
+        UnpickleValue(body + 4, meta_len, &meta) && meta.items &&
+        meta.items->size() == 2) {
       arr.set("dtype", (*meta.items)[0]);
       arr.set("shape", (*meta.items)[1]);
-      arr.set("data", wire::Value::Bytes(body.substr(4 + meta_len)));
+      arr.set("data", wire::Value::Bytes(std::string(
+          body + 4 + meta_len, body_n - 4 - meta_len)));
       r->value = std::move(arr);
     } else {
       r->raw = true;
-      r->value = wire::Value::Bytes(std::move(body));
+      r->value = wire::Value::Bytes(std::string(body, body_n));
     }
     return;
   }
   r->raw = true;
-  r->value = wire::Value::Bytes(std::move(body));
+  r->value = wire::Value::Bytes(std::string(body, body_n));
 }
 
 }  // namespace
@@ -634,9 +636,8 @@ CallResult ActorHandle::Call(const std::string& method,
     out.ok = (flags & 0x01) != 0;
     out.in_store = (flags & 0x02) != 0;
     if (!out.in_store) {
-      std::string payload = f.substr(2 + tl + 1);
       bool was_ok = out.ok;
-      decode_payload(payload, &out);
+      decode_payload(f, size_t(2 + tl + 1), &out);
       out.ok = was_ok && out.error.empty();
     }
     return out;

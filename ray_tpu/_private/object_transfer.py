@@ -36,6 +36,10 @@ class ObjectTransfer:
         self._is_shutdown = is_shutdown
         self._pulls: set[bytes] = set()  # oids with an in-flight pull
         self._pull_lock = threading.Lock()
+        # push side (reference: push_manager.cc)
+        self._pushes: set[tuple[bytes, bytes]] = set()
+        self._push_sem = threading.Semaphore(self._PUSH_CONCURRENCY)
+        self._partials: dict = {}  # oid -> [bytearray, size, last_ts]
         # Seal notifications batch: every sealed object needs its location
         # in the GCS directory, but one synchronous control-plane RPC per
         # seal caps put/task throughput at the RPC rate (the round-2
@@ -190,3 +194,117 @@ class ObjectTransfer:
                     "data": bytes(view[offset:offset + chunk])}
         finally:
             self._store.release(oid)
+
+    # ------------------------------------------------------------------
+    # Push side (reference: push_manager.cc — proactive chunked pushes
+    # with at most one in-flight push per (node, object) and bounded
+    # concurrency; object_manager.h HandlePush on the receiver)
+    # ------------------------------------------------------------------
+
+    _PUSH_CONCURRENCY = 2
+    _PARTIAL_TTL_S = 60.0
+
+    def push(self, oid: bytes, node) -> bool:
+        """Proactively send a locally-sealed object to a peer node.
+
+        Dedups in-flight (node, oid) pairs — re-pushing while a transfer
+        runs is a no-op, the reference PushManager contract.  Returns True
+        when a push was started."""
+        if node is None or not node.alive or not node.sched_socket:
+            return False
+        key = (node.node_id, oid)
+        with self._pull_lock:
+            if key in self._pushes:
+                return False
+            self._pushes.add(key)
+        threading.Thread(target=self._push_object,
+                         args=(key, node.sched_socket),
+                         name="obj-push", daemon=True).start()
+        return True
+
+    def _push_object(self, key, sched_addr: str):
+        oid = key[1]
+        with self._push_sem:
+            try:
+                view = self._store.get(oid, 0)
+                if view is None:
+                    return  # evicted since scheduling the push
+                try:
+                    # stream straight from the shm view: no whole-object
+                    # heap copy (a multi-GB push must not double-buffer)
+                    conn = protocol.connect_addr(sched_addr)
+                    try:
+                        size = len(view)
+                        off = 0
+                        while True:
+                            chunk = bytes(view[off:off + FETCH_CHUNK])
+                            conn.send({"t": "rpc", "method": "push_chunk",
+                                       "params": {"oid": oid, "offset": off,
+                                                  "size": size,
+                                                  "data": chunk}})
+                            resp = conn.recv()
+                            if resp is None or not resp.get("ok") \
+                                    or not resp["result"]:
+                                return  # receiver declined (has it)
+                            off += len(chunk)
+                            if off >= size:
+                                return
+                    finally:
+                        conn.close()
+                finally:
+                    self._store.release(oid)
+            except (OSError, ConnectionError):
+                return  # best-effort: the getter-side pull still covers it
+            finally:
+                with self._pull_lock:
+                    self._pushes.discard(key)
+
+    def receive_chunk(self, oid: bytes, offset: int, size: int,
+                      data: bytes) -> bool:
+        """Receiver half: assemble pushed chunks; False tells the pusher
+        to stop (already have the object / stale partial)."""
+        if self._store.contains(oid):
+            return False
+        now = time.monotonic()
+        with self._pull_lock:
+            # expire abandoned partials (pusher died mid-transfer)
+            for k in [k for k, v in self._partials.items()
+                      if now - v[2] > self._PARTIAL_TTL_S]:
+                del self._partials[k]
+            st = self._partials.get(oid)
+            if offset == 0:
+                # a fresh stream RESTARTS assembly — a retried pusher (or
+                # a second pusher racing) must not be killed by a stale
+                # partial from a dead one
+                st = [bytearray(), size, now]
+                self._partials[oid] = st
+            elif st is None:
+                return False  # mid-stream chunk with no partial: stale
+            if offset != len(st[0]) or size != st[1]:
+                del self._partials[oid]
+                return False
+            st[0] += data
+            st[2] = now
+            done = len(st[0]) >= size
+            if done:
+                del self._partials[oid]
+        if not done:
+            return True
+        try:
+            buf = self._store.create(oid, size)
+            try:
+                buf[:size] = st[0]
+            finally:
+                buf.release()
+            self._store.seal(oid)
+            self.note_sealed(oid)
+        except FileExistsError:
+            pass  # local compute / concurrent pull won
+        except Exception:
+            return False
+        return True
+
+    def push_stats(self) -> dict:
+        with self._pull_lock:
+            return {"pushes_in_flight": len(self._pushes),
+                    "partials": len(self._partials)}

@@ -1005,6 +1005,12 @@ class Scheduler:
                 return {"lines": [], "error": f"no such log: {name}"}
             lines = data.splitlines()
             return {"lines": lines[-tail:] if tail > 0 else lines}
+        if method == "push_chunk":
+            # proactive push from a peer (reference: object_manager.h
+            # HandlePush): assemble chunks; False tells the pusher to stop
+            return self._transfer.receive_chunk(
+                params["oid"], params["offset"], params["size"],
+                params["data"])
         if method == "pull":
             return self.trigger_pull(params["oid"])
         if method == "object_locations":
@@ -1301,6 +1307,18 @@ class Scheduler:
                 "node": node_id})
         else:
             self._forwarded[spec.task_id] = (node_id, spec)
+        # Push locally-present args ahead of the task (reference:
+        # push_manager.cc) so the target's workers skip the pull round
+        # trip; best-effort — the pull path still covers misses.
+        deps = getattr(spec, "dependencies", None)
+        if deps:
+            target = self._cluster_nodes.get(node_id)
+            for dep_oid in deps:
+                try:
+                    if self._store.contains(dep_oid):
+                        self._transfer.push(dep_oid, target)
+                except Exception:
+                    pass
         if _DEBUG_SCHED:
             _dbg(f"forward {spec.kind} {spec.name} -> {node_id.hex()[:8]}"
                  f"{' (relay)' if relay else ''}")

@@ -55,3 +55,8 @@ class TaskSpec:
     node_affinity: Optional[bytes] = None
     affinity_soft: bool = True
     origin_node: Optional[bytes] = None  # forwarder to notify on completion
+    # ObjectRef arguments captured at submission (escape-hook collector in
+    # worker.py): lets a forwarding node PUSH locally-present args to the
+    # target ahead of execution (reference: push_manager.cc; the deps the
+    # reference carries in its TaskSpec protobuf)
+    dependencies: Optional[list] = None

@@ -156,6 +156,25 @@ class WorkerContext:
         if self._seal_notify is not None:
             self._seal_notify(oid)
 
+    def collect_escaped_refs(self):
+        """Context manager: collect the oids of every ObjectRef pickled on
+        THIS thread inside the block (the escape hook fires per ref during
+        args pickling) — how task submission learns its dependencies
+        without a second pass over the args."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _cm():
+            prev = getattr(self._tls, "escape_sink", None)
+            sink: list[bytes] = []
+            self._tls.escape_sink = sink
+            try:
+                yield sink
+            finally:
+                self._tls.escape_sink = prev
+
+        return _cm()
+
     def _on_ref_escape(self, oid: bytes) -> None:
         """An ObjectRef is being pickled (it may leave this process): if its
         value lives only in the in-process memory store, promote it to the
@@ -163,6 +182,9 @@ class WorkerContext:
         flagged instead — the delivery path promotes it the moment the
         direct reply lands (another process may already be blocking on the
         shm store for it)."""
+        sink = getattr(self._tls, "escape_sink", None)
+        if sink is not None:
+            sink.append(oid)
         owned = getattr(self, "_owned_puts", None)
         if owned is not None:
             owned.pop(oid, None)  # other processes may now hold refs

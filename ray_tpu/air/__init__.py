@@ -1,0 +1,30 @@
+"""ray_tpu.air: shared AIR commons for Train and Tune.
+
+Counterpart of /root/reference/python/ray/air/: the run/checkpoint/failure
+configs and Result type shared by the AI libraries (re-exported from their
+canonical homes here), plus the execution layer
+(``air.execution.ActorManager`` — the reference's ``RayActorManager``,
+python/ray/air/execution/_internal/actor_manager.py:22) that Tune's trial
+loop runs on.
+"""
+
+from ray_tpu.air.execution import ActorManager, TrackedActor
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.controller import Result
+
+__all__ = [
+    "ActorManager",
+    "Checkpoint",
+    "CheckpointConfig",
+    "FailureConfig",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TrackedActor",
+]

@@ -1,0 +1,3 @@
+from ray_tpu.air.execution.actor_manager import ActorManager, TrackedActor
+
+__all__ = ["ActorManager", "TrackedActor"]

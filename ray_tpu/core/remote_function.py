@@ -79,17 +79,26 @@ class RemoteFunction:
         num_returns = options.get("num_returns", 1)
         return_ids = [ids.object_id_for_return(task_id, i)
                       for i in range(num_returns)]
+        collect = getattr(worker, "collect_escaped_refs", None)
+        if collect is not None:
+            with collect() as deps:
+                args_blob = cloudpickle.dumps((list(args), dict(kwargs)))
+            dependencies = deps or None
+        else:
+            args_blob = cloudpickle.dumps((list(args), dict(kwargs)))
+            dependencies = None
         spec = TaskSpec(
             task_id=task_id,
             kind=TASK,
             fn_id=fn_id,
-            args_blob=cloudpickle.dumps((list(args), dict(kwargs))),
+            args_blob=args_blob,
             return_ids=return_ids,
             resources=resolve_resources(options),
             name=options.get("name") or self.__name__,
             max_retries=options.get("max_retries", 3),
             runtime_env=package_runtime_env(
                 options.get("runtime_env"), worker),
+            dependencies=dependencies,
             **strategy_fields(options),
         )
         worker.submit(spec)

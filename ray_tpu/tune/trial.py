@@ -35,8 +35,9 @@ class Trial:
     reports: List[dict] = field(default_factory=list)
     checkpoint_dir: Optional[str] = None  # latest persisted checkpoint
     error: Optional[str] = None
-    actor: Any = None
+    actor: Any = None  # TrackedActor while running (air.execution)
     trial_dir: str = ""
+    next_poll: float = 0.0  # ActorManager pacing (tuner.py)
 
 
 class _TuneSession:

@@ -172,25 +172,62 @@ class Tuner:
         scores: Dict[str, float] = {}
         sign = 1.0 if tc.mode == "max" else -1.0
 
-        def launch(trial: Trial, restore_from: Optional[str] = None):
-            actor = ray_tpu.remote(TrialRunnerActor).options(
-                **tc.resources_per_trial).remote()
-            ray_tpu.get(actor.start.remote(
-                self._trainable, trial.config, trial.trial_dir,
-                restore_from))
-            trial.actor = actor
-            trial.status = RUNNING
-            running.append(trial)
+        # Trial actors run on the shared AIR actor manager (reference:
+        # TuneController over RayActorManager, air/execution/_internal/
+        # actor_manager.py): completions route via callbacks; one poll is
+        # in flight per trial, so a slow trial never stalls the loop.
+        from ray_tpu.air.execution import ActorManager
 
-        def finalize(trial: Trial, status: str, error: Optional[str] = None):
+        mgr = ActorManager()
+        inbox: List[tuple] = []  # (trial, poll_payload)
+        _POLL_PERIOD_S = 0.05
+
+        def on_actor_dead(tracked, msg: str):
+            trial = tracked.data
+            if trial in running:
+                finalize(trial, ERRORED, f"trial actor died: {msg}",
+                         kill=False)
+                searcher.on_trial_complete(trial.trial_id,
+                                           trial.last_result, error=True)
+
+        def on_poll(tracked, payload):
+            inbox.append((tracked.data, payload))
+
+        def on_task_error(tracked, exc):
+            # a start/poll raising synchronously (bad trial dir, corrupt
+            # checkpoint scan) must fail the trial, not strand it PENDING
+            trial = tracked.data
+            if trial in running:
+                finalize(trial, ERRORED, repr(exc))
+                searcher.on_trial_complete(trial.trial_id,
+                                           trial.last_result, error=True)
+
+        def launch(trial: Trial, restore_from: Optional[str] = None):
+            tracked = mgr.add_actor(
+                TrialRunnerActor, options=dict(tc.resources_per_trial),
+                data=trial, on_actor_dead=on_actor_dead)
+            trial.actor = tracked
+            trial.status = RUNNING
+            trial.next_poll = 0.0
+            running.append(trial)
+            mgr.schedule_actor_task(
+                tracked, "start",
+                (self._trainable, trial.config, trial.trial_dir,
+                 restore_from),
+                on_result=lambda tr, _v: schedule_poll(tr),
+                on_error=on_task_error)
+
+        def schedule_poll(tracked):
+            mgr.schedule_actor_task(tracked, "poll", on_result=on_poll,
+                                    on_error=on_task_error)
+
+        def finalize(trial: Trial, status: str,
+                     error: Optional[str] = None, kill: bool = True):
             trial.status = status
             trial.error = error
             running.remove(trial)
             if trial.actor is not None:
-                try:
-                    ray_tpu.kill(trial.actor)
-                except Exception:
-                    pass
+                mgr.remove_actor(trial.actor, kill=kill)
                 trial.actor = None
 
         def record(trial: Trial, rep: dict):
@@ -222,11 +259,21 @@ class Tuner:
                     exhausted = True
             while pending and len(running) < max_conc:
                 launch(pending.pop(0))
-            progressed = False
-            for trial in list(running):
-                poll = ray_tpu.get(trial.actor.poll.remote())
+            # re-arm polls that are due (pacing: a trial with no new
+            # reports is polled every _POLL_PERIOD_S, not continuously)
+            now = time.monotonic()
+            for trial in running:
+                if (trial.actor is not None and trial.actor.in_flight == 0
+                        and now >= getattr(trial, "next_poll", 0.0)):
+                    trial.next_poll = now + _POLL_PERIOD_S
+                    schedule_poll(trial.actor)
+            mgr.wait(timeout=_POLL_PERIOD_S)
+            polls, inbox[:] = list(inbox), []
+            for trial, poll in polls:
+                if trial not in running:
+                    continue
+                stopped_or_relaunched = False
                 for rep in poll["reports"]:
-                    progressed = True
                     record(trial, rep)
                     if rep.get("final"):
                         continue
@@ -237,10 +284,11 @@ class Tuner:
                         if tc.metric and tc.metric in rep["metrics"] \
                         else CONTINUE
                     if decision == STOP:
-                        ray_tpu.get(trial.actor.stop.remote())
+                        ray_tpu.get(trial.actor.handle.stop.remote())
                         finalize(trial, TERMINATED)
                         searcher.on_trial_complete(
                             trial.trial_id, trial.last_result)
+                        stopped_or_relaunched = True
                         break
                     src_id = scheduler.exploit_decision(
                         trial.trial_id, rep["metrics"], scores) \
@@ -252,22 +300,24 @@ class Tuner:
                         if src.checkpoint_dir:
                             # exploit: restart from the stronger trial's
                             # checkpoint with a perturbed config
-                            ray_tpu.get(trial.actor.stop.remote())
+                            ray_tpu.get(trial.actor.handle.stop.remote())
                             finalize(trial, TERMINATED)
                             trial.config = scheduler.perturb(src.config)
                             launch(trial,
                                    restore_from=src.checkpoint_dir)
+                            stopped_or_relaunched = True
                             break
-                else:
-                    if trial in running and poll["status"] in (
-                            TERMINATED, ERRORED):
-                        finalize(trial, poll["status"], poll["error"])
-                        searcher.on_trial_complete(
-                            trial.trial_id, trial.last_result,
-                            error=poll["status"] == ERRORED)
-                        progressed = True
-            if not progressed:
-                time.sleep(0.05)
+                if stopped_or_relaunched:
+                    continue
+                if trial in running and poll["status"] in (
+                        TERMINATED, ERRORED):
+                    finalize(trial, poll["status"], poll["error"])
+                    searcher.on_trial_complete(
+                        trial.trial_id, trial.last_result,
+                        error=poll["status"] == ERRORED)
+                elif poll["reports"]:
+                    # fresh data: poll again without the pacing delay
+                    trial.next_poll = 0.0
 
         results = []
         for trial in trials:

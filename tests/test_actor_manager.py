@@ -1,0 +1,99 @@
+"""air.execution.ActorManager: event-driven actor/task routing.
+
+Mirrors the reference's actor-manager tests
+(python/ray/air/execution/tests/test_actor_manager.py shape): result
+routing, error routing, actor-death notification, removal semantics.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.air.execution import ActorManager
+
+
+@pytest.fixture(scope="module")
+def cluster(ray_cluster):
+    return ray_cluster
+
+
+def test_result_and_error_routing(cluster):
+    class Worker:
+        def ok(self, x):
+            return x * 2
+
+        def bad(self):
+            raise ValueError("nope")
+
+    mgr = ActorManager()
+    a = mgr.add_actor(Worker, data="payload")
+    results, errors = [], []
+    mgr.schedule_actor_task(a, "ok", (21,),
+                            on_result=lambda tr, v: results.append(
+                                (tr.data, v)))
+    mgr.schedule_actor_task(a, "bad",
+                            on_error=lambda tr, e: errors.append(e))
+    deadline = time.monotonic() + 30
+    while (len(results) + len(errors) < 2) and time.monotonic() < deadline:
+        mgr.wait(timeout=0.2)
+    assert results == [("payload", 42)]
+    assert len(errors) == 1 and isinstance(errors[0], ValueError)
+    mgr.remove_actor(a)
+    assert mgr.live_actors == []
+
+
+def test_actor_death_notification(cluster):
+    class Mortal:
+        def die(self):
+            import os
+
+            os._exit(1)
+
+        def ping(self):
+            return 1
+
+    mgr = ActorManager()
+    deaths = []
+    a = mgr.add_actor(Mortal, on_actor_dead=lambda tr, msg: deaths.append(tr))
+    mgr.schedule_actor_task(a, "ping",
+                            on_result=lambda tr, v: None)
+    deadline = time.monotonic() + 30
+    while a.in_flight and time.monotonic() < deadline:
+        mgr.wait(timeout=0.2)
+    mgr.schedule_actor_task(a, "die", on_result=lambda tr, v: None)
+    # a second task queued behind the death is dropped silently
+    mgr.schedule_actor_task(a, "ping", on_result=lambda tr, v: None)
+    deadline = time.monotonic() + 60
+    while not deaths and time.monotonic() < deadline:
+        mgr.wait(timeout=0.2)
+    assert deaths == [a]
+    assert a.state == "DEAD"
+    assert a.in_flight == 0
+    # scheduling on a dead actor is refused
+    assert not mgr.schedule_actor_task(a, "ping")
+
+
+def test_remove_drops_pending_without_callbacks(cluster):
+    class Slow:
+        def sleepy(self):
+            time.sleep(30)
+            return 1
+
+    mgr = ActorManager()
+    fired = []
+    a = mgr.add_actor(Slow)
+    mgr.schedule_actor_task(a, "sleepy",
+                            on_result=lambda tr, v: fired.append(v),
+                            on_error=lambda tr, e: fired.append(e))
+    mgr.remove_actor(a)  # kills the actor, drops the pending task
+    mgr.wait(timeout=0.5)
+    assert fired == []
+    assert mgr.num_pending_tasks() == 0
+
+
+def test_wait_honors_timeout_when_idle(cluster):
+    mgr = ActorManager()
+    t0 = time.monotonic()
+    assert mgr.wait(timeout=0.2) == 0
+    assert time.monotonic() - t0 >= 0.15  # no busy-spin contract

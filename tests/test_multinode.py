@@ -268,3 +268,103 @@ def test_placement_group_strict_pack_lands_on_one_node(cluster):
     assignment = placement_group_table()[pg.id]["assignment"]
     assert len(set(assignment)) == 1
     remove_placement_group(pg)
+
+
+def test_push_object_to_peer(cluster):
+    """Proactive push (reference: push_manager.cc): the object lands in
+    the peer's store without any getter-side pull."""
+    import numpy as np
+
+    node_b = _add_worker(cluster)
+    head = cluster.head_node
+    data = np.arange(1 << 20, dtype=np.uint8)
+    ref = ray_tpu.put(data)
+    oid = ref.binary()
+    deadline = time.monotonic() + 30
+    target = None
+    while time.monotonic() < deadline and target is None:
+        target = head.scheduler._cluster_nodes.get(node_b.node_id)
+        if target is None:
+            time.sleep(0.1)  # head's view fills on the next sync tick
+    assert target is not None
+    assert head.scheduler._transfer.push(oid, target)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if node_b.scheduler._store.contains(oid):
+            break
+        time.sleep(0.1)
+    assert node_b.scheduler._store.contains(oid)
+    # re-push of a present object is declined by the receiver (no error)
+    head.scheduler._transfer.push(oid, target)
+    # the pushed copy is advertised: a third party can resolve locations
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        locs = head.gcs.get_object_locations(oid)
+        if node_b.node_id in locs:
+            break
+        time.sleep(0.1)
+    assert node_b.node_id in locs
+
+
+def test_spillback_pushes_args(cluster):
+    """A forwarded task's ObjectRef args (captured at submission via the
+    escape-hook collector) are PUSHED to the target node — observed on the
+    push API itself, not just the end state (the pull path would also
+    produce the end state)."""
+    import numpy as np
+
+    node_b = _add_worker(cluster, cpus=2.0)
+    head = cluster.head_node
+    pushed = []
+    transfer = head.scheduler._transfer
+    orig_push = transfer.push
+
+    def spy_push(oid, node):
+        pushed.append((oid, node.node_id if node else None))
+        return orig_push(oid, node)
+
+    transfer.push = spy_push
+    big = ray_tpu.put(np.ones(1 << 20, np.uint8))
+
+    @ray_tpu.remote
+    def use(x, tag):
+        return int(x.sum())
+
+    # occupy the head's CPUs so the next tasks spill to node B
+    @ray_tpu.remote
+    def hog():
+        time.sleep(3.0)
+        return 1
+
+    hogs = [hog.options(num_cpus=1).remote() for _ in range(2)]
+    time.sleep(0.5)
+    refs = [use.remote(big, i) for i in range(2)]
+    assert ray_tpu.get(refs, timeout=120) == [1 << 20] * 2
+    ray_tpu.get(hogs)
+    # the dependency was captured AND pushed at forward time
+    assert (big.binary(), node_b.node_id) in pushed, pushed
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if node_b.scheduler._store.contains(big.binary()):
+            break
+        time.sleep(0.1)
+    assert node_b.scheduler._store.contains(big.binary())
+
+
+def test_push_receiver_rejects_stale_partials(ray_cluster):
+    """receive_chunk protocol: mid-stream resumes without a partial are
+    declined; mismatched sizes reset the partial."""
+    import ray_tpu.api as api
+
+    tr = api._global_node.scheduler._transfer
+    oid = b"Q" * 28
+    assert not tr.receive_chunk(oid, offset=4, size=8, data=b"late")
+    assert tr.receive_chunk(oid, offset=0, size=8, data=b"half")
+    # size mismatch resets
+    assert not tr.receive_chunk(oid, offset=4, size=9, data=b"xxxx")
+    # a fresh offset-0 stream RESTARTS assembly over any stale partial
+    # (a retried pusher must not be killed by a dead pusher's leavings)
+    assert tr.receive_chunk(oid, offset=0, size=8, data=b"part")
+    assert tr.receive_chunk(oid, offset=0, size=8, data=b"full")
+    assert tr.receive_chunk(oid, offset=4, size=8, data=b"data")
+    assert api._global_node.scheduler._store.contains(oid)
