@@ -104,6 +104,10 @@ def pick_spill_target(
         return None  # PG bundles are reserved on this node
     if spec.node_affinity == node_id and not spec.affinity_soft:
         return None
+    from ray_tpu.util.scheduling_strategies import labels_match
+
+    hard = getattr(spec, "label_selector", None)
+    soft = getattr(spec, "label_selector_soft", None)
     res = spec.resources or {}
     locally_feasible = all(
         total_resources.get(k, 0) >= v for k, v in res.items())
@@ -111,15 +115,20 @@ def pick_spill_target(
     for nid, node in cluster_nodes.items():
         if nid == node_id or not node.alive:
             continue
+        labels = getattr(node, "labels", None)
+        if hard and not labels_match(hard, labels):
+            continue  # hard label selector excludes this node
         if not all(node.resources.get(k, 0) >= v for k, v in res.items()):
             continue  # never feasible there
         has_now = all(node.available.get(k, 0) >= v for k, v in res.items())
-        if not has_now and locally_feasible:
+        if not has_now and locally_feasible and not hard:
             # feasible here eventually: only spill to nodes with free
-            # capacity right now
+            # capacity right now (a hard selector has no "here" option)
             continue
         score = (1000.0 if has_now else 0.0) + sum(
             node.available.get(k, 0) for k in ("CPU", "TPU"))
+        if soft and labels_match(soft, labels):
+            score += 10000.0  # soft label preference dominates load
         if score > best_score:
             best, best_score = nid, score
     if best is not None:

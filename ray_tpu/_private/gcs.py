@@ -76,6 +76,8 @@ class NodeInfo:
     # live load view, refreshed by heartbeats
     available: dict = field(default_factory=dict)
     queued: int = 0
+    # static key->value node labels (NodeLabelSchedulingStrategy)
+    labels: dict = field(default_factory=dict)
 
 
 class Gcs:

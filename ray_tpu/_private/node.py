@@ -74,7 +74,9 @@ class Node:
         merge_default_resources: bool = True,
         listen_host: Optional[str] = None,
         gcs_persist_path: Optional[str] = None,
+        labels: Optional[dict] = None,
     ):
+        self.labels = dict(labels or {})
         """listen_host: bind the node's control-plane services (GCS on the
         head, scheduler everywhere) to TCP on this interface instead of
         unix sockets — required for clusters spanning hosts.  The object
@@ -180,6 +182,7 @@ class Node:
             max_workers=max_workers or max(4, int(merged.get("CPU", 4)) * 2),
             node_id=self.node_id,
             is_head=head,
+            labels=self.labels,
         )
         # Register AFTER the scheduler binds: with TCP the advertised
         # address carries the kernel-assigned port.
@@ -187,7 +190,8 @@ class Node:
         self.gcs.register_node(NodeInfo(
             self.node_id, resources=dict(merged), is_head=head,
             sched_socket=self.sched_address,
-            store_socket=self.store_server.socket_path))
+            store_socket=self.store_server.socket_path,
+            labels=self.labels))
         if head:
             # Job submission lives on the head (reference: JobManager in the
             # dashboard head process, dashboard/modules/job/job_manager.py).

@@ -31,6 +31,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ray_tpu._private import cluster_scheduler as cluster_mod
+from ray_tpu.util import scheduling_strategies as strategies_mod
 from ray_tpu._private import gcs as gcs_mod
 from ray_tpu._private.object_transfer import ObjectTransfer
 from ray_tpu._private.protocol import (
@@ -153,6 +154,7 @@ class Scheduler:
         node_id: Optional[bytes] = None,
         is_head: bool = True,
         gcs_address: Optional[str] = None,
+        labels: Optional[dict] = None,
     ):
         self.store_socket = store_socket
         self.shm_name = shm_name
@@ -161,6 +163,7 @@ class Scheduler:
         self.gcs_address = gcs_address
         self.node_id = node_id or os.urandom(16)
         self.is_head = is_head
+        self.labels = dict(labels or {})
         self.total_resources = dict(node_resources)
         self.available = dict(node_resources)
 
@@ -1839,6 +1842,21 @@ class Scheduler:
                         f"placement group bundle {bundle} only has {cap}"))
                     progress = True
                     continue
+            if spec.label_selector and not strategies_mod.labels_match(
+                    spec.label_selector, self.labels):
+                # hard label selector this node fails: place elsewhere
+                # (reference: node-label policy,
+                # scheduling/policy/node_label_scheduling_policy.cc)
+                target = cluster_mod.pick_spill_target(
+                    spec, self.node_id, self.total_resources,
+                    self._cluster_nodes)
+                if target is not None and self._forward(spec, target):
+                    progress = True
+                else:
+                    # no matching node right now: stay pending (a labeled
+                    # node may join), like the reference's infeasible queue
+                    remaining.append(spec)
+                continue
             if (spec.node_affinity is not None
                     and spec.node_affinity != self.node_id):
                 # NodeAffinitySchedulingStrategy: run on the named node if

@@ -55,6 +55,10 @@ class TaskSpec:
     node_affinity: Optional[bytes] = None
     affinity_soft: bool = True
     origin_node: Optional[bytes] = None  # forwarder to notify on completion
+    # NodeLabelSchedulingStrategy: hard selector must match the executing
+    # node's labels; soft is a preference among feasible nodes
+    label_selector: Optional[dict] = None
+    label_selector_soft: Optional[dict] = None
     # ObjectRef arguments captured at submission (escape-hook collector in
     # worker.py): lets a forwarding node PUSH locally-present args to the
     # target ahead of execution (reference: push_manager.cc; the deps the

@@ -47,6 +47,10 @@ def strategy_fields(options: dict) -> dict:
     if pg is not None:
         return {"pg_id": pg.id,
                 "pg_bundle": 0 if bundle in (None, -1) else bundle}
+    if strategy is not None and hasattr(strategy, "hard"):
+        # NodeLabelSchedulingStrategy
+        return {"label_selector": dict(strategy.hard) or None,
+                "label_selector_soft": dict(strategy.soft) or None}
     if strategy is not None and hasattr(strategy, "node_id"):
         # NodeAffinitySchedulingStrategy: node_id is hex (as returned by
         # ray_tpu.nodes()) or raw bytes

@@ -196,9 +196,14 @@ def cmd_start(args):
             res["TPU"] = float(args.num_tpus)
         from ray_tpu._private.node import Node as _Node
 
+        labels = None
+        if args.labels:
+            import json as _json
+
+            labels = _json.loads(args.labels)
         head_node = _Node(
             head=True, resources=res or None,
-            min_workers=args.min_workers,
+            min_workers=args.min_workers, labels=labels,
             node_id=(bytes.fromhex(args.node_id) if args.node_id else None))
         node = ray_tpu.init(_existing_node=head_node)
         print(f"head node started\n  gcs address: {node.gcs_address}\n"
@@ -228,10 +233,16 @@ def cmd_start(args):
             res["CPU"] = float(args.num_cpus)
         if args.num_tpus is not None:
             res["TPU"] = float(args.num_tpus)
+        labels = None
+        if args.labels:
+            import json as _json
+
+            labels = _json.loads(args.labels)
         node = Node(head=False, gcs_address=address,
                     resources=res or None, min_workers=args.min_workers,
                     node_id=(bytes.fromhex(args.node_id)
                              if args.node_id else None),
+                    labels=labels,
                     # --resources declares the node's EXACT shape (used by
                     # the autoscaler so planned == actual)
                     merge_default_resources=not args.resources)
@@ -318,6 +329,10 @@ def main(argv=None):
     sp.add_argument("--min-workers", type=int, default=2)
     sp.add_argument("--node-id", default=None,
                     help="hex node id (autoscaler-assigned identity)")
+    sp.add_argument("--labels", default=None,
+                    help='static node labels as JSON, e.g. '
+                         '\'{"zone": "us-central2-b"}\' '
+                         '(NodeLabelSchedulingStrategy)')
     sp.add_argument("--resources", default=None,
                     help='JSON resource dict, e.g. \'{"AS_RES": 2.0}\'')
     sp.add_argument("--client-server-port", type=int, default=None,

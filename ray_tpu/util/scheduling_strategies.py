@@ -49,3 +49,29 @@ class Exists:
 
 class DoesNotExist:
     pass
+
+
+def labels_match(selector: dict, labels: dict) -> bool:
+    """Evaluate a label selector against a node's labels (reference:
+    NodeLabelSchedulingStrategy operators,
+    python/ray/util/scheduling_strategies.py:135)."""
+    labels = labels or {}
+    for key, cond in (selector or {}).items():
+        present = key in labels
+        value = labels.get(key)
+        if isinstance(cond, In):
+            if not present or value not in cond.values:
+                return False
+        elif isinstance(cond, NotIn):
+            if present and value in cond.values:
+                return False
+        elif isinstance(cond, Exists):
+            if not present:
+                return False
+        elif isinstance(cond, DoesNotExist):
+            if present:
+                return False
+        else:  # plain value: exact match
+            if not present or value != cond:
+                return False
+    return True

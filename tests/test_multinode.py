@@ -368,3 +368,56 @@ def test_push_receiver_rejects_stale_partials(ray_cluster):
     assert tr.receive_chunk(oid, offset=0, size=8, data=b"full")
     assert tr.receive_chunk(oid, offset=4, size=8, data=b"data")
     assert api._global_node.scheduler._store.contains(oid)
+
+
+def test_node_label_scheduling(cluster):
+    """NodeLabelSchedulingStrategy end to end (reference:
+    scheduling_strategies.py:135 + node_label_scheduling_policy.cc):
+    hard selectors route to matching nodes; In/Exists operators work;
+    an unsatisfiable selector keeps the task pending, not failed."""
+    from ray_tpu.util.scheduling_strategies import (
+        Exists,
+        In,
+        NodeLabelSchedulingStrategy,
+    )
+
+    labeled = cluster.add_node(resources={"CPU": 2.0}, min_workers=1,
+                               object_store_memory=1 << 27,
+                               labels={"accelerator": "tpu-v5e",
+                                       "zone": "z1"})
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote
+    def where():
+        import ray_tpu as rt
+
+        return rt.get_runtime_context().node_id_hex()
+
+    target = labeled.node_id.hex()
+    # plain exact-match selector
+    r = where.options(scheduling_strategy=NodeLabelSchedulingStrategy(
+        hard={"accelerator": "tpu-v5e"})).remote()
+    assert ray_tpu.get(r, timeout=120) == target
+    # In + Exists operators
+    r = where.options(scheduling_strategy=NodeLabelSchedulingStrategy(
+        hard={"zone": In("z1", "z2"), "accelerator": Exists()})).remote()
+    assert ray_tpu.get(r, timeout=120) == target
+    # soft preference routes there too when both nodes are free
+    r = where.options(scheduling_strategy=NodeLabelSchedulingStrategy(
+        soft={"zone": "z1"})).remote()
+    ray_tpu.get(r, timeout=120)  # must complete (soft never blocks)
+    # unsatisfiable hard selector: stays pending (infeasible queue
+    # semantics), then a matching node joining unblocks it
+    r = where.options(scheduling_strategy=NodeLabelSchedulingStrategy(
+        hard={"zone": "nowhere"})).remote()
+    import pytest as _pytest
+
+    from ray_tpu.exceptions import GetTimeoutError
+
+    with _pytest.raises(GetTimeoutError):
+        ray_tpu.get(r, timeout=3)
+    late = cluster.add_node(resources={"CPU": 1.0}, min_workers=1,
+                            object_store_memory=1 << 27,
+                            labels={"zone": "nowhere"})
+    cluster.wait_for_nodes()
+    assert ray_tpu.get(r, timeout=120) == late.node_id.hex()
