@@ -20,6 +20,20 @@ from ray_tpu._private import protocol
 from ray_tpu._private.task_spec import FETCH_CHUNK
 
 
+class _Partial:
+    """In-progress push assembly writing directly into a store extent."""
+
+    __slots__ = ("buf", "size", "ts", "written", "lock", "dead")
+
+    def __init__(self, buf, size: int, ts: float):
+        self.buf = buf          # shm memoryview from store.create
+        self.size = size
+        self.ts = ts
+        self.written = 0
+        self.lock = threading.Lock()
+        self.dead = False
+
+
 class ObjectTransfer:
     def __init__(
         self,
@@ -39,7 +53,7 @@ class ObjectTransfer:
         # push side (reference: push_manager.cc)
         self._pushes: set[tuple[bytes, bytes]] = set()
         self._push_sem = threading.Semaphore(self._PUSH_CONCURRENCY)
-        self._partials: dict = {}  # oid -> [bytearray, size, last_ts]
+        self._partials: dict = {}  # oid -> _Partial (direct-to-shm assembly)
         # Seal notifications batch: every sealed object needs its location
         # in the GCS directory, but one synchronous control-plane RPC per
         # seal caps put/task throughput at the RPC rate (the round-2
@@ -75,8 +89,21 @@ class ObjectTransfer:
     _FLUSH_WINDOW_S = 0.01
 
     def _seal_flush_loop(self):
+        last_sweep = time.monotonic()
         while not self._is_shutdown():
-            if not self._seal_event.wait(timeout=1.0):
+            fired = self._seal_event.wait(timeout=1.0)
+            # Abandoned-partial sweep rides this thread: a partial holds an
+            # UNSEALED store create, which never enters the LRU and so can
+            # never be evicted — if the pusher died and no further push
+            # ever arrives, only a timer reclaims that extent.
+            now = time.monotonic()
+            if now - last_sweep >= self._PARTIAL_TTL_S / 4:
+                last_sweep = now
+                with self._pull_lock:
+                    for k in [k for k, v in self._partials.items()
+                              if now - v.ts > self._PARTIAL_TTL_S]:
+                        self._drop_partial_locked(k)
+            if not fired:
                 continue
             # batching window: under a put storm the queue refills faster
             # than one GCS round trip, and flushing instantly degrades to
@@ -261,48 +288,79 @@ class ObjectTransfer:
 
     def receive_chunk(self, oid: bytes, offset: int, size: int,
                       data: bytes) -> bool:
-        """Receiver half: assemble pushed chunks; False tells the pusher
-        to stop (already have the object / stale partial)."""
+        """Receiver half: assemble pushed chunks straight into the shm
+        extent; False tells the pusher to stop (already have the object /
+        stale partial).
+
+        The store buffer is created on the FIRST chunk and each chunk is
+        written at its offset — a multi-GB push never double-buffers on
+        the receiver (mirrors the pusher's no-copy streaming), and the
+        memcpy happens under a per-partial lock, not ``_pull_lock``, so
+        pull/push bookkeeping is never serialized behind large copies
+        (ADVICE r3).  Lock order is always _pull_lock -> partial.lock.
+        """
         if self._store.contains(oid):
             return False
         now = time.monotonic()
         with self._pull_lock:
             # expire abandoned partials (pusher died mid-transfer)
             for k in [k for k, v in self._partials.items()
-                      if now - v[2] > self._PARTIAL_TTL_S]:
-                del self._partials[k]
+                      if now - v.ts > self._PARTIAL_TTL_S]:
+                self._drop_partial_locked(k)
             st = self._partials.get(oid)
             if offset == 0:
                 # a fresh stream RESTARTS assembly — a retried pusher (or
                 # a second pusher racing) must not be killed by a stale
                 # partial from a dead one
-                st = [bytearray(), size, now]
+                if st is not None:
+                    self._drop_partial_locked(oid)
+                try:
+                    buf = self._store.create(oid, size)
+                except Exception:
+                    return False  # exists (someone else won) or store full
+                st = _Partial(buf, size, now)
                 self._partials[oid] = st
             elif st is None:
                 return False  # mid-stream chunk with no partial: stale
-            if offset != len(st[0]) or size != st[1]:
-                del self._partials[oid]
+            if offset != st.written or size != st.size:
+                self._drop_partial_locked(oid)
                 return False
-            st[0] += data
-            st[2] = now
-            done = len(st[0]) >= size
+            st.ts = now
+        with st.lock:
+            if st.dead:
+                return False  # dropped (TTL / restart) while we waited
+            st.buf[offset:offset + len(data)] = data
+            st.written = offset + len(data)
+            done = st.written >= size
             if done:
-                del self._partials[oid]
+                st.dead = True
+                st.buf.release()
         if not done:
             return True
+        with self._pull_lock:
+            self._partials.pop(oid, None)
         try:
-            buf = self._store.create(oid, size)
-            try:
-                buf[:size] = st[0]
-            finally:
-                buf.release()
             self._store.seal(oid)
             self.note_sealed(oid)
-        except FileExistsError:
-            pass  # local compute / concurrent pull won
         except Exception:
             return False
         return True
+
+    def _drop_partial_locked(self, oid: bytes):
+        """Abandon a partial's half-written store create (holds
+        _pull_lock; takes the partial's lock to fence in-flight copies)."""
+        st = self._partials.pop(oid, None)
+        if st is None:
+            return
+        with st.lock:
+            if st.dead:
+                return
+            st.dead = True
+            try:
+                st.buf.release()
+                self._store.abort(oid)
+            except Exception:
+                pass
 
     def push_stats(self) -> dict:
         with self._pull_lock:

@@ -205,15 +205,9 @@ read_bigquery = _gated_reader(
 read_mongo = _gated_reader(
     "read_mongo", "pymongo",
     "partitions a collection by _id ranges, one cursor per read task")
-read_clickhouse = _gated_reader(
-    "read_clickhouse", "clickhouse-connect",
-    "partitions a query by intDiv on a numeric key")
 read_lance = _gated_reader(
     "read_lance", "pylance",
     "reads dataset fragments, one per read task", import_name="lance")
-read_iceberg = _gated_reader(
-    "read_iceberg", "pyiceberg",
-    "plans table scan tasks from the snapshot's manifest list")
 read_hudi = _gated_reader(
     "read_hudi", "hudi",
     "reads file slices from the latest commit timeline")
@@ -224,13 +218,49 @@ read_databricks_tables = _gated_reader(
     "read_databricks_tables", "databricks-sql-connector",
     "pages results through the Databricks SQL statement API",
     import_name="databricks.sql")
-read_videos = _gated_reader(
-    "read_videos", "opencv-python",
-    "decodes frames per file, one video per read task",
-    import_name="cv2")
 read_audio = _gated_reader(
     "read_audio", "soundfile",
     "decodes PCM per file with sample-rate metadata")
+
+
+def read_iceberg(table_dir: str, *, snapshot_id: Optional[int] = None,
+                 columns: Optional[List[str]] = None,
+                 override_num_blocks: Optional[int] = None) -> Dataset:
+    """Rows of an Iceberg table's current (or named) snapshot, one read
+    task per live parquet data file — native metadata-chain walk, no
+    pyiceberg (reference: _internal/datasource/iceberg_datasource.py;
+    see data/lakehouse.py for scope)."""
+    from ray_tpu.data.lakehouse import iceberg_tasks
+
+    return _read("ReadIceberg", iceberg_tasks(
+        table_dir, _par(override_num_blocks), snapshot_id=snapshot_id,
+        columns=columns))
+
+
+def read_videos(paths, *, override_num_blocks: Optional[int] = None
+                ) -> Dataset:
+    """One row per decoded frame ({"frame": HxWx3 uint8 RGB,
+    "frame_index", "path"}); AVI/MJPEG + raw-DIB decode natively via
+    PIL, other containers fall back to cv2 when importable (reference:
+    _internal/datasource/video_datasource.py over opencv)."""
+    from ray_tpu.data.video import video_tasks
+
+    return _read("ReadVideos", video_tasks(paths, _par(override_num_blocks)))
+
+
+def read_clickhouse(query: str, *, dsn: str = "http://localhost:8123",
+                    partition_key: Optional[str] = None,
+                    user: Optional[str] = None,
+                    password: Optional[str] = None,
+                    override_num_blocks: Optional[int] = None) -> Dataset:
+    """Rows of a ClickHouse query over the server's HTTP interface
+    (FORMAT JSONEachRow), fanned out by modulo(partition_key, N) when a
+    numeric partition key is given — no client wheel needed (reference:
+    _internal/datasource/clickhouse_datasource.py over
+    clickhouse-connect)."""
+    return _read("ReadClickHouse", _ds.clickhouse_tasks(
+        query, dsn, _par(override_num_blocks),
+        partition_key=partition_key, user=user, password=password))
 
 
 __all__ = [

@@ -383,6 +383,9 @@ class Dataset:
     def write_json(self, path: str, **kw) -> None:
         self._write(path, "json", **kw)
 
+    def write_avro(self, path: str, **kw) -> None:
+        self._write(path, "avro", **kw)
+
     def __repr__(self):
         return f"Dataset({self._plan!r})"
 

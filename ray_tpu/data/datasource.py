@@ -273,6 +273,9 @@ def make_write_fn(path: str, fmt: str, write_kwargs: Optional[dict] = None):
             elif fmt == "tfrecords":
                 out = os.path.join(path, name + ".tfrecords")
                 write_tfrecord_file(b.to_pylist(), out)
+            elif fmt == "avro":
+                out = os.path.join(path, name + ".avro")
+                write_avro_file(b.to_pylist(), out)
             else:
                 raise ValueError(f"unknown write format {fmt!r}")
             yield pa.table({"path": [out], "num_rows": [b.num_rows]})
@@ -426,6 +429,61 @@ def sql_tasks(sql: str, connection_factory: Callable[[], Any],
     return [read]
 
 
+def clickhouse_tasks(query: str, dsn: str, parallelism: int,
+                     partition_key: Optional[str] = None,
+                     user: Optional[str] = None,
+                     password: Optional[str] = None) -> List[Callable]:
+    """Native ClickHouse reader over the server's HTTP interface.
+
+    The reference delegates to the `clickhouse-connect` wheel
+    (_internal/datasource/clickhouse_datasource.py); that wheel just
+    speaks HTTP to port 8123, so the dependency is skipped: each read
+    task POSTs its partition of the query with ``FORMAT JSONEachRow``
+    and parses a line per row.  With ``partition_key`` (a numeric
+    column) the query fans out over ``parallelism`` tasks via
+    ``modulo(key, N) = i`` (the wheel's intDiv strategy); without one
+    the query runs as a single task.
+    """
+    import urllib.parse
+    import urllib.request
+
+    base = query.strip().rstrip(";")
+    # positiveModulo: ClickHouse modulo is C-style (negative for negative
+    # keys, so those rows would match no shard); NULL keys match no
+    # comparison at all, so shard 0 sweeps them up explicitly.
+    def shard_pred(i: int) -> str:
+        pred = f"positiveModulo({partition_key}, {parallelism}) = {i}"
+        if i == 0:
+            pred = f"({pred} OR {partition_key} IS NULL)"
+        return pred
+
+    shards = ([f"SELECT * FROM ({base}) WHERE {shard_pred(i)}"
+               for i in range(parallelism)]
+              if partition_key and parallelism > 1 else [base])
+
+    def make(shard_sql: str) -> Callable:
+        def read() -> Iterator[Block]:
+            import json as json_mod
+
+            url = dsn.rstrip("/") + "/?" + urllib.parse.urlencode(
+                {"query": shard_sql + " FORMAT JSONEachRow"})
+            req = urllib.request.Request(url, method="POST")
+            if user:
+                req.add_header("X-ClickHouse-User", user)
+            if password:
+                req.add_header("X-ClickHouse-Key", password)
+            with urllib.request.urlopen(req) as resp:
+                rows = [json_mod.loads(line)
+                        for line in resp.read().decode().splitlines()
+                        if line.strip()]
+            if rows:
+                yield block_mod.from_rows(rows)
+
+        return read
+
+    return [make(s) for s in shards]
+
+
 # -- avro --------------------------------------------------------------------
 
 class _AvroDecoder:
@@ -548,49 +606,270 @@ def avro_tasks(paths, parallelism: int) -> List[Callable]:
     files = expand_paths(paths, [".avro"])
 
     def read_file(f: str) -> Iterator[Block]:
-        import json as json_mod
-        import zlib
-
-        with open(f, "rb") as fh:
-            data = fh.read()
-        if data[:4] != b"Obj\x01":
-            raise ValueError(f"{f}: not an avro container file")
-        d = _AvroDecoder(data)
-        d.pos = 4
-        meta: Dict[str, bytes] = {}
-        while True:
-            n = d.long()
-            if n == 0:
-                break
-            if n < 0:
-                n = -n
-                d.long()
-            for _ in range(n):
-                k = d.read(d.long()).decode()
-                meta[k] = d.read(d.long())
-        schema = json_mod.loads(meta["avro.schema"])
-        codec = meta.get("avro.codec", b"null").decode()
-        names: Dict[str, Any] = {}
-        _collect_named(schema, names)
-        sync = d.read(16)
-        while d.pos < len(d.buf):
-            count = d.long()
-            size = d.long()
-            payload = d.read(size)
-            if codec == "deflate":
-                payload = zlib.decompress(payload, -15)
-            elif codec != "null":
-                raise ValueError(f"unsupported avro codec {codec!r}")
-            bd = _AvroDecoder(payload)
-            rows = [bd.decode(schema, names) for _ in range(count)]
-            if rows and not isinstance(rows[0], dict):
-                rows = [{"value": r} for r in rows]  # non-record schema
+        for rows in _avro_file_blocks(f):
             if rows:
                 yield block_mod.from_rows(rows)
-            if d.read(16) != sync:
-                raise ValueError(f"{f}: sync marker mismatch")
 
     return _file_tasks(files, parallelism, read_file)
+
+
+class _AvroEncoder:
+    """Minimal Avro binary encoder — the write half of ``_AvroDecoder``
+    (null codec, core types).  Powers ``write_avro`` and the hand-built
+    manifest files in the native Iceberg reader's tests; the reference
+    delegates both halves to the `fastavro` wheel, absent here."""
+
+    def __init__(self):
+        self.out = bytearray()
+
+    def long(self, v: int):
+        v = (v << 1) ^ (v >> 63)  # zigzag (Python >> floors, so -1 for <0)
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            if v:
+                self.out.append(b | 0x80)
+            else:
+                self.out.append(b)
+                break
+
+    def encode(self, value, schema, names: Dict[str, Any]):
+        import struct as _struct
+
+        if isinstance(schema, list):  # union: first branch accepting value
+            for i, branch in enumerate(schema):
+                if _avro_union_match(value, branch, names):
+                    self.long(i)
+                    return self.encode(value, branch, names)
+            raise ValueError(f"no union branch for {type(value)} in {schema}")
+        if isinstance(schema, dict):
+            t = schema["type"]
+            if t == "record":
+                for f in schema["fields"]:
+                    self.encode(value.get(f["name"]), f["type"], names)
+                return
+            if t == "enum":
+                self.long(schema["symbols"].index(value))
+                return
+            if t == "array":
+                if value:
+                    self.long(len(value))
+                    for item in value:
+                        self.encode(item, schema["items"], names)
+                self.long(0)
+                return
+            if t == "map":
+                if value:
+                    self.long(len(value))
+                    for k, v in value.items():
+                        kb = k.encode()
+                        self.long(len(kb))
+                        self.out += kb
+                        self.encode(v, schema["values"], names)
+                self.long(0)
+                return
+            if t == "fixed":
+                self.out += value
+                return
+            return self.encode(value, t, names)
+        if schema == "null":
+            return
+        if schema == "boolean":
+            self.out.append(1 if value else 0)
+            return
+        if schema in ("int", "long"):
+            self.long(int(value))
+            return
+        if schema == "float":
+            self.out += _struct.pack("<f", value)
+            return
+        if schema == "double":
+            self.out += _struct.pack("<d", float(value))
+            return
+        if schema == "bytes":
+            self.long(len(value))
+            self.out += value
+            return
+        if schema == "string":
+            b = value.encode() if isinstance(value, str) else bytes(value)
+            self.long(len(b))
+            self.out += b
+            return
+        if schema in names:
+            return self.encode(value, names[schema], names)
+        raise ValueError(f"unsupported avro schema {schema!r}")
+
+
+def _avro_union_match(value, branch, names: Dict[str, Any]) -> bool:
+    b = branch["type"] if isinstance(branch, dict) else branch
+    if b in names and not isinstance(branch, dict):
+        branch = names[b]
+        b = branch["type"]
+    if b == "null":
+        return value is None
+    if value is None:
+        return False
+    if b == "boolean":
+        return isinstance(value, bool)
+    if b in ("int", "long"):
+        return isinstance(value, int) and not isinstance(value, bool)
+    if b in ("float", "double"):
+        return isinstance(value, float)
+    if b == "string":
+        return isinstance(value, str)
+    if b in ("bytes", "fixed"):
+        return isinstance(value, (bytes, bytearray))
+    if b == "record":
+        return isinstance(value, dict)
+    if b == "array":
+        return isinstance(value, (list, tuple))
+    if b == "map":
+        return isinstance(value, dict)
+    if b == "enum":
+        return isinstance(value, str)
+    return False
+
+
+def _infer_avro_schema(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Schema inference for write_avro: per-field type widened across ALL
+    values (long + double -> double), never just the first — typing from
+    one sample would silently truncate 2.5 to 2 under a 'long' schema.
+    Fields that are ever None become nullable unions; non-promotable
+    mixes raise instead of coercing."""
+
+    def of(v):
+        if isinstance(v, bool):
+            return "boolean"
+        if isinstance(v, int):
+            return "long"
+        if isinstance(v, float):
+            return "double"
+        if isinstance(v, (bytes, bytearray)):
+            return "bytes"
+        if isinstance(v, (list, tuple)):
+            item = _widen((of(x) for x in v), "array item") if len(v) \
+                else "string"
+            return {"type": "array", "items": item}
+        if isinstance(v, dict):
+            vals = list(v.values())
+            values = _widen((of(x) for x in vals), "map value") if vals \
+                else "string"
+            return {"type": "map", "values": values}
+        return "string"
+
+    def _widen(types, what: str):
+        out = None
+        for t in types:
+            if out is None or out == t:
+                out = t
+            elif out in ("long", "double") and t in ("long", "double"):
+                out = "double"
+            else:
+                raise ValueError(
+                    f"write_avro: mixed {what} types {out!r} vs {t!r} "
+                    "cannot be widened; cast the column first")
+        return out if out is not None else "string"
+
+    fields = []
+    keys: List[str] = []
+    for r in rows:
+        for k in r:
+            if k not in keys:
+                keys.append(k)
+    for k in keys:
+        vals = [v for r in rows if (v := r.get(k)) is not None]
+        t = _widen((of(v) for v in vals), f"values for field {k!r}") \
+            if vals else "string"
+        if len(vals) < len(rows):
+            t = ["null", t]
+        fields.append({"name": k, "type": t})
+    return {"type": "record", "name": "row", "fields": fields}
+
+
+def write_avro_file(rows: List[Dict[str, Any]], out: str,
+                    schema: Optional[Dict[str, Any]] = None) -> None:
+    """Write an Avro Object Container File (null codec)."""
+    import json as json_mod
+
+    schema = schema or _infer_avro_schema(rows)
+    names: Dict[str, Any] = {}
+    _collect_named(schema, names)
+    enc = _AvroEncoder()
+    enc.out += b"Obj\x01"
+    meta = {"avro.schema": json_mod.dumps(schema).encode(),
+            "avro.codec": b"null"}
+    enc.long(len(meta))
+    for k, v in meta.items():
+        kb = k.encode()
+        enc.long(len(kb))
+        enc.out += kb
+        enc.long(len(v))
+        enc.out += v
+    enc.long(0)
+    sync = os.urandom(16)
+    enc.out += sync
+    if rows:
+        block = _AvroEncoder()
+        for r in rows:
+            block.encode(r, schema, names)
+        enc.long(len(rows))
+        enc.long(len(block.out))
+        enc.out += block.out
+        enc.out += sync
+    with open(out, "wb") as fh:
+        fh.write(bytes(enc.out))
+
+
+def read_avro_rows(path: str) -> List[Dict[str, Any]]:
+    """All rows of one avro container file (helper for the Iceberg
+    manifest chain, which needs rows eagerly, not as read tasks)."""
+    rows: List[Dict[str, Any]] = []
+    for block in _avro_file_blocks(path):
+        rows.extend(block)
+    return rows
+
+
+def _avro_file_blocks(f: str) -> Iterator[List[Dict[str, Any]]]:
+    import json as json_mod
+    import zlib
+
+    with open(f, "rb") as fh:
+        data = fh.read()
+    if data[:4] != b"Obj\x01":
+        raise ValueError(f"{f}: not an avro container file")
+    d = _AvroDecoder(data)
+    d.pos = 4
+    meta: Dict[str, bytes] = {}
+    while True:
+        n = d.long()
+        if n == 0:
+            break
+        if n < 0:
+            n = -n
+            d.long()
+        for _ in range(n):
+            k = d.read(d.long()).decode()
+            meta[k] = d.read(d.long())
+    schema = json_mod.loads(meta["avro.schema"])
+    codec = meta.get("avro.codec", b"null").decode()
+    names: Dict[str, Any] = {}
+    _collect_named(schema, names)
+    sync = d.read(16)
+    while d.pos < len(d.buf):
+        count = d.long()
+        size = d.long()
+        payload = d.read(size)
+        if codec == "deflate":
+            payload = zlib.decompress(payload, -15)
+        elif codec != "null":
+            raise ValueError(f"unsupported avro codec {codec!r}")
+        bd = _AvroDecoder(payload)
+        rows = [bd.decode(schema, names) for _ in range(count)]
+        if rows and not isinstance(rows[0], dict):
+            rows = [{"value": r} for r in rows]  # non-record schema
+        yield rows
+        if d.read(16) != sync:
+            raise ValueError(f"{f}: sync marker mismatch")
 
 
 # -- torch / tf ingestion ----------------------------------------------------

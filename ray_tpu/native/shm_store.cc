@@ -59,6 +59,10 @@ constexpr size_t kIdLen = 20;
 constexpr size_t kReqLen = 1 + kIdLen + 8 + 8;
 constexpr size_t kRespLen = 1 + 8 + 8;
 constexpr uint64_t kAlign = 64;  // cache-line align allocations
+// Extent sentinel for husk entries (aborted recreation whose old readers
+// are still pinned): never a valid segment offset, and FreeListAllocator
+// ignores offsets it does not own.
+constexpr uint64_t kInvalidOffset = ~0ull;
 
 using ObjectId = std::array<uint8_t, kIdLen>;
 
@@ -89,6 +93,11 @@ struct ObjectEntry {
   // Release instead — freeing under an active zero-copy Get view would
   // let the next Create scribble over live reader memory.
   bool delete_pending = false;
+  // Extents of prior incarnations deleted-while-pinned and then recreated
+  // under the same id.  Their readers' pins are folded into `refcount`, so
+  // they are freed when refcount drains to 0 — never while any reader of
+  // any incarnation might still hold a zero-copy view.
+  std::vector<uint64_t> zombie_extents;
   std::list<ObjectId>::iterator lru_it;
   bool in_lru = false;
 };
@@ -163,12 +172,31 @@ class Store {
 
   uint8_t Create(const ObjectId& id, uint64_t size, uint64_t* offset) {
     std::unique_lock<std::mutex> lk(mu_);
-    if (objects_.count(id)) return ST_EXISTS;
+    auto it = objects_.find(id);
+    // An entry with delete_pending is logically GONE (Delete tombstoned it;
+    // only a reader's pin keeps the extent alive) — recreation (task retry /
+    // lineage reconstruction) must succeed, not bounce off ST_EXISTS.
+    if (it != objects_.end() && !it->second.delete_pending) return ST_EXISTS;
     evicted_.erase(id);  // recreation (e.g. task retry) clears the tombstone
     DropSpilledLocked(id);  // recreation supersedes a spilled copy
     uint64_t off;
     while (!alloc_.Alloc(size, &off)) {
       if (!EvictOneLocked()) return ST_OOM;
+    }
+    // NOTE: EvictOneLocked above cannot have erased `it` — delete_pending
+    // entries are never in the LRU (Delete removed them).
+    if (it != objects_.end()) {
+      // Fresh incarnation under the same id: old extent stays zombie-pinned
+      // until its readers drain (pins folded into refcount).
+      ObjectEntry& e = it->second;
+      if (e.offset != kInvalidOffset) e.zombie_extents.push_back(e.offset);
+      e.offset = off;
+      e.size = size;
+      e.sealed = false;
+      e.delete_pending = false;
+      e.refcount += 1;  // creator pin, on top of surviving old-reader pins
+      *offset = off;
+      return ST_OK;
     }
     ObjectEntry e;
     e.offset = off;
@@ -202,6 +230,10 @@ class Store {
       }
       if (evicted_.count(id)) return ST_EVICTED;
       auto it = objects_.find(id);
+      // A deferred Delete keeps the entry until the last Release, but the
+      // object is GONE to new observers (mirror Contains): do not depend on
+      // the bounded tombstone ring to hide it.
+      if (it != objects_.end() && it->second.delete_pending) return ST_EVICTED;
       if (it != objects_.end() && it->second.sealed) {
         it->second.refcount++;
         if (it->second.in_lru) {
@@ -262,8 +294,21 @@ class Store {
     std::unique_lock<std::mutex> lk(mu_);
     auto it = objects_.find(id);
     if (it == objects_.end()) return ST_NOT_FOUND;
-    if (it->second.sealed) return ST_ERR;
-    alloc_.Free(it->second.offset);
+    ObjectEntry& e = it->second;
+    if (e.sealed) return ST_ERR;
+    if (e.offset != kInvalidOffset) alloc_.Free(e.offset);
+    if (e.refcount > 1) {
+      // Aborted recreation while old-incarnation readers are still pinned:
+      // keep a husk entry to receive their Releases (invisible to
+      // Get/Contains via delete_pending); zombies free on the last one.
+      e.offset = kInvalidOffset;
+      e.size = 0;
+      e.delete_pending = true;
+      e.refcount--;  // drop the creator pin
+      RecordEvictedLocked(id);
+      return ST_OK;
+    }
+    for (uint64_t off : e.zombie_extents) alloc_.Free(off);
     objects_.erase(it);
     return ST_OK;
   }
@@ -299,8 +344,13 @@ class Store {
  private:
   void DecrefLocked(ObjectEntry& e, const ObjectId& id) {
     if (e.refcount > 0) e.refcount--;
+    if (e.refcount == 0 && !e.zombie_extents.empty()) {
+      // last pin of any incarnation gone: old extents are now unreferenced
+      for (uint64_t off : e.zombie_extents) alloc_.Free(off);
+      e.zombie_extents.clear();
+    }
     if (e.refcount == 0 && e.delete_pending) {
-      alloc_.Free(e.offset);
+      if (e.offset != kInvalidOffset) alloc_.Free(e.offset);
       objects_.erase(id);  // e is dangling after this — return at once
       return;
     }

@@ -177,3 +177,50 @@ def test_delete_defers_while_pinned(store):
     assert np.frombuffer(view, np.uint8).sum() == data.sum()
     view.release()
     store.release(oid)                # last release frees the extent
+
+
+def test_recreate_while_pinned(store):
+    """A Delete deferred by a reader pin must not block recreation: task
+    retry / lineage reconstruction re-Creates the same id and the new
+    incarnation must be visible to new getters while the old extent stays
+    intact for the pinned reader (ADVICE r3 medium: Create on a
+    delete_pending entry returned ST_EXISTS and silently dropped the
+    write)."""
+    oid = b"R" * 20
+    store.put(oid, b"\x01" * 4096)
+    old_view = store.get(oid, 0)       # pins incarnation 1
+    assert old_view is not None
+    store.delete(oid)                  # deferred: reader still pinned
+    from ray_tpu.core.store_client import ObjectEvictedError
+
+    with pytest.raises(ObjectEvictedError):
+        store.get(oid, 0)
+    # reconstruction rewrites the same id — must succeed, not "exists"
+    store.put(oid, b"\x02" * 4096)
+    new_view = store.get(oid, 1000)
+    assert new_view is not None and bytes(new_view[:8]) == b"\x02" * 8
+    # the pinned old incarnation is unharmed by the new write
+    assert bytes(old_view[:8]) == b"\x01" * 8
+    old_view.release()
+    store.release(oid)                 # drains the old incarnation's pin
+    new_view.release()
+    store.release(oid)
+    # id still present (only the OLD incarnation's extent was freed)
+    assert store.contains(oid)
+    store.delete(oid)
+
+
+def test_recreate_abort_with_old_readers(store):
+    """Aborting a recreation while old-incarnation readers are still
+    pinned must keep their extent alive and leave the id deleted."""
+    oid = b"A" * 20
+    store.put(oid, b"\x03" * 1024)
+    old_view = store.get(oid, 0)
+    store.delete(oid)
+    buf = store.create(oid, 1024)      # recreation begins...
+    buf.release()
+    store.abort(oid)                   # ...and is aborted mid-write
+    assert not store.contains(oid)
+    assert bytes(old_view[:8]) == b"\x03" * 8  # old reader unharmed
+    old_view.release()
+    store.release(oid)
