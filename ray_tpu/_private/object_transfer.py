@@ -303,10 +303,8 @@ class ObjectTransfer:
             return False
         now = time.monotonic()
         with self._pull_lock:
-            # expire abandoned partials (pusher died mid-transfer)
-            for k in [k for k, v in self._partials.items()
-                      if now - v.ts > self._PARTIAL_TTL_S]:
-                self._drop_partial_locked(k)
+            # (abandoned partials are reclaimed by the timer sweep in
+            # _seal_flush_loop — no per-chunk scan here)
             st = self._partials.get(oid)
             if offset == 0:
                 # a fresh stream RESTARTS assembly — a retried pusher (or

@@ -27,6 +27,11 @@ from ray_tpu.exceptions import GetTimeoutError, ObjectLostError
 
 _GET_CHUNK_MS = 500  # blocking-get slice so Ctrl-C stays responsive
 _EAGER_DELETE_MIN = int(os.environ.get("RTPU_EAGER_DELETE_MIN", 64 * 1024))
+# Puts at or below this serialize into a scratch buffer and ride the
+# store's one-round-trip OP_PUT instead of create/write/seal (see
+# store_client.py put); the extra copy is trivial next to the saved
+# daemon round trip.
+_INLINE_PUT_MAX = int(os.environ.get("RTPU_INLINE_PUT_MAX", 64 * 1024))
 
 
 class WorkerContext:
@@ -143,12 +148,17 @@ class WorkerContext:
         processes can resolve the ref) — called when a ref escapes this
         process or the memory store evicts."""
         try:
-            buf = self.store.create(oid, len(payload))
-            try:
-                buf[:len(payload)] = payload
-            finally:
-                buf.release()
-            self.store.seal(oid)
+            if len(payload) <= _INLINE_PUT_MAX:
+                self.store.put(oid, payload)  # one round trip
+            else:
+                # large promote: write into the mmap directly (no extra
+                # socket copy of a multi-MB payload)
+                buf = self.store.create(oid, len(payload))
+                try:
+                    buf[:len(payload)] = payload
+                finally:
+                    buf.release()
+                self.store.seal(oid)
         except FileExistsError:
             return  # already in the store
         except Exception:
@@ -371,18 +381,26 @@ class WorkerContext:
         oid = oid or ids.random_object_id()
         size, token = serialized_size(value)
         track_owned = track_owned and size >= _EAGER_DELETE_MIN
-        buf = self.store.create(oid, size)
-        try:
+        if size <= _INLINE_PUT_MAX:
+            # small object: serialize to a scratch buffer and ship it in
+            # ONE daemon round trip (OP_PUT) — create/seal round trips
+            # dominate small-put cost on a 1-core host
+            scratch = bytearray(size)
+            write_payload(memoryview(scratch), token)
+            self.store.put(oid, scratch)
+        else:
+            buf = self.store.create(oid, size)
             try:
-                write_payload(buf, token)
-            finally:
-                buf.release()
-            self.store.seal(oid)
-        except BaseException:
-            # Never leave an unsealed husk behind — it would wedge every
-            # consumer blocking on this id.
-            self.store.abort(oid)
-            raise
+                try:
+                    write_payload(buf, token)
+                finally:
+                    buf.release()
+                self.store.seal(oid)
+            except BaseException:
+                # Never leave an unsealed husk behind — it would wedge
+                # every consumer blocking on this id.
+                self.store.abort(oid)
+                raise
         if self._seal_notify is not None:
             self._seal_notify(oid)
         if track_owned:
@@ -508,11 +526,25 @@ class WorkerContext:
             return _MEMSTORE_FALLTHROUGH
         return deserialize(memoryview(entry.payload))
 
+    def _store_fetch(self, oid: bytes, timeout_ms: int):
+        """Fetch + deserialize from the shm store; _STORE_MISS when the
+        object is not available (a stored value may BE None).  Small
+        objects arrive as inline bytes (one round trip, nothing pinned);
+        large ones as a pinned zero-copy view released when the
+        deserialized arrays die."""
+        got = self.store.get_bytes(oid, timeout_ms)
+        if got is None:
+            return _STORE_MISS
+        if isinstance(got, memoryview):
+            return deserialize(
+                got, release_cb=lambda o=oid: self.store.release(o))
+        return deserialize(memoryview(got))
+
     def _get_object_inner(self, ref, oid, timeout: Optional[float]):
         # Fast path: already sealed, no block notification needed.
-        view = self.store.get(oid, 0)
-        if view is not None:
-            return deserialize(view, release_cb=lambda o=oid: self.store.release(o))
+        value = self._store_fetch(oid, 0)
+        if value is not _STORE_MISS:
+            return value
         deadline = None if timeout is None else time.monotonic() + timeout
         blocked = False
         next_pull = time.monotonic()
@@ -539,11 +571,9 @@ class WorkerContext:
                         raise ObjectLostError(
                             f"object {ref} was lost: every node holding a "
                             f"copy died", oid=oid)
-                view = self.store.get(oid, _GET_CHUNK_MS)
-                if view is not None:
-                    return deserialize(
-                        view, release_cb=lambda o=oid: self.store.release(o)
-                    )
+                value = self._store_fetch(oid, _GET_CHUNK_MS)
+                if value is not _STORE_MISS:
+                    return value
                 if deadline is not None and time.monotonic() >= deadline:
                     raise GetTimeoutError(
                         f"get timed out after {timeout}s waiting for {ref}"
@@ -633,7 +663,8 @@ class WorkerContext:
         return fn_id
 
 
-_MEMSTORE_FALLTHROUGH = object()  # sentinel: "check the shm store instead"
+_MEMSTORE_FALLTHROUGH = object()
+_STORE_MISS = object()  # store fetch miss (a stored value may be None)
 
 _global_worker: Optional[WorkerContext] = None
 

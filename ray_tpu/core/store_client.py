@@ -33,9 +33,31 @@ ST_TIMEOUT = 4
 ST_NOT_SEALED = 5
 ST_ERR = 6
 ST_EVICTED = 7
+ST_VIEW = 8  # GET_INLINE: too big to inline; pin kept, (offset, size) back
 
 _OP_CREATE, _OP_SEAL, _OP_GET, _OP_RELEASE = 1, 2, 3, 4
 _OP_DELETE, _OP_CONTAINS, _OP_STATS, _OP_ABORT = 5, 6, 7, 8
+_OP_PUT, _OP_GET_INLINE = 9, 10
+
+# Objects at or below this come back as inline bytes from GET_INLINE (one
+# round trip, daemon-side copy, no pin/RELEASE); bigger ones come back as
+# a pinned zero-copy mmap view in the SAME round trip (ST_VIEW).  The
+# copy is cheaper than pin bookkeeping well past this size on a 1-core
+# host, but views keep large reads zero-copy for jax.device_put.
+# Env-tunable alongside RTPU_INLINE_PUT_MAX so put/get stay symmetric.
+INLINE_GET_MAX = int(os.environ.get("RTPU_INLINE_GET_MAX", 64 * 1024))
+
+
+def _native_core():
+    """The _rtpu_core extension (shared gating with the direct-call
+    transport: disabled under RTPU_NATIVE_TRANSPORT=0 / RPC chaos so the
+    Python fallback path stays exercised), or None."""
+    try:
+        from ray_tpu._private.direct import native_core
+
+        return native_core()
+    except Exception:
+        return None
 
 
 class StoreFullError(Exception):
@@ -102,7 +124,11 @@ class StoreClient:
         self._socket_path = socket_path
         self._client_id = os.urandom(ID_LEN)  # server-side ref bookkeeping key
         self._pool_lock = threading.Lock()
-        self._pool: list[socket.socket] = [self._dial(timeout=10)]
+        # pool entries: (socket, native StoreConn | None).  The native conn
+        # runs the per-op pack/send/recv in C with the GIL released
+        # (native/core_worker.cc StoreConn); the Python path remains the
+        # fallback when the extension is unavailable or chaos-disabled.
+        self._pool: list = [self._dial(timeout=10)]
         shm_file = f"/dev/shm/{shm_name.lstrip('/')}"
         fd = os.open(shm_file, os.O_RDWR)
         try:
@@ -110,42 +136,67 @@ class StoreClient:
         finally:
             os.close(fd)
 
-    def _dial(self, timeout: float = 2.0) -> socket.socket:
+    def _dial(self, timeout: float = 2.0):
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         deadline = time.monotonic() + timeout
         while True:
             try:
                 sock.connect(self._socket_path)
                 sock.sendall(self._client_id)  # handshake
-                return sock
+                break
             except OSError:
                 if time.monotonic() > deadline:
                     raise
                 time.sleep(0.05)
+        nc = None
+        core = _native_core()
+        if core is not None:
+            nc = core.StoreConn(sock.fileno())
+        return sock, nc
+
+    @staticmethod
+    def _oid20(oid: bytes) -> bytes:
+        # struct's "20s" silently truncates/pads; keep that behavior for
+        # the native path too
+        return oid if len(oid) == ID_LEN else oid[:ID_LEN].ljust(ID_LEN,
+                                                                 b"\x00")
+
+    def _checkout(self):
+        with self._pool_lock:
+            entry = self._pool.pop() if self._pool else None
+        return entry if entry is not None else self._dial()
+
+    def _checkin(self, entry):
+        with self._pool_lock:
+            if len(self._pool) < 8:
+                self._pool.append(entry)
+                return
+        entry[0].close()
+
+    @staticmethod
+    def _recv_exact(sock, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("object store connection closed")
+            buf += chunk
+        return buf
 
     def _call(self, op: int, oid: bytes, arg0: int = 0, arg1: int = 0):
-        req = _REQ.pack(op, oid, arg0, arg1)
-        with self._pool_lock:
-            sock = self._pool.pop() if self._pool else None
-        if sock is None:
-            sock = self._dial()
+        entry = self._checkout()
+        sock, nc = entry
         try:
-            sock.sendall(req)
-            buf = b""
-            while len(buf) < _RESP.size:
-                chunk = sock.recv(_RESP.size - len(buf))
-                if not chunk:
-                    raise ConnectionError("object store connection closed")
-                buf += chunk
+            if nc is not None:
+                out = nc.call(op, self._oid20(oid), arg0, arg1)
+            else:
+                sock.sendall(_REQ.pack(op, oid, arg0, arg1))
+                out = _RESP.unpack(self._recv_exact(sock, _RESP.size))
         except BaseException:
             sock.close()
             raise
-        with self._pool_lock:
-            if len(self._pool) < 8:
-                self._pool.append(sock)
-            else:
-                sock.close()
-        return _RESP.unpack(buf)
+        self._checkin(entry)
+        return out
 
     def create(self, oid: bytes, size: int) -> memoryview:
         """Allocate space; returns a writable view. Must seal() after writing."""
@@ -164,9 +215,78 @@ class StoreClient:
             raise RuntimeError(f"seal failed: status={status}")
 
     def put(self, oid: bytes, data) -> None:
-        buf = self.create(oid, len(data))
-        buf[:] = data
-        self.seal(oid)
+        """Create + write + seal in ONE daemon round trip (OP_PUT): the
+        payload rides the request stream and the daemon writes it into
+        the fresh extent itself.  Two round trips (create, seal) were 83%
+        of a small put's cost — each is a client<->daemon context switch
+        on a 1-core host."""
+        data = bytes(data) if not isinstance(data, (bytes, bytearray,
+                                                    memoryview)) else data
+        entry = self._checkout()
+        sock, nc = entry
+        try:
+            if nc is not None:
+                status = nc.put(self._oid20(oid), data)
+            else:
+                req = _REQ.pack(_OP_PUT, oid, len(data), 0)
+                if len(data) <= 65536:
+                    sock.sendall(req + bytes(data))  # one syscall
+                else:
+                    sock.sendall(req)
+                    sock.sendall(data)
+                status, _, _ = _RESP.unpack(
+                    self._recv_exact(sock, _RESP.size))
+        except BaseException:
+            sock.close()
+            raise
+        self._checkin(entry)
+        if status == ST_OOM:
+            raise StoreFullError(
+                f"object store full allocating {len(data)} bytes")
+        if status == ST_EXISTS:
+            raise FileExistsError(f"object {oid.hex()} already exists")
+        if status != ST_OK:
+            raise RuntimeError(f"put failed: status={status}")
+
+    def get_bytes(self, oid: bytes, timeout_ms: int = 0):
+        """Like get() but always ONE round trip: small objects come back
+        as bytes with NO pin (nothing to release); larger objects answer
+        ST_VIEW with the pin kept and (offset, size), mapped here into
+        the usual zero-copy view.
+
+        Returns bytes | memoryview | None.  Callers must only release()
+        when the result is a memoryview.
+        """
+        entry = self._checkout()
+        sock, nc = entry
+        try:
+            if nc is not None:
+                status, inline, size, data = nc.get_inline(
+                    self._oid20(oid), timeout_ms, INLINE_GET_MAX)
+            else:
+                sock.sendall(
+                    _REQ.pack(_OP_GET_INLINE, oid, timeout_ms,
+                              INLINE_GET_MAX))
+                status, inline, size = _RESP.unpack(
+                    self._recv_exact(sock, _RESP.size))
+                data = (self._recv_exact(sock, size)
+                        if status == ST_OK and inline == 1 else None)
+        except BaseException:
+            sock.close()
+            raise
+        self._checkin(entry)
+        if status in (ST_NOT_FOUND, ST_NOT_SEALED, ST_TIMEOUT):
+            return None
+        if status == ST_EVICTED:
+            raise ObjectEvictedError(
+                f"object {oid.hex()[:12]} was evicted from the store")
+        if status == ST_VIEW:  # pinned view handed back in-round-trip
+            return memoryview(self._mm)[inline : inline + size]
+        if status != ST_OK:
+            raise RuntimeError(f"get failed: status={status}")
+        if inline:
+            return data
+        return self.get(oid, timeout_ms)
 
     def get(self, oid: bytes, timeout_ms: int = 0):
         """Return a zero-copy memoryview of a sealed object, or None.
@@ -210,8 +330,8 @@ class StoreClient:
 
     def close(self):
         with self._pool_lock:
-            socks, self._pool = self._pool, []
-        for sock in socks:
+            entries, self._pool = self._pool, []
+        for sock, _ in entries:
             try:
                 sock.close()
             except OSError:

@@ -636,11 +636,15 @@ class _AvroEncoder:
     def encode(self, value, schema, names: Dict[str, Any]):
         import struct as _struct
 
-        if isinstance(schema, list):  # union: first branch accepting value
-            for i, branch in enumerate(schema):
-                if _avro_union_match(value, branch, names):
-                    self.long(i)
-                    return self.encode(value, branch, names)
+        if isinstance(schema, list):  # union
+            # exact-type branch first (an int must bind to a long branch
+            # before a double one, or precision silently drops), then the
+            # lenient pass (int widening into a double-only union)
+            for lenient in (False, True):
+                for i, branch in enumerate(schema):
+                    if _avro_union_match(value, branch, names, lenient):
+                        self.long(i)
+                        return self.encode(value, branch, names)
             raise ValueError(f"no union branch for {type(value)} in {schema}")
         if isinstance(schema, dict):
             t = schema["type"]
@@ -700,7 +704,8 @@ class _AvroEncoder:
         raise ValueError(f"unsupported avro schema {schema!r}")
 
 
-def _avro_union_match(value, branch, names: Dict[str, Any]) -> bool:
+def _avro_union_match(value, branch, names: Dict[str, Any],
+                      lenient: bool = False) -> bool:
     b = branch["type"] if isinstance(branch, dict) else branch
     if b in names and not isinstance(branch, dict):
         branch = names[b]
@@ -713,7 +718,16 @@ def _avro_union_match(value, branch, names: Dict[str, Any]) -> bool:
         return isinstance(value, bool)
     if b in ("int", "long"):
         return isinstance(value, int) and not isinstance(value, bool)
-    if b in ("float", "double"):
+    if b == "double":
+        # lenient: an int may widen into a double branch (a nullable
+        # column inferred as ["null","double"] still holds ints) — but
+        # only after the exact pass proved there is no integer branch
+        if lenient:
+            return (isinstance(value, (int, float))
+                    and not isinstance(value, bool))
+        return isinstance(value, float)
+    if b == "float":
+        # never bind ints to float32 — silent precision loss
         return isinstance(value, float)
     if b == "string":
         return isinstance(value, str)

@@ -65,10 +65,14 @@ def _table_metadata(table_dir: str) -> dict:
         path = os.path.join(meta_dir, f"v{v}.metadata.json")
     else:
         def version_of(f: str) -> int:
-            # numeric sort: lexicographic would pick v9 over v10
-            stem = f[:-len(".metadata.json")]
-            digits = "".join(ch for ch in stem if ch.isdigit())
-            return int(digits) if digits else -1
+            # numeric sort on the LEADING digit run only: names are
+            # v{N}.metadata.json or {NNNNN}-{uuid}.metadata.json, and
+            # digits inside the uuid must not contaminate the version
+            stem = f[:-len(".metadata.json")].lstrip("v")
+            n = 0
+            while n < len(stem) and stem[n].isdigit():
+                n += 1
+            return int(stem[:n]) if n else -1
 
         cands = sorted(
             (f for f in os.listdir(meta_dir)

@@ -45,6 +45,10 @@ def _parse_avi(buf: bytes) -> Tuple[List[bytes], dict]:
         raise ValueError("not an AVI (RIFF/'AVI ') file")
     frames: List[bytes] = []
     fmt = {"compression": None, "width": 0, "height": 0, "bpp": 24}
+    # strf binds to the PRECEDING strh's stream type: in a file whose
+    # first stream is audio, the first strf is a WAVEFORMATEX, not the
+    # video BITMAPINFOHEADER
+    cur_stream = {"is_video": False}
 
     def walk(start: int, end: int):
         for fourcc, off, size in _riff_chunks(buf, start, end):
@@ -52,7 +56,10 @@ def _parse_avi(buf: bytes) -> Tuple[List[bytes], dict]:
                 ltype = buf[off:off + 4]
                 if ltype in (b"hdrl", b"movi", b"strl", b"rec "):
                     walk(off + 4, off + size)
-            elif fourcc == b"strf" and fmt["compression"] is None:
+            elif fourcc == b"strh":
+                cur_stream["is_video"] = buf[off:off + 4] == b"vids"
+            elif fourcc == b"strf" and cur_stream["is_video"] \
+                    and fmt["compression"] is None:
                 # BITMAPINFOHEADER: width i32 @4, height i32 @8,
                 # bitcount u16 @14, compression u32 @16
                 if size >= 20:

@@ -691,6 +691,215 @@ static PyTypeObject ServerType = {
     PyVarObject_HEAD_INIT(nullptr, 0)
 };
 
+// ---------- StoreConn (native shm-store client op layer) ----------
+//
+// One pooled connection to the shm_store daemon (protocol of
+// shm_store.cc: fixed 37-byte request / 17-byte response, with OP_PUT
+// payload streaming and OP_GET_INLINE payload returns).  The Python
+// StoreClient keeps the pool + mmap; each checked-out socket is wrapped
+// in a StoreConn so the per-op pack/send/recv runs in C with the GIL
+// released — on the multi-client put path the Python per-op overhead is
+// comparable to the daemon round trip itself.
+
+struct StoreConnCore {
+  int fd = -1;
+  bool dead = false;
+};
+
+typedef struct {
+  PyObject_HEAD
+  StoreConnCore* core;
+} StoreConnObject;
+
+static PyObject* StoreConn_new(PyTypeObject* type, PyObject* args,
+                               PyObject* kwds) {
+  int fd;
+  if (!PyArg_ParseTuple(args, "i", &fd)) return nullptr;
+  StoreConnObject* self = (StoreConnObject*)type->tp_alloc(type, 0);
+  if (!self) return nullptr;
+  self->core = new StoreConnCore();
+  self->core->fd = fd;
+  return (PyObject*)self;
+}
+
+static void StoreConn_dealloc(StoreConnObject* self) {
+  if (self->core) {
+    // fd ownership stays with the Python socket object that dialed it
+    delete self->core;
+    self->core = nullptr;
+  }
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+static bool recv_full(int fd, char* p, size_t n) {
+  while (n > 0) {
+    ssize_t k = ::recv(fd, p, n, 0);
+    if (k <= 0) {
+      if (k < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += k;
+    n -= size_t(k);
+  }
+  return true;
+}
+
+constexpr size_t kStoreIdLen = 20;
+constexpr size_t kStoreReqLen = 1 + kStoreIdLen + 8 + 8;
+constexpr size_t kStoreRespLen = 1 + 8 + 8;
+
+static void pack_store_req(char* req, uint8_t op, const char* oid,
+                           uint64_t a0, uint64_t a1) {
+  req[0] = char(op);
+  memcpy(req + 1, oid, kStoreIdLen);
+  memcpy(req + 1 + kStoreIdLen, &a0, 8);
+  memcpy(req + 1 + kStoreIdLen + 8, &a1, 8);
+}
+
+// call(op, oid, a0, a1) -> (status, r0, r1)
+static PyObject* StoreConn_call(StoreConnObject* self, PyObject* args) {
+  int op;
+  Py_buffer oid;
+  unsigned long long a0, a1;
+  if (!PyArg_ParseTuple(args, "iy*KK", &op, &oid, &a0, &a1)) return nullptr;
+  if (oid.len != Py_ssize_t(kStoreIdLen)) {
+    PyBuffer_Release(&oid);
+    PyErr_SetString(PyExc_ValueError, "oid must be 20 bytes");
+    return nullptr;
+  }
+  StoreConnCore* c = self->core;
+  char req[kStoreReqLen], resp[kStoreRespLen];
+  pack_store_req(req, uint8_t(op), (const char*)oid.buf, a0, a1);
+  bool ok = false;
+  Py_BEGIN_ALLOW_THREADS
+  ok = !c->dead && send_all(c->fd, req, kStoreReqLen) &&
+       recv_full(c->fd, resp, kStoreRespLen);
+  if (!ok) c->dead = true;
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&oid);
+  if (!ok) {
+    PyErr_SetString(PyExc_ConnectionError, "object store connection closed");
+    return nullptr;
+  }
+  uint64_t r0, r1;
+  memcpy(&r0, resp + 1, 8);
+  memcpy(&r1, resp + 1 + 8, 8);
+  return Py_BuildValue("(iKK)", int(uint8_t(resp[0])),
+                       (unsigned long long)r0, (unsigned long long)r1);
+}
+
+// put(oid, payload) -> status  (request + payload in one send when small)
+static PyObject* StoreConn_put(StoreConnObject* self, PyObject* args) {
+  Py_buffer oid, payload;
+  if (!PyArg_ParseTuple(args, "y*y*", &oid, &payload)) return nullptr;
+  if (oid.len != Py_ssize_t(kStoreIdLen)) {
+    PyBuffer_Release(&oid);
+    PyBuffer_Release(&payload);
+    PyErr_SetString(PyExc_ValueError, "oid must be 20 bytes");
+    return nullptr;
+  }
+  StoreConnCore* c = self->core;
+  bool ok = false;
+  char resp[kStoreRespLen];
+  Py_BEGIN_ALLOW_THREADS
+  if (!c->dead) {
+    if (size_t(payload.len) <= 65536 - kStoreReqLen) {
+      char buf[65536];
+      pack_store_req(buf, 9 /*OP_PUT*/, (const char*)oid.buf,
+                     uint64_t(payload.len), 0);
+      memcpy(buf + kStoreReqLen, payload.buf, size_t(payload.len));
+      ok = send_all(c->fd, buf, kStoreReqLen + size_t(payload.len));
+    } else {
+      char req[kStoreReqLen];
+      pack_store_req(req, 9, (const char*)oid.buf, uint64_t(payload.len), 0);
+      ok = send_all(c->fd, req, kStoreReqLen) &&
+           send_all(c->fd, (const char*)payload.buf, size_t(payload.len));
+    }
+    ok = ok && recv_full(c->fd, resp, kStoreRespLen);
+    if (!ok) c->dead = true;
+  }
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&oid);
+  PyBuffer_Release(&payload);
+  if (!ok) {
+    PyErr_SetString(PyExc_ConnectionError, "object store connection closed");
+    return nullptr;
+  }
+  return PyLong_FromLong(long(uint8_t(resp[0])));
+}
+
+// get_inline(oid, timeout_ms, cap) -> (status, r0, r1, payload|None)
+static PyObject* StoreConn_get_inline(StoreConnObject* self, PyObject* args) {
+  Py_buffer oid;
+  unsigned long long timeout_ms, cap;
+  if (!PyArg_ParseTuple(args, "y*KK", &oid, &timeout_ms, &cap))
+    return nullptr;
+  if (oid.len != Py_ssize_t(kStoreIdLen)) {
+    PyBuffer_Release(&oid);
+    PyErr_SetString(PyExc_ValueError, "oid must be 20 bytes");
+    return nullptr;
+  }
+  StoreConnCore* c = self->core;
+  char req[kStoreReqLen], resp[kStoreRespLen];
+  pack_store_req(req, 10 /*OP_GET_INLINE*/, (const char*)oid.buf,
+                 timeout_ms, cap);
+  bool ok = false;
+  Py_BEGIN_ALLOW_THREADS
+  ok = !c->dead && send_all(c->fd, req, kStoreReqLen) &&
+       recv_full(c->fd, resp, kStoreRespLen);
+  if (!ok) c->dead = true;
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&oid);
+  if (!ok) {
+    PyErr_SetString(PyExc_ConnectionError, "object store connection closed");
+    return nullptr;
+  }
+  int status = int(uint8_t(resp[0]));
+  uint64_t r0, r1;
+  memcpy(&r0, resp + 1, 8);
+  memcpy(&r1, resp + 1 + 8, 8);
+  if (status == 0 /*ST_OK*/ && r0 == 1) {
+    // inline payload follows: read straight into a fresh bytes object
+    PyObject* data = PyBytes_FromStringAndSize(nullptr, Py_ssize_t(r1));
+    if (!data) return nullptr;
+    bool ok2 = false;
+    char* dst = PyBytes_AS_STRING(data);
+    Py_BEGIN_ALLOW_THREADS
+    ok2 = recv_full(c->fd, dst, size_t(r1));
+    if (!ok2) c->dead = true;
+    Py_END_ALLOW_THREADS
+    if (!ok2) {
+      Py_DECREF(data);
+      PyErr_SetString(PyExc_ConnectionError,
+                      "object store connection closed");
+      return nullptr;
+    }
+    PyObject* out = Py_BuildValue("(iKKN)", status, (unsigned long long)r0,
+                                  (unsigned long long)r1, data);
+    return out;
+  }
+  return Py_BuildValue("(iKKO)", status, (unsigned long long)r0,
+                       (unsigned long long)r1, Py_None);
+}
+
+static PyObject* StoreConn_is_dead(StoreConnObject* self, PyObject*) {
+  return PyBool_FromLong(self->core->dead);
+}
+
+static PyMethodDef StoreConn_methods[] = {
+    {"call", (PyCFunction)StoreConn_call, METH_VARARGS,
+     "call(op, oid, a0, a1) -> (status, r0, r1)"},
+    {"put", (PyCFunction)StoreConn_put, METH_VARARGS,
+     "put(oid, payload) -> status (create+copy+seal, one round trip)"},
+    {"get_inline", (PyCFunction)StoreConn_get_inline, METH_VARARGS,
+     "get_inline(oid, timeout_ms, cap) -> (status, r0, r1, bytes|None)"},
+    {"is_dead", (PyCFunction)StoreConn_is_dead, METH_NOARGS, ""},
+    {nullptr, nullptr, 0, nullptr}};
+
+static PyTypeObject StoreConnType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+};
+
 // ---------- module ----------
 
 static PyModuleDef rtpu_core_module = {
@@ -719,11 +928,22 @@ PyMODINIT_FUNC PyInit__rtpu_core(void) {
   ServerType.tp_doc = "Callee-side epoll frame server (threadless)";
   if (PyType_Ready(&ServerType) < 0) return nullptr;
 
+  StoreConnType.tp_name = "_rtpu_core.StoreConn";
+  StoreConnType.tp_basicsize = sizeof(StoreConnObject);
+  StoreConnType.tp_flags = Py_TPFLAGS_DEFAULT;
+  StoreConnType.tp_new = StoreConn_new;
+  StoreConnType.tp_dealloc = (destructor)StoreConn_dealloc;
+  StoreConnType.tp_methods = StoreConn_methods;
+  StoreConnType.tp_doc = "Native shm-store client op layer (GIL-free I/O)";
+  if (PyType_Ready(&StoreConnType) < 0) return nullptr;
+
   PyObject* m = PyModule_Create(&rtpu_core_module);
   if (!m) return nullptr;
   Py_INCREF(&ChannelType);
   PyModule_AddObject(m, "Channel", (PyObject*)&ChannelType);
   Py_INCREF(&ServerType);
   PyModule_AddObject(m, "Server", (PyObject*)&ServerType);
+  Py_INCREF(&StoreConnType);
+  PyModule_AddObject(m, "StoreConn", (PyObject*)&StoreConnType);
   return m;
 }

@@ -224,3 +224,30 @@ def test_recreate_abort_with_old_readers(store):
     assert bytes(old_view[:8]) == b"\x03" * 8  # old reader unharmed
     old_view.release()
     store.release(oid)
+
+
+def test_get_bytes_inline_and_view(store):
+    """get_bytes: small objects come back as UNPINNED inline bytes,
+    large ones as a pinned zero-copy view — both in one round trip."""
+    from ray_tpu.core.store_client import INLINE_GET_MAX
+
+    small = b"s" * 20
+    store.put(small, b"\x05" * 100)
+    got = store.get_bytes(small, 1000)
+    assert isinstance(got, bytes) and got == b"\x05" * 100
+    # no pin left behind: delete must free immediately (no deferred husk)
+    store.delete(small)
+    assert not store.contains(small)
+
+    big = b"b" * 20
+    payload = b"\x06" * (INLINE_GET_MAX + 1)
+    store.put(big, payload)
+    view = store.get_bytes(big, 1000)
+    assert isinstance(view, memoryview)
+    assert bytes(view[:4]) == b"\x06\x06\x06\x06" and len(view) == len(payload)
+    # the view IS a pin: a delete while held defers (object invisible)
+    store.delete(big)
+    assert not store.contains(big)
+    assert bytes(view[-4:]) == b"\x06\x06\x06\x06"  # extent still intact
+    view.release()
+    store.release(big)
