@@ -77,16 +77,22 @@ def choose_victim(workers) -> Optional[object]:
     def task_started(w):
         # newest in-flight task approximated by insertion order (dicts
         # preserve it); the last entry is the most recently dispatched
-        return len(w.in_flight)
+        return len(w.in_flight) + getattr(w, "native_inflight", 0)
 
-    candidates = [w for w in workers
-                  if w.alive and w.in_flight and w.proc is not None]
+    candidates = [
+        w for w in workers
+        if w.alive and w.proc is not None
+        and (w.in_flight or getattr(w, "native_inflight", 0))]
     if not candidates:
         return None
 
     def rank(w):
         specs = list(w.in_flight.values())
-        retriable = any(getattr(s, "retries_left", 0) > 0 for s in specs)
+        # Native-lane tasks count as retriable plain work: the orphan
+        # reap applies the real per-spec retry policy after the kill.
+        retriable = (getattr(w, "native_inflight", 0) > 0
+                     or any(getattr(s, "retries_left", 0) > 0
+                            for s in specs))
         is_actor = w.actor_id is not None
         # sort ascending; kill the FIRST: retriable plain workers first
         # (0), then non-retriable plain (1), then actors (2); newest

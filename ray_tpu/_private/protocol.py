@@ -110,19 +110,31 @@ class Connection:
             self.sock.sendall(frame)
 
     def recv(self) -> dict | None:
-        """Receive one message; None on clean EOF."""
+        """Receive one pickled message; None on clean EOF or when the
+        frame was a binary-dialect frame (callers of recv() never expect
+        those)."""
+        kind, msg = self.recv_any()
+        return msg if kind == "msg" else None
+
+    def recv_any(self):
+        """Receive one message of EITHER dialect: ("msg", dict) for
+        pickled frames (first byte 0x80, the pickle protocol marker),
+        ("raw", bytes) for binary node-service frames (0x10-0x13 raylet
+        lane), or (None, None) on clean EOF."""
         if _CHAOS_RECV and _chaos_rng.random() < _CHAOS_RECV:
             # raise (not clean-EOF None): dispatch loops must hit their
             # error/crash-recovery paths, not their graceful-shutdown path
             raise ConnectionResetError("rpc chaos: injected recv failure")
         header = self._recv_exact(_LEN.size)
         if header is None:
-            return None
+            return None, None
         (length,) = _LEN.unpack(header)
         body = self._recv_exact(length)
         if body is None:
-            return None
-        return pickle.loads(body)
+            return None, None
+        if body[:1] == b"\x80":
+            return "msg", pickle.loads(body)
+        return "raw", body
 
     def send_bytes(self, data: bytes):
         """Send one raw frame (no pickling) — pre-auth handshakes."""

@@ -49,6 +49,7 @@ from ray_tpu._private.task_spec import (  # noqa: F401  (re-exported surface)
     MAX_SPILLS,
     TASK,
     TaskSpec,
+    is_plain_task,
 )
 from ray_tpu._private.worker_pool import WorkerPool, WorkerState
 from ray_tpu.core.store_client import StoreClient
@@ -103,6 +104,10 @@ class _NativeConnShim:
     def __init__(self, srv, conn_id: int):
         self._srv = srv
         self._cid = conn_id
+
+    @property
+    def conn_id(self) -> int:
+        return self._cid
 
     def send(self, msg: dict):
         import pickle as _pickle
@@ -277,12 +282,30 @@ class Scheduler:
         from ray_tpu._private import direct as direct_mod
 
         self._node_srv = None
+        # Native raylet lane (core_worker.cc RayletCore): plain-task
+        # dispatch + the node resource ledger live in C++; Python keeps
+        # policy (PGs, affinity, actors, retries, spillback).  The ledger
+        # is SINGLE-OWNER — every Python resource acquire/release routes
+        # through _res_* so the two lanes cannot drift.
+        self._raylet_native = False
+        self._lane_accept = False  # plain submits ride the native lane
+        self._conn_workers: dict[int, WorkerState] = {}
+        self._last_grow_check = 0.0
         core = direct_mod.native_core()
         if core is not None:
             token = cluster_token() if self._is_tcp else ""
             self._node_srv = core.Server(
                 self._listener.detach(), int(self._is_tcp),
                 token.encode("utf-8"))
+            if os.environ.get("RTPU_NATIVE_RAYLET", "1") != "0":
+                self._node_srv.raylet_enable(
+                    {k: float(v) for k, v in node_resources.items()})
+                self._raylet_native = True
+                # head nodes start single-node (lane on); worker nodes are
+                # by definition multi-node (policy path, so spillback and
+                # PG routing still apply) — the heartbeat keeps this fresh
+                self._lane_accept = is_head
+                self._node_srv.raylet_set_accept(self._lane_accept)
             self._accept_thread = threading.Thread(
                 target=self._native_serve_loop, name="sched-serve",
                 daemon=True)
@@ -323,9 +346,67 @@ class Scheduler:
         return node
 
     # ------------------------------------------------------------------
+    # Node resource ledger.  With the native raylet the C++ side is the
+    # single owner (its dispatch loop deducts without the Python lock);
+    # these four methods are the ONLY way Python touches availability.
+    # Callers hold self._lock on the fallback path, preserving atomicity.
+    # ------------------------------------------------------------------
+    def _res_try_acquire(self, need: dict) -> bool:
+        if self._raylet_native:
+            return bool(self._node_srv.raylet_try_acquire(
+                {k: float(v) for k, v in need.items()}))
+        if any(self.available.get(k, 0) < v for k, v in need.items()):
+            return False
+        for k, v in need.items():
+            self.available[k] -= v
+        return True
+
+    def _res_release(self, res: dict):
+        if not res:
+            return
+        if self._raylet_native:
+            self._node_srv.raylet_release(
+                {k: float(v) for k, v in res.items()})
+            return
+        for k, v in res.items():
+            self.available[k] = self.available.get(k, 0) + v
+
+    def _res_force_acquire(self, res: dict):
+        if not res:
+            return
+        if self._raylet_native:
+            self._node_srv.raylet_force_acquire(
+                {k: float(v) for k, v in res.items()})
+            return
+        for k, v in res.items():
+            self.available[k] = self.available.get(k, 0) - v
+
+    def _res_snapshot(self) -> dict:
+        if self._raylet_native:
+            return self._node_srv.raylet_snapshot()
+        return dict(self.available)
+
+    # ------------------------------------------------------------------
     # Public API (called from the driver thread and from worker readers)
     # ------------------------------------------------------------------
     def submit(self, spec: TaskSpec):
+        # Fast lane: plain stateless tasks go straight into the native
+        # raylet queue — no Python scheduler state, no lock.  Dispatch,
+        # resource accounting, and completion run in C++ (see
+        # core_worker.cc); Python sees the task again only if its worker
+        # dies (orphan reap -> retry policy).
+        if (self._lane_accept and not self._draining
+                and is_plain_task(spec)):
+            spec.retries_left = spec.max_retries
+            import pickle
+
+            self._node_srv.raylet_submit(
+                spec.task_id,
+                float((spec.resources or {}).get("CPU", 0)),
+                spec.name or "",
+                pickle.dumps(spec, protocol=5))
+            self._maybe_grow_native()
+            return
         with self._lock:
             if self._shutdown:
                 return
@@ -427,12 +508,58 @@ class Scheduler:
 
     def list_task_events(self) -> list[dict]:
         with self._lock:
+            self._merge_native_events_locked()
             return [dict(e) for e in self._task_events.values()]
+
+    def _merge_native_events_locked(self):
+        """Fold the native raylet's task-event ring into the Python table
+        (lazy: drained on state-API queries, never on the hot path)."""
+        if not self._raylet_native:
+            return
+        try:
+            drained = self._node_srv.raylet_drain_events()
+        except Exception:
+            return
+        _STATES = {0: "PENDING", 1: "RUNNING", 2: "FINISHED", 3: "FAILED"}
+        for tid, name, state_i, ts in drained:
+            state = _STATES.get(state_i, "PENDING")
+            ev = self._task_events.get(tid)
+            if ev is None:
+                if len(self._task_events) >= self._task_events_cap:
+                    drop = [t for t, e in self._task_events.items()
+                            if e["state"] in ("FINISHED", "FAILED",
+                                              "FORWARDED")][
+                        :max(1, self._task_events_cap // 10)]
+                    for t in drop:
+                        del self._task_events[t]
+                ev = {"task_id": tid, "name": name, "kind": TASK,
+                      "state": state, "submitted_ts": ts, "start_ts": None,
+                      "end_ts": None, "worker_id": None, "actor_id": None,
+                      "ok": None}
+                self._task_events[tid] = ev
+            ev["state"] = state
+            if state == "RUNNING" and ev["start_ts"] is None:
+                ev["start_ts"] = ts
+            elif state in ("FINISHED", "FAILED"):
+                ev["end_ts"] = ts
+                ev["ok"] = state == "FINISHED"
+                exporter = getattr(self, "_event_exporter", None)
+                if exporter is None:
+                    from ray_tpu.util.events import get_exporter
+
+                    exporter = get_exporter()
+                if exporter is not None:
+                    try:
+                        exporter.export_task_event(dict(ev))
+                    except Exception:
+                        pass
 
     def cancel(self, task_id: bytes, force: bool = False) -> bool:
         """Cancel a pending task; with force, kill the running worker too."""
         with self._lock:
             spec = self._task_index.get(task_id)
+            if spec is None and self._raylet_native:
+                return self._cancel_native_locked(task_id, force)
             if spec is None:
                 return False
             if spec in self._pending:
@@ -449,6 +576,76 @@ class Scheduler:
                         self._pool.terminate_worker(w)
                         return True
             return False
+
+    def _steal_native_pending(self):
+        """Move the native queue onto the Python pending deque (load-aware
+        placement + spillback apply from here on)."""
+        import pickle
+
+        try:
+            frames = self._node_srv.raylet_steal_pending()
+        except Exception:
+            return
+        if not frames:
+            return
+        with self._lock:
+            for frame in frames:
+                try:
+                    tl = frame[1]
+                    spec = pickle.loads(frame[2 + tl:])
+                except Exception:
+                    continue
+                self._pending.append(spec)
+                self._task_index[spec.task_id] = spec
+                self._record_task_event_locked(spec, "PENDING")
+            self._wake.notify_all()
+
+    def _fail_native_infeasible(self):
+        """Fail native-lane tasks whose CPU demand exceeds node totals
+        (the Python lane raises the same class of error at acquire)."""
+        import pickle
+
+        try:
+            frames = self._node_srv.raylet_drain_infeasible()
+        except Exception:
+            return
+        for frame in frames:
+            try:
+                tl = frame[1]
+                spec = pickle.loads(frame[2 + tl:])
+            except Exception:
+                continue
+            self._fail_task(spec, ValueError(
+                f"task {spec.name} requests {spec.resources} but this "
+                f"node's total resources are {self.total_resources}; "
+                f"no node can ever satisfy it"))
+
+    def _cancel_native_locked(self, task_id: bytes, force: bool) -> bool:
+        """Cancel a native-lane task: queued tasks are pulled out of the
+        C++ queue and failed; running ones are force-killable via their
+        worker (the orphan reap then fails them as cancelled)."""
+        import pickle
+
+        try:
+            state, conn_id, frame = self._node_srv.raylet_cancel(task_id)
+        except Exception:
+            return False
+        if state == 1:
+            try:
+                tl = frame[1]
+                spec = pickle.loads(frame[2 + tl:])
+            except Exception:
+                return True  # removed from the queue either way
+            self._fail_task(spec, TaskCancelledError(
+                f"task {spec.name} cancelled"))
+            return True
+        if state == 2 and force:
+            w = self._conn_workers.get(conn_id)
+            if w is not None and w.actor_id is None and w.proc is not None:
+                self._cancelled.add(task_id)
+                self._pool.terminate_worker(w)
+                return True
+        return False
 
     def _cancel_remote(self, task_id: bytes, force: bool) -> bool:
         """Relay a cancel to the node a spec was forwarded to."""
@@ -577,7 +774,7 @@ class Scheduler:
         policy.  Reads the GCS directly (not the heartbeat-cached view): PG
         creation is rare and must see nodes that joined in the last tick."""
         with self._lock:
-            avail: dict[bytes, dict] = {self.node_id: dict(self.available)}
+            avail: dict[bytes, dict] = {self.node_id: self._res_snapshot()}
         try:
             nodes = {n.node_id: n for n in self.gcs.list_nodes()}
             self._cluster_nodes = nodes
@@ -597,11 +794,8 @@ class Scheduler:
             for b in bundles.values():
                 for k, v in b.items():
                     need[k] = need.get(k, 0) + v
-            for k, v in need.items():
-                if self.available.get(k, 0) < v:
-                    return False
-            for k, v in need.items():
-                self.available[k] -= v
+            if not self._res_try_acquire(need):
+                return False
             pg = self._pgs.get(pg_id)
             if pg is None:
                 pg = PlacementGroupState(pg_id, {}, strategy)
@@ -618,9 +812,11 @@ class Scheduler:
             pg = self._pgs.pop(pg_id, None)
             if pg is None:
                 return
+            freed: dict[str, float] = {}
             for b in pg.bundles.values():
                 for k, v in b.items():
-                    self.available[k] = self.available.get(k, 0) + v
+                    freed[k] = freed.get(k, 0) + v
+            self._res_release(freed)
             self._wake.notify_all()
 
     def _reconcile_pgs(self):
@@ -674,13 +870,16 @@ class Scheduler:
                 "pending_demand": [
                     dict(s.resources or {}) for s in list(self._pending)[:512]
                 ],
-                "available_resources": dict(self.available),
+                "available_resources": self._res_snapshot(),
                 "total_resources": dict(self.total_resources),
             }
 
     def shutdown(self):
         with self._lock:
             self._shutdown = True
+            # flush native task events so terminal records reach the
+            # export pipeline before the server dies
+            self._merge_native_events_locked()
             self._wake.notify_all()
         if self._memory_monitor is not None:
             self._memory_monitor.shutdown()
@@ -714,6 +913,92 @@ class Scheduler:
             threading.Thread(target=self._reader_loop, args=(conn,),
                              daemon=True).start()
 
+    def _maybe_grow_native(self):
+        """Pool growth check for the native lane (rate-limited: C++ queues
+        without Python seeing per-task traffic, so growth is polled)."""
+        now = time.monotonic()
+        if now - self._last_grow_check < 0.2:
+            return
+        self._last_grow_check = now
+        try:
+            st = self._node_srv.raylet_stats()
+        except Exception:
+            return
+        if st["pending"] > 0 and st["idle"] == 0:
+            with self._lock:
+                self._pool.maybe_grow()
+
+    def _find_idle_worker(self) -> Optional[WorkerState]:
+        """Python-lane worker lease.  With the native raylet, C++ owns the
+        idle pool (its dispatch loop and this path draw from the same
+        queue, so a worker can never be double-booked)."""
+        if not self._raylet_native:
+            return self._pool.find_idle_worker()
+        while True:
+            cid = self._node_srv.raylet_acquire_worker()
+            if cid is None:
+                return None
+            w = self._conn_workers.get(cid)
+            if (w is not None and w.alive and w.conn is not None
+                    and w.actor_id is None):
+                return w
+            # stale entry (conn dropped or worker claimed by an actor):
+            # skip it; C++ already forgot dropped conns
+
+    def _native_release_worker(self, w: WorkerState):
+        """Return a Python-lane leased worker to the shared idle pool."""
+        if (self._raylet_native and w.conn_id is not None and w.alive
+                and w.actor_id is None):
+            try:
+                self._node_srv.raylet_release_worker(w.conn_id)
+            except Exception:
+                pass
+
+    def _reap_native_orphans(self, conn_id: int,
+                             oom: Optional[dict] = None):
+        """Retry policy for native-lane tasks whose worker (conn_id) died
+        before DONE (mirrors _on_worker_death's requeue for the Python
+        lane); ``oom`` carries memory-monitor kill provenance when the
+        death was a deliberate pressure kill — scoped to THIS worker's
+        orphans only."""
+        import pickle
+
+        try:
+            frames = self._node_srv.raylet_reap_orphans(conn_id)
+        except Exception:
+            return
+        for frame in frames:
+            try:
+                tl = frame[1]
+                spec = pickle.loads(frame[2 + tl:])
+            except Exception:
+                continue
+            if spec.task_id in self._cancelled:
+                self._cancelled.discard(spec.task_id)
+                self._fail_task(spec, TaskCancelledError(
+                    f"task {spec.name} was force-cancelled"))
+            elif spec.retries_left > 0:
+                spec.retries_left -= 1
+                self._node_srv.raylet_submit(
+                    spec.task_id,
+                    float((spec.resources or {}).get("CPU", 0)),
+                    spec.name or "",
+                    pickle.dumps(spec, protocol=5))
+            elif oom is not None:
+                from ray_tpu.exceptions import OutOfMemoryError
+
+                self._fail_task(spec, OutOfMemoryError(
+                    f"task {spec.name} was killed by the node memory "
+                    f"monitor: worker rss={oom['rss'] >> 20}MB, node "
+                    f"memory {oom['used'] >> 20}/{oom['total'] >> 20}MB "
+                    f"exceeded the {oom['threshold']:.0%} threshold; "
+                    f"reduce per-task memory or raise "
+                    f"RTPU_MEMORY_MONITOR_THRESHOLD"))
+            else:
+                self._fail_task(spec, WorkerCrashedError(
+                    f"worker died executing {spec.name or 'task'} "
+                    f"({spec.task_id.hex()[:8]})"))
+
     def _native_serve_loop(self):
         """Node service on the C++ epoll server: ONE serving thread runs
         accept/read/parse/dispatch for every worker, peer, and rpc
@@ -735,10 +1020,24 @@ class Scheduler:
             if item is None:
                 continue
             conn_id, frame = item
+            if conn_id == 0:
+                # synthetic raylet markers
+                if frame == b"\x13":  # sealed-object batch to publish
+                    for oid in srv.raylet_drain_sealed():
+                        self.note_sealed(oid)
+                elif frame == b"\x7f":  # infeasible tasks to fail
+                    self._fail_native_infeasible()
+                continue
             if not frame:  # disconnect marker
                 ctx = ctxs.pop(conn_id, None)
+                self._conn_workers.pop(conn_id, None)
+                oom = None
                 if ctx is not None and ctx.worker is not None:
+                    # peek OOM provenance before the death handler pops it
+                    oom = self._oom_kills.get(ctx.worker.worker_id)
                     self._on_worker_death(ctx.worker)
+                if self._raylet_native:
+                    self._reap_native_orphans(conn_id, oom)
                 continue
             ctx = ctxs.get(conn_id)
             if ctx is None:
@@ -746,14 +1045,49 @@ class Scheduler:
                                      rpc_pool)
                 ctxs[conn_id] = ctx
             try:
-                msg = _pickle.loads(frame)
-                keep = self._handle_node_msg(msg, ctx)
+                if frame[0] != 0x80:
+                    # binary node-service frame the raylet routed to the
+                    # policy path (0x10 SUBMIT with the lane off)
+                    keep = self._handle_raw_frame(frame, ctx)
+                else:
+                    msg = _pickle.loads(frame)
+                    keep = self._handle_node_msg(msg, ctx)
             except Exception:
                 if not self._shutdown:
                     traceback.print_exc()
                 keep = False  # treat a raising handler as a broken conn
             if not keep:
                 srv.kick(conn_id)  # its disconnect marker runs cleanup
+
+    def _handle_raw_frame(self, frame: bytes, ctx: "_ConnCtx") -> bool:
+        """Binary node-service frames that reach Python: a 0x10 SUBMIT
+        when the native lane is off (multi-node — the full policy path,
+        including spillback, applies) or a 0x13 SEALED batch when the
+        raylet is disabled."""
+        import pickle as _pickle
+
+        kind = frame[0]
+        if kind == 0x10:
+            # [0x10][tl][tid][f64 cpu][u16 nl][name][pickled spec]
+            tl = frame[1]
+            off = 2 + tl + 8
+            nl = int.from_bytes(frame[off:off + 2], "little")
+            spec = _pickle.loads(frame[off + 2 + nl:])
+            try:
+                self.submit(spec)
+            except ValueError as e:
+                self._fail_task(spec, e)
+            return True
+        if kind == 0x13:
+            n = frame[1]
+            pos = 2
+            for _ in range(n):
+                ln = frame[pos]
+                pos += 1
+                self.note_sealed(bytes(frame[pos:pos + ln]))
+                pos += ln
+            return True
+        return True  # unknown binary frame: ignore, keep the connection
 
     def _reader_loop(self, conn: Connection):
         # TCP peers must pass the cluster-token handshake before any frame
@@ -795,6 +1129,11 @@ class Scheduler:
                 worker.conn = ctx.conn
                 worker.server_addr = msg.get("server_addr")
                 worker.idle = True
+                cid = getattr(ctx.conn, "conn_id", None)
+                if self._raylet_native and cid is not None:
+                    worker.conn_id = cid
+                    self._conn_workers[cid] = worker
+                    self._node_srv.raylet_bind_worker(cid)
                 self._wake.notify_all()
         elif t == "done":
             self._on_task_done(ctx.worker, msg)
@@ -941,7 +1280,7 @@ class Scheduler:
                                 if w.alive]),
                 "store_used_bytes": store.get("used_bytes", 0),
                 "store_num_objects": store.get("num_objects", 0),
-                "available": dict(self.available),
+                "available": self._res_snapshot(),
                 "resources": dict(self.total_resources),
             }
             return {"runtime": runtime,
@@ -1251,7 +1590,7 @@ class Scheduler:
                     # a draining node advertises NOTHING: peers stop
                     # spilling to it while local work finishes
                     available = {} if self._draining \
-                        else dict(self.available)
+                        else self._res_snapshot()
                     queued = len(self._pending)
                 self.gcs.heartbeat(self.node_id, available, queued)
                 if self.is_head:
@@ -1269,6 +1608,23 @@ class Scheduler:
                     # capacity may unblock the queue)
                     with self._lock:
                         self._wake.notify_all()
+                if self._raylet_native:
+                    # the native fast lane is a SINGLE-NODE optimization:
+                    # with peers alive, plain tasks need the Python policy
+                    # path (spillback, load-aware placement)
+                    accept = (self.is_head and not self._draining
+                              and not (alive - {self.node_id}))
+                    if accept != self._lane_accept:
+                        self._lane_accept = accept
+                        self._node_srv.raylet_set_accept(accept)
+                    if not accept:
+                        # reclaim anything queued during the transition
+                        # window so the policy path can spill it to peers
+                        self._steal_native_pending()
+                    self._maybe_grow_native()
+                    with self._lock:
+                        # keep the event table/export pipeline current
+                        self._merge_native_events_locked()
                 now = time.monotonic()
                 if now - getattr(self, "_last_pg_reconcile", 0.0) > 5.0:
                     self._last_pg_reconcile = now
@@ -1408,8 +1764,12 @@ class Scheduler:
                         pg.available[bundle]["CPU"] = (
                             pg.available[bundle].get("CPU", 0) + cpu)
                 else:
-                    self.available["CPU"] = self.available.get("CPU", 0) + cpu
+                    self._res_release({"CPU": cpu})
                 self._wake.notify_all()
+            if self._raylet_native and worker.blocked_count == 1 \
+                    and worker.conn_id is not None:
+                # a native-lane task blocking in get(): C++ tracks its CPU
+                self._node_srv.raylet_block_worker(worker.conn_id)
 
     def _on_worker_unblocked(self, worker: WorkerState):
         with self._lock:
@@ -1430,8 +1790,10 @@ class Scheduler:
                             pg_state.available[pg[1]][k] = (
                                 pg_state.available[pg[1]].get(k, 0) - v)
                 else:
-                    for k, v in res.items():
-                        self.available[k] = self.available.get(k, 0) - v
+                    self._res_force_acquire(res)
+            if self._raylet_native and worker.blocked_count == 0 \
+                    and worker.conn_id is not None:
+                self._node_srv.raylet_unblock_worker(worker.conn_id)
 
     def _on_task_done(self, worker: WorkerState, msg: dict):
         task_id = msg["task_id"]
@@ -1460,9 +1822,11 @@ class Scheduler:
                     worker.actor_id = None
                     self._actor_workers.pop(spec.actor_id, None)
                     worker.idle = True
+                    self._native_release_worker(worker)
             elif spec.kind == TASK:
                 self._release_worker_grants(worker)
                 worker.idle = True
+                self._native_release_worker(worker)
             # ACTOR_METHOD: worker stays bound to the actor; nothing to release.
             self._wake.notify_all()
         self._notify_origin(spec)
@@ -1477,6 +1841,15 @@ class Scheduler:
         from ray_tpu._private.memory_monitor import choose_victim, process_rss
 
         with self._lock:
+            if self._raylet_native:
+                # fold native-lane busyness into the victim policy's view
+                try:
+                    counts = self._node_srv.raylet_native_inflight()
+                except Exception:
+                    counts = {}
+                for w in self._workers.values():
+                    w.native_inflight = (counts.get(w.conn_id, 0)
+                                         if w.conn_id is not None else 0)
             victim = choose_victim(self._workers.values())
             if victim is None:
                 return False
@@ -1646,8 +2019,7 @@ class Scheduler:
                 for k, v in worker.held_resources.items():
                     pg.available[bundle][k] = pg.available[bundle].get(k, 0) + v
         else:
-            for k, v in worker.held_resources.items():
-                self.available[k] = self.available.get(k, 0) + v
+            self._res_release(worker.held_resources)
         worker.held_resources = {}
         worker.held_pg = None
         if worker.held_chips:
@@ -1897,7 +2269,7 @@ class Scheduler:
                 else:
                     remaining.append(spec)
                 continue
-            w = self._pool.find_idle_worker()
+            w = self._find_idle_worker()
             if w is None:
                 self._return_resources(spec, granted)
                 remaining.append(spec)
@@ -1936,10 +2308,8 @@ class Scheduler:
             for k, v in res.items():
                 avail[k] -= v
             return dict(res)
-        if any(self.available.get(k, 0) < v for k, v in res.items()):
+        if not self._res_try_acquire(res):
             return None
-        for k, v in res.items():
-            self.available[k] -= v
         return dict(res)
 
     def _return_resources(self, spec: TaskSpec, granted: dict):
@@ -1950,8 +2320,7 @@ class Scheduler:
                 for k, v in granted.items():
                     pg.available[bundle][k] = pg.available[bundle].get(k, 0) + v
         else:
-            for k, v in granted.items():
-                self.available[k] = self.available.get(k, 0) + v
+            self._res_release(granted)
 
     def _dispatch(self, w: WorkerState, spec: TaskSpec):
         self._record_task_event(spec, "RUNNING", worker_id=w.worker_id)

@@ -64,3 +64,19 @@ class TaskSpec:
     # target ahead of execution (reference: push_manager.cc; the deps the
     # reference carries in its TaskSpec protobuf)
     dependencies: Optional[list] = None
+
+
+def is_plain_task(spec: TaskSpec) -> bool:
+    """True when the spec qualifies for the native raylet's fast lane
+    (core_worker.cc RayletCore): a stateless task whose dispatch needs no
+    Python policy — no placement group, affinity, label, runtime env, or
+    device-resident returns, and only CPU resource demands.  Everything
+    else takes the Python scheduler path."""
+    if spec.kind != TASK:
+        return False
+    if (spec.pg_id is not None or spec.node_affinity is not None
+            or spec.label_selector or spec.label_selector_soft
+            or spec.runtime_env or spec.tensor_transport is not None):
+        return False
+    res = spec.resources or {}
+    return all(k == "CPU" for k in res)

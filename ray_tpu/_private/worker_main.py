@@ -23,7 +23,12 @@ import cloudpickle
 
 from ray_tpu._private import protocol
 from ray_tpu._private import runtime_env as runtime_env_mod
-from ray_tpu._private.task_spec import ACTOR_CREATION, ACTOR_METHOD, TaskSpec
+from ray_tpu._private.task_spec import (
+    ACTOR_CREATION,
+    ACTOR_METHOD,
+    TaskSpec,
+    is_plain_task,
+)
 from ray_tpu._private.serialization import store_error_best_effort
 from ray_tpu._private.worker import WorkerContext, set_global_worker
 from ray_tpu.core.object_ref import ObjectRef
@@ -44,20 +49,29 @@ class WorkerRuntime:
         # two delivery paths (scheduler conn + direct server connections).
         self._actor_locks: dict[bytes, threading.Lock] = {}
         self._actor_locks_guard = threading.Lock()
+        # Binary node-service frames (0x10 submit / 0x12 done / 0x13
+        # sealed) engage only when the scheduler runs the native server —
+        # which is exactly when this process has the extension too (same
+        # image, same env; chaos disables both sides symmetrically).
+        from ray_tpu._private.direct import native_core
+
+        self._native_frames = (
+            native_core() is not None
+            and os.environ.get("RTPU_NATIVE_RAYLET", "1") != "0")
 
         self.ctx = WorkerContext(
             mode="worker",
             store=self.store,
-            submit_fn=lambda spec: self.conn.send({"t": "submit", "spec": spec}),
+            submit_fn=self._submit,
             rpc_fn=self._rpc,
             worker_id=self.worker_id,
             block_notify_fn=lambda blocked: self.conn.send(
                 {"t": "blocked" if blocked else "unblocked"}),
-            seal_notify_fn=lambda oid: self.conn.send(
-                {"t": "sealed", "oid": oid}),
+            seal_notify_fn=self._notify_sealed,
             gcs_address=os.environ.get("RTPU_GCS_ADDRESS") or None,
         )
         set_global_worker(self.ctx)
+
         # Direct-call server: callers push actor methods straight to this
         # process (see _private/direct.py; native C++ transport when the
         # extension is available).  TCP clusters bind the same interface
@@ -74,6 +88,35 @@ class WorkerRuntime:
         self.direct_server = make_direct_server(self, bind)
         # Caller-side direct path for actor calls made FROM this worker.
         self.ctx.init_direct(self._rpc)
+
+    def _submit(self, spec: TaskSpec) -> None:
+        """Nested-task submission: plain tasks ride the binary raylet
+        lane (consumed in C++ on the scheduler; Python only when the lane
+        is off), everything else the pickled policy path."""
+        if self._native_frames and is_plain_task(spec):
+            import pickle
+            import struct
+
+            spec.retries_left = spec.max_retries
+            tid = spec.task_id
+            cpu = float((spec.resources or {}).get("CPU", 0))
+            name = (spec.name or "").encode("utf-8")[:255]
+            # never split a UTF-8 codepoint mid-sequence
+            name = name.decode("utf-8", "ignore").encode("utf-8")
+            self.conn.send_bytes(
+                bytes([0x10, len(tid)]) + tid + struct.pack("<d", cpu)
+                + struct.pack("<H", len(name)) + name
+                + pickle.dumps(spec, protocol=5))
+        else:
+            self.conn.send({"t": "submit", "spec": spec})
+
+    def _notify_sealed(self, oid: bytes) -> None:
+        if self._native_frames:
+            # 0x13: buffered in the scheduler's C++ raylet, published to
+            # the GCS in batches — no Python wakeup per seal
+            self.conn.send_bytes(bytes([0x13, 1, len(oid)]) + oid)
+        else:
+            self.conn.send({"t": "sealed", "oid": oid})
 
     def _rpc(self, method: str, params: dict):
         if protocol.chaos_should_fail(method, "req"):
@@ -103,20 +146,42 @@ class WorkerRuntime:
             return lock
 
     def notify_sealed(self, oid: bytes):
-        self.conn.send({"t": "sealed", "oid": oid})
+        self._notify_sealed(oid)
 
     def run(self):
         self.conn.send({"t": "register", "worker_id": self.worker_id.hex(),
                         "server_addr": self.direct_server.addr})
         while True:
-            msg = self.conn.recv()
-            if msg is None:
+            kind, msg = self.conn.recv_any()
+            if kind is None:
                 return
+            if kind == "raw":
+                # 0x11 ASSIGN from the native raylet: [tl][tid][payload]
+                frame = msg
+                if frame and frame[0] == 0x11:
+                    import pickle
+
+                    tl = frame[1]
+                    spec = pickle.loads(bytes(frame[2 + tl:]))
+                    spec._native_lane = True  # DONE goes back as 0x12
+                    self.handle_task(spec, {})
+                continue
             t = msg["t"]
             if t == "task":
                 self.handle_task(msg["spec"], msg.get("env") or {})
             elif t == "shutdown":
                 return
+
+    def _notify_done(self, spec: TaskSpec, ok: bool, error):
+        if getattr(spec, "_native_lane", False):
+            # 0x12: consumed by the C++ raylet (resource return + next
+            # dispatch) — the scheduler's Python never runs
+            tid = spec.task_id
+            self.conn.send_bytes(
+                bytes([0x12, len(tid)]) + tid + bytes([1 if ok else 0]))
+        else:
+            self.conn.send({"t": "done", "task_id": spec.task_id,
+                            "ok": ok, "error": error})
 
     def handle_task(self, spec: TaskSpec, env: dict):
         # Clear env granted to the previous task (e.g. TPU_VISIBLE_CHIPS)
@@ -270,9 +335,8 @@ class WorkerRuntime:
                 for oid in spec.return_ids:
                     if store_error_best_effort(self.store, oid, e, tb,
                                                raised_by_task=True):
-                        self.conn.send({"t": "sealed", "oid": oid})
-                self.conn.send({"t": "done", "task_id": spec.task_id,
-                                "ok": ok, "error": error})
+                        self._notify_sealed(oid)
+                self._notify_done(spec, ok, error)
                 self.ctx.current_task_id = None
                 self.ctx.current_actor_id = None
                 return
@@ -308,7 +372,7 @@ class WorkerRuntime:
                 # from transport-level failures the scheduler records
                 if store_error_best_effort(self.store, oid, e, tb,
                                            raised_by_task=True):
-                    self.conn.send({"t": "sealed", "oid": oid})
+                    self._notify_sealed(oid)
                 else:
                     print(f"FATAL: could not record error for "
                           f"{oid.hex()[:12]}", file=sys.stderr, flush=True)
@@ -322,8 +386,7 @@ class WorkerRuntime:
                 applied_env.undo()
             self.ctx.current_task_id = None
             self.ctx.current_actor_id = None
-        self.conn.send({"t": "done", "task_id": spec.task_id, "ok": ok,
-                        "error": error})
+        self._notify_done(spec, ok, error)
 
 
 def _apply_jax_platform_env():

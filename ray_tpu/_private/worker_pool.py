@@ -24,6 +24,7 @@ class WorkerState:
     worker_id: bytes
     proc: subprocess.Popen
     conn: Optional[Connection] = None
+    conn_id: Optional[int] = None  # native-server connection id (raylet)
     # the worker process's direct-call server endpoint (reported at
     # registration); published to the GCS when an actor lands on it
     server_addr: Optional[str] = None
@@ -40,6 +41,9 @@ class WorkerState:
     blocked_count: int = 0
     blocked_resources: dict = field(default_factory=dict)
     blocked_pg: Optional[tuple[bytes, int]] = None
+    # Native-lane in-flight count, refreshed by _handle_memory_pressure
+    # before victim selection (C++ owns the authoritative table).
+    native_inflight: int = 0
     held_chips: list = field(default_factory=list)  # physical TPU chip indices
 
 

@@ -48,7 +48,10 @@
 #include <deque>
 #include <map>
 #include <mutex>
+#include <set>
 #include <string>
+#include <unordered_map>
+#include <vector>
 
 namespace {
 
@@ -391,6 +394,121 @@ static PyTypeObject ChannelType = {
     PyVarObject_HEAD_INIT(nullptr, 0)
 };
 
+// ---------- Raylet core (native local dispatch) ----------
+//
+// C++ counterpart of the reference raylet's local task manager + worker
+// lease grant (/root/reference/src/ray/raylet/local_task_manager.cc,
+// node_manager.cc HandleRequestWorkerLease:1892): the steady-state
+// dispatch cycle for plain stateless tasks —
+//
+//   caller 0x10 SUBMIT -> [queue] -> resource deduct + idle-worker pick
+//     -> 0x11 ASSIGN to the worker -> worker 0x12 DONE -> resource return
+//     -> next dispatch; 0x13 batches sealed-object ids
+//
+// runs entirely inside the Server's epoll thread with the GIL released.
+// Python stays the OWNER of policy (placement groups, affinity, labels,
+// runtime envs, actor lifecycle, retries on worker death, multi-node
+// spillback) and calls in through the raylet_* methods; the ledger here
+// is the single owner of node resources so the two lanes cannot drift.
+//
+// Binary node-service frames (first byte; pickled frames start 0x80):
+//   0x10 SUBMIT : [u8 tl][tid][f64 cpu][payload = pickled TaskSpec]
+//   0x11 ASSIGN : [u8 tl][tid][payload]            (raylet -> worker)
+//   0x12 DONE   : [u8 tl][tid][u8 ok]              (worker -> raylet)
+//   0x13 SEALED : [u8 n]{[u8 len][oid]}*n          (worker -> raylet)
+
+struct ServerCore;
+
+struct RayletCore {
+  std::mutex mu;  // guards everything below (serve thread + Python threads)
+  std::map<std::string, double> avail;  // the node resource ledger
+  std::deque<uint64_t> idle;            // native-capable idle workers
+  std::set<uint64_t> idle_set;
+  std::set<uint64_t> bound;             // all native-bound worker conns
+  struct Pending {
+    std::string tid;
+    std::string name;
+    double cpu;
+    std::string assign;  // pre-built 0x11 frame body
+  };
+  std::deque<Pending> pending;
+  struct InFlight {
+    double cpu;
+    std::string assign;  // kept for worker-death orphan recovery
+    std::string name;
+    bool blocked = false;  // CPU released while the task blocks in get()
+  };
+  std::unordered_map<uint64_t, std::map<std::string, InFlight>> inflight;
+  // Per-dead-conn assign frames (keyed so OOM provenance of ONE worker's
+  // kill is never applied to another's orphans).
+  std::map<uint64_t, std::vector<std::string>> orphans;
+  // Assign frames of tasks whose demand exceeds node TOTALS — can never
+  // dispatch; Python fails them with a clear error.
+  std::vector<std::string> infeasible;
+  bool infeasible_marker = false;
+  std::map<std::string, double> total;  // node totals (infeasibility)
+  std::vector<std::string> sealed;   // oid batch for Python to publish
+  bool sealed_marker = false;  // a drain marker is already queued to Python
+  // Task-event ring for the state API / timeline (reference:
+  // GcsTaskManager): Python drains + merges lazily on state queries, so
+  // the steady state writes a struct, never wakes Python.
+  // state: 0=PENDING 1=RUNNING 2=FINISHED 3=FAILED
+  struct Event {
+    std::string tid;
+    std::string name;
+    uint8_t state;
+    double ts;
+  };
+  std::deque<Event> events;
+  static constexpr size_t kMaxEvents = 50000;
+
+  void push_event_locked(const std::string& tid, const std::string& name,
+                         uint8_t state) {
+    struct timespec t;
+    clock_gettime(CLOCK_REALTIME, &t);
+    events.push_back({tid, name, state, double(t.tv_sec) +
+                                            double(t.tv_nsec) * 1e-9});
+    while (events.size() > kMaxEvents) events.pop_front();
+  }
+  uint64_t n_dispatched = 0, n_done = 0, n_submitted = 0;
+  bool enabled = false;
+  bool accept_submits = true;  // false: 0x10 falls through to Python
+                               // (multi-node policy path)
+
+  bool try_acquire_locked(const std::map<std::string, double>& need) {
+    for (const auto& [k, v] : need) {
+      auto it = avail.find(k);
+      if ((it == avail.end() ? 0.0 : it->second) < v) return false;
+    }
+    for (const auto& [k, v] : need) avail[k] -= v;
+    return true;
+  }
+
+  void release_locked(const std::map<std::string, double>& res) {
+    for (const auto& [k, v] : res) avail[k] += v;
+  }
+
+  void remove_worker_locked(uint64_t id) {
+    bound.erase(id);
+    if (idle_set.erase(id)) {
+      for (auto it = idle.begin(); it != idle.end(); ++it) {
+        if (*it == id) {
+          idle.erase(it);
+          break;
+        }
+      }
+    }
+    auto inf = inflight.find(id);
+    if (inf != inflight.end()) {
+      for (auto& [tid, fl] : inf->second) {
+        if (!fl.blocked) avail["CPU"] += fl.cpu;  // blocked already returned
+        orphans[id].push_back(std::move(fl.assign));
+      }
+      inflight.erase(inf);
+    }
+  }
+};
+
 // ---------- Server (callee side) ----------
 
 struct ConnState {
@@ -418,6 +536,8 @@ struct ServerCore {
   std::mutex out_mu;  // guards out_queue only
   std::deque<std::pair<uint64_t, std::string>> out_queue;
   std::mutex dummy_send_mu;  // sends are single-threaded; kept for helpers
+  RayletCore* raylet = nullptr;
+  std::vector<uint64_t> pending_drops;  // conns to drop after event loop
 
   void drop(uint64_t id) {
     auto it = conns.find(id);
@@ -427,10 +547,161 @@ struct ServerCore {
     ::close(it->second.fd);
     bool was_ready = it->second.phase == ConnState::READY;
     conns.erase(it);
+    if (raylet) {
+      std::lock_guard<std::mutex> g(raylet->mu);
+      raylet->remove_worker_locked(id);
+    }
     // surface the disconnect to Python as an EMPTY frame (never legal on
     // the wire) so the consumer can run its death/cleanup handler — the
     // raylet-mode consumer requeues the dead worker's in-flight tasks
     if (was_ready) ready.emplace_back(id, std::string());
+  }
+
+  // Serve-thread only: dispatch queued plain tasks onto idle workers.
+  void raylet_pump() {
+    RayletCore* r = raylet;
+    if (!r || !r->enabled) return;
+    std::vector<std::pair<uint64_t, std::string>> sends;
+    bool emit_sealed = false, emit_infeasible = false;
+    {
+      std::lock_guard<std::mutex> g(r->mu);
+      if (!r->sealed.empty() && !r->sealed_marker) {
+        // wake Python exactly once per batch to publish locations
+        r->sealed_marker = true;
+        emit_sealed = true;
+      }
+      // First-fit over the WHOLE queue: a head task waiting for capacity
+      // must not wedge smaller tasks behind it (the Python lane requeues
+      // unschedulable specs and keeps going — same semantics here), and
+      // a task whose demand exceeds node TOTALS is failed, not queued
+      // forever.
+      for (auto it = r->pending.begin();
+           it != r->pending.end() && !r->idle.empty();) {
+        RayletCore::Pending& p = *it;
+        auto tot = r->total.find("CPU");
+        if (p.cpu > (tot == r->total.end() ? 0.0 : tot->second)) {
+          r->infeasible.push_back(std::move(p.assign));
+          if (!r->infeasible_marker) {
+            r->infeasible_marker = true;
+            emit_infeasible = true;
+          }
+          it = r->pending.erase(it);
+          continue;
+        }
+        if (p.cpu > 0) {
+          std::map<std::string, double> need{{"CPU", p.cpu}};
+          if (!r->try_acquire_locked(need)) {
+            ++it;  // not now; later (smaller) tasks may still fit
+            continue;
+          }
+        }
+        uint64_t w = r->idle.front();
+        r->idle.pop_front();
+        r->idle_set.erase(w);
+        r->push_event_locked(p.tid, p.name, 1);
+        r->inflight[w].emplace(
+            p.tid, RayletCore::InFlight{p.cpu, p.assign, p.name});
+        sends.emplace_back(w, std::move(p.assign));
+        r->n_dispatched++;
+        it = r->pending.erase(it);
+      }
+    }
+    if (emit_sealed) ready.emplace_back(0, std::string("\x13"));
+    if (emit_infeasible) ready.emplace_back(0, std::string("\x7f"));
+    for (auto& [w, frame] : sends) {
+      auto it = conns.find(w);
+      bool ok = it != conns.end() &&
+                send_frame(it->second.fd, dummy_send_mu, frame.data(),
+                           frame.size());
+      if (!ok) {
+        // worker vanished mid-dispatch: orphan the task for Python's
+        // retry path and schedule the connection drop
+        std::lock_guard<std::mutex> g(r->mu);
+        size_t tl = frame.size() >= 2 ? uint8_t(frame[1]) : 0;
+        std::string tid = frame.size() >= 2 + tl ? frame.substr(2, tl)
+                                                 : std::string();
+        auto inf = r->inflight.find(w);
+        if (inf != r->inflight.end()) {
+          auto t = inf->second.find(tid);
+          if (t != inf->second.end()) {
+            r->avail["CPU"] += t->second.cpu;
+            r->orphans[w].push_back(std::move(t->second.assign));
+            inf->second.erase(t);
+          }
+        }
+        pending_drops.push_back(w);
+      }
+    }
+  }
+
+  // Serve-thread only: true when the frame was a raylet-lane frame.
+  bool raylet_handle(uint64_t id, const std::string& f) {
+    RayletCore* r = raylet;
+    if (!r || !r->enabled || f.size() < 2) return false;
+    uint8_t k = uint8_t(f[0]);
+    if (k == 0x10) {  // SUBMIT from a worker/driver connection
+      if (!r->accept_submits) return false;  // Python policy path takes it
+      size_t tl = uint8_t(f[1]);
+      if (f.size() < 2 + tl + 8 + 2) return true;  // malformed: swallow
+      std::string tid = f.substr(2, tl);
+      double cpu;
+      memcpy(&cpu, f.data() + 2 + tl, 8);
+      uint16_t nl;
+      memcpy(&nl, f.data() + 2 + tl + 8, 2);
+      size_t off = 2 + tl + 8 + 2;
+      if (f.size() < off + nl) return true;
+      std::string name = f.substr(off, nl);
+      off += nl;
+      std::string assign;
+      assign.reserve(f.size() - off + 2 + tl);
+      assign.push_back(char(0x11));
+      assign.push_back(char(tl));
+      assign += tid;
+      assign.append(f, off, std::string::npos);
+      std::lock_guard<std::mutex> g(r->mu);
+      r->n_submitted++;
+      r->push_event_locked(tid, name, 0);
+      r->pending.push_back(
+          {std::move(tid), std::move(name), cpu, std::move(assign)});
+      return true;
+    }
+    if (k == 0x12) {  // DONE
+      size_t tl = uint8_t(f[1]);
+      if (f.size() < 2 + tl) return true;
+      std::lock_guard<std::mutex> g(r->mu);
+      auto inf = r->inflight.find(id);
+      if (inf != r->inflight.end()) {
+        std::string tid = f.substr(2, tl);
+        auto t = inf->second.find(tid);
+        if (t != inf->second.end()) {
+          if (!t->second.blocked) r->avail["CPU"] += t->second.cpu;
+          bool ok = f.size() > 2 + tl && f[2 + tl] != 0;
+          r->push_event_locked(tid, t->second.name, ok ? 2 : 3);
+          inf->second.erase(t);
+          r->n_done++;
+        }
+      }
+      if (r->bound.count(id) && !r->idle_set.count(id) &&
+          (inf == r->inflight.end() || inf->second.empty())) {
+        r->idle.push_back(id);
+        r->idle_set.insert(id);
+      }
+      return true;
+    }
+    if (k == 0x13) {  // SEALED oid batch
+      size_t n = uint8_t(f[1]);
+      size_t pos = 2;
+      std::lock_guard<std::mutex> g(r->mu);
+      for (size_t i = 0; i < n && pos < f.size(); ++i) {
+        size_t l = uint8_t(f[pos]);
+        pos += 1;
+        if (pos + l > f.size()) break;
+        r->sealed.emplace_back(f, pos, l);
+        pos += l;
+      }
+      return true;
+    }
+    return false;
   }
 
   // Exec-thread only: drain queued replies onto their sockets.  An empty
@@ -518,6 +789,10 @@ struct ServerCore {
         continue;
       }
       if (frame.empty()) continue;  // empty frames are reserved markers
+      if (raylet && raylet_handle(id, frame)) {
+        frame.clear();
+        continue;  // consumed natively: Python never sees it
+      }
       ready.emplace_back(id, std::move(frame));
       frame.clear();
     }
@@ -567,10 +842,519 @@ static void Server_dealloc(ServerObject* self) {
     ::close(c->listen_fd);
     ::close(c->wake_fd);
     ::close(c->epfd);
+    delete c->raylet;
     delete c;
     self->core = nullptr;
   }
   Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+// ---------- raylet_* methods (Python-facing; touch state, never sockets) --
+
+static bool dict_to_resmap(PyObject* d, std::map<std::string, double>* out) {
+  PyObject *key, *value;
+  Py_ssize_t pos = 0;
+  while (PyDict_Next(d, &pos, &key, &value)) {
+    const char* k = PyUnicode_AsUTF8(key);
+    double v = PyFloat_AsDouble(value);
+    if (!k || (v == -1.0 && PyErr_Occurred())) return false;
+    (*out)[k] = v;
+  }
+  return true;
+}
+
+static void raylet_wake(ServerCore* c) {
+  uint64_t one = 1;
+  (void)!::write(c->wake_fd, &one, 8);
+}
+
+static RayletCore* raylet_of(ServerObject* self) {
+  ServerCore* c = self->core;
+  if (!c->raylet) {
+    PyErr_SetString(PyExc_RuntimeError, "raylet not enabled");
+    return nullptr;
+  }
+  return c->raylet;
+}
+
+static PyObject* Server_raylet_enable(ServerObject* self, PyObject* args) {
+  PyObject* resources;
+  if (!PyArg_ParseTuple(args, "O!", &PyDict_Type, &resources))
+    return nullptr;
+  ServerCore* c = self->core;
+  if (!c->raylet) c->raylet = new RayletCore();
+  std::map<std::string, double> res;
+  if (!dict_to_resmap(resources, &res)) return nullptr;
+  {
+    std::lock_guard<std::mutex> g(c->raylet->mu);
+    c->raylet->total = res;
+    c->raylet->avail = std::move(res);
+    c->raylet->enabled = true;
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject* Server_raylet_try_acquire(ServerObject* self,
+                                           PyObject* args) {
+  PyObject* d;
+  if (!PyArg_ParseTuple(args, "O!", &PyDict_Type, &d)) return nullptr;
+  RayletCore* r = raylet_of(self);
+  if (!r) return nullptr;
+  std::map<std::string, double> need;
+  if (!dict_to_resmap(d, &need)) return nullptr;
+  bool ok;
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    ok = r->try_acquire_locked(need);
+  }
+  return PyBool_FromLong(ok);
+}
+
+static PyObject* Server_raylet_release(ServerObject* self, PyObject* args) {
+  PyObject* d;
+  if (!PyArg_ParseTuple(args, "O!", &PyDict_Type, &d)) return nullptr;
+  RayletCore* r = raylet_of(self);
+  if (!r) return nullptr;
+  std::map<std::string, double> res;
+  if (!dict_to_resmap(d, &res)) return nullptr;
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    r->release_locked(res);
+  }
+  raylet_wake(self->core);  // freed capacity may unblock queued dispatch
+  Py_RETURN_NONE;
+}
+
+static PyObject* Server_raylet_force_acquire(ServerObject* self,
+                                             PyObject* args) {
+  // Unconditional deduct (may go negative): the unblock path accepts
+  // transient oversubscription, matching the Python scheduler's rule.
+  PyObject* d;
+  if (!PyArg_ParseTuple(args, "O!", &PyDict_Type, &d)) return nullptr;
+  RayletCore* r = raylet_of(self);
+  if (!r) return nullptr;
+  std::map<std::string, double> res;
+  if (!dict_to_resmap(d, &res)) return nullptr;
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    for (const auto& [k, v] : res) r->avail[k] -= v;
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject* Server_raylet_snapshot(ServerObject* self, PyObject*) {
+  RayletCore* r = raylet_of(self);
+  if (!r) return nullptr;
+  std::map<std::string, double> copy;
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    copy = r->avail;
+  }
+  PyObject* d = PyDict_New();
+  if (!d) return nullptr;
+  for (const auto& [k, v] : copy) {
+    PyObject* val = PyFloat_FromDouble(v);
+    if (!val || PyDict_SetItemString(d, k.c_str(), val) < 0) {
+      Py_XDECREF(val);
+      Py_DECREF(d);
+      return nullptr;
+    }
+    Py_DECREF(val);
+  }
+  return d;
+}
+
+static PyObject* Server_raylet_bind_worker(ServerObject* self,
+                                           PyObject* args) {
+  unsigned long long conn_id;
+  if (!PyArg_ParseTuple(args, "K", &conn_id)) return nullptr;
+  RayletCore* r = raylet_of(self);
+  if (!r) return nullptr;
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    r->bound.insert(conn_id);
+    if (!r->idle_set.count(conn_id)) {
+      r->idle.push_back(conn_id);
+      r->idle_set.insert(conn_id);
+    }
+  }
+  raylet_wake(self->core);
+  Py_RETURN_NONE;
+}
+
+static PyObject* Server_raylet_acquire_worker(ServerObject* self,
+                                              PyObject*) {
+  // Python-lane lease: pop an idle worker for a non-plain task (PG /
+  // actor / custom-resource); the caller dispatches + releases it.
+  RayletCore* r = raylet_of(self);
+  if (!r) return nullptr;
+  uint64_t id = 0;
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    if (r->idle.empty()) Py_RETURN_NONE;
+    id = r->idle.front();
+    r->idle.pop_front();
+    r->idle_set.erase(id);
+  }
+  return PyLong_FromUnsignedLongLong(id);
+}
+
+static PyObject* Server_raylet_release_worker(ServerObject* self,
+                                              PyObject* args) {
+  unsigned long long conn_id;
+  if (!PyArg_ParseTuple(args, "K", &conn_id)) return nullptr;
+  RayletCore* r = raylet_of(self);
+  if (!r) return nullptr;
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    if (r->bound.count(conn_id) && !r->idle_set.count(conn_id)) {
+      r->idle.push_back(conn_id);
+      r->idle_set.insert(conn_id);
+    }
+  }
+  raylet_wake(self->core);
+  Py_RETURN_NONE;
+}
+
+static PyObject* Server_raylet_submit(ServerObject* self, PyObject* args) {
+  // In-process submit (the driver on the head node): same lane as a 0x10
+  // frame, without a socket hop.
+  Py_buffer tid, payload;
+  double cpu;
+  const char* name;
+  Py_ssize_t name_len;
+  if (!PyArg_ParseTuple(args, "y*ds#y*", &tid, &cpu, &name, &name_len,
+                        &payload))
+    return nullptr;
+  RayletCore* r = raylet_of(self);
+  if (!r) {
+    PyBuffer_Release(&tid);
+    PyBuffer_Release(&payload);
+    return nullptr;
+  }
+  std::string t((const char*)tid.buf, size_t(tid.len));
+  std::string assign;
+  assign.reserve(2 + t.size() + size_t(payload.len));
+  assign.push_back(char(0x11));
+  assign.push_back(char(uint8_t(t.size())));
+  assign += t;
+  assign.append((const char*)payload.buf, size_t(payload.len));
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    r->n_submitted++;
+    r->push_event_locked(t, std::string(name, size_t(name_len)), 0);
+    r->pending.push_back({std::move(t),
+                          std::string(name, size_t(name_len)), cpu,
+                          std::move(assign)});
+  }
+  PyBuffer_Release(&tid);
+  PyBuffer_Release(&payload);
+  raylet_wake(self->core);
+  Py_RETURN_NONE;
+}
+
+static PyObject* Server_raylet_native_inflight(ServerObject* self,
+                                               PyObject*) {
+  // {conn_id: in-flight native task count} — the OOM killer's victim
+  // policy needs to see native-lane busyness (Python's WorkerState
+  // in_flight only tracks the policy lane).
+  RayletCore* r = raylet_of(self);
+  if (!r) return nullptr;
+  PyObject* d = PyDict_New();
+  if (!d) return nullptr;
+  std::vector<std::pair<uint64_t, size_t>> rows;
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    for (const auto& [w, m] : r->inflight)
+      if (!m.empty()) rows.emplace_back(w, m.size());
+  }
+  for (const auto& [w, n] : rows) {
+    PyObject* key = PyLong_FromUnsignedLongLong(w);
+    PyObject* val = PyLong_FromSize_t(n);
+    if (!key || !val || PyDict_SetItem(d, key, val) < 0) {
+      Py_XDECREF(key);
+      Py_XDECREF(val);
+      Py_DECREF(d);
+      return nullptr;
+    }
+    Py_DECREF(key);
+    Py_DECREF(val);
+  }
+  return d;
+}
+
+static PyObject* Server_raylet_drain_events(ServerObject* self, PyObject*) {
+  // [(task_id, name, state, ts), ...]; state 0=PENDING 1=RUNNING
+  // 2=FINISHED 3=FAILED.  Python merges into its task-event table on
+  // state-API queries.
+  RayletCore* r = raylet_of(self);
+  if (!r) return nullptr;
+  std::deque<RayletCore::Event> out;
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    out.swap(r->events);
+  }
+  PyObject* list = PyList_New(Py_ssize_t(out.size()));
+  if (!list) return nullptr;
+  Py_ssize_t i = 0;
+  for (const auto& e : out) {
+    // lenient name decode: a truncated/garbled UTF-8 name must not
+    // poison the whole drained batch
+    PyObject* name = PyUnicode_DecodeUTF8(
+        e.name.data(), Py_ssize_t(e.name.size()), "replace");
+    if (!name) {
+      Py_DECREF(list);
+      return nullptr;
+    }
+    PyObject* item = Py_BuildValue(
+        "(y#Nid)", e.tid.data(), Py_ssize_t(e.tid.size()), name,
+        int(e.state), e.ts);  // N: item owns `name`
+    if (!item) {
+      Py_DECREF(list);
+      return nullptr;
+    }
+    PyList_SET_ITEM(list, i++, item);
+  }
+  return list;
+}
+
+static PyObject* Server_raylet_set_accept(ServerObject* self,
+                                          PyObject* args) {
+  // false: 0x10 SUBMITs fall through to Python (multi-node spillback
+  // policy applies); DONE/SEALED stay native either way.
+  int accept;
+  if (!PyArg_ParseTuple(args, "p", &accept)) return nullptr;
+  RayletCore* r = raylet_of(self);
+  if (!r) return nullptr;
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    r->accept_submits = accept != 0;
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject* Server_raylet_block_worker(ServerObject* self,
+                                            PyObject* args) {
+  // The worker's running native task entered a blocking get: release its
+  // CPU back to the ledger so dependency chains cannot deadlock the node
+  // (reference: NotifyDirectCallTaskBlocked, node_manager.cc).
+  unsigned long long conn_id;
+  if (!PyArg_ParseTuple(args, "K", &conn_id)) return nullptr;
+  RayletCore* r = raylet_of(self);
+  if (!r) return nullptr;
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    auto inf = r->inflight.find(conn_id);
+    if (inf != r->inflight.end()) {
+      for (auto& [tid, fl] : inf->second) {
+        if (!fl.blocked) {
+          fl.blocked = true;
+          r->avail["CPU"] += fl.cpu;
+        }
+      }
+    }
+  }
+  raylet_wake(self->core);
+  Py_RETURN_NONE;
+}
+
+static PyObject* Server_raylet_unblock_worker(ServerObject* self,
+                                              PyObject* args) {
+  // Unconditional re-deduct (transient oversubscription accepted).
+  unsigned long long conn_id;
+  if (!PyArg_ParseTuple(args, "K", &conn_id)) return nullptr;
+  RayletCore* r = raylet_of(self);
+  if (!r) return nullptr;
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    auto inf = r->inflight.find(conn_id);
+    if (inf != r->inflight.end()) {
+      for (auto& [tid, fl] : inf->second) {
+        if (fl.blocked) {
+          fl.blocked = false;
+          r->avail["CPU"] -= fl.cpu;
+        }
+      }
+    }
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject* Server_raylet_reap_orphans(ServerObject* self,
+                                            PyObject* args) {
+  // Assign frames ([0x11][tl][tid][payload]) of tasks whose worker died
+  // before DONE; Python unpickles the payload and runs its retry policy.
+  // Keyed by the dead connection so one worker's death provenance (e.g.
+  // an OOM kill) is never applied to another's tasks.
+  unsigned long long conn_id;
+  if (!PyArg_ParseTuple(args, "K", &conn_id)) return nullptr;
+  RayletCore* r = raylet_of(self);
+  if (!r) return nullptr;
+  std::vector<std::string> out;
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    auto it = r->orphans.find(conn_id);
+    if (it != r->orphans.end()) {
+      out = std::move(it->second);
+      r->orphans.erase(it);
+    }
+  }
+  PyObject* list = PyList_New(Py_ssize_t(out.size()));
+  if (!list) return nullptr;
+  for (size_t i = 0; i < out.size(); ++i) {
+    PyObject* b =
+        PyBytes_FromStringAndSize(out[i].data(), Py_ssize_t(out[i].size()));
+    if (!b) {
+      Py_DECREF(list);
+      return nullptr;
+    }
+    PyList_SET_ITEM(list, Py_ssize_t(i), b);
+  }
+  return list;
+}
+
+static PyObject* Server_raylet_drain_infeasible(ServerObject* self,
+                                                PyObject*) {
+  // Assign frames of tasks whose demand exceeds node totals — Python
+  // fails them with a precise error instead of queueing forever.
+  RayletCore* r = raylet_of(self);
+  if (!r) return nullptr;
+  std::vector<std::string> out;
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    out.swap(r->infeasible);
+    r->infeasible_marker = false;
+  }
+  PyObject* list = PyList_New(Py_ssize_t(out.size()));
+  if (!list) return nullptr;
+  for (size_t i = 0; i < out.size(); ++i) {
+    PyObject* b =
+        PyBytes_FromStringAndSize(out[i].data(), Py_ssize_t(out[i].size()));
+    if (!b) {
+      Py_DECREF(list);
+      return nullptr;
+    }
+    PyList_SET_ITEM(list, Py_ssize_t(i), b);
+  }
+  return list;
+}
+
+static PyObject* Server_raylet_steal_pending(ServerObject* self,
+                                             PyObject*) {
+  // Drain the whole native queue back to Python (assign frames).  Used
+  // when the cluster stops being single-node: tasks accepted into the
+  // fast lane during the transition window move to the policy path so
+  // spillback/load-aware placement applies to them.
+  RayletCore* r = raylet_of(self);
+  if (!r) return nullptr;
+  std::deque<RayletCore::Pending> out;
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    out.swap(r->pending);
+  }
+  PyObject* list = PyList_New(Py_ssize_t(out.size()));
+  if (!list) return nullptr;
+  Py_ssize_t i = 0;
+  for (auto& p : out) {
+    PyObject* b = PyBytes_FromStringAndSize(p.assign.data(),
+                                            Py_ssize_t(p.assign.size()));
+    if (!b) {
+      Py_DECREF(list);
+      return nullptr;
+    }
+    PyList_SET_ITEM(list, i++, b);
+  }
+  return list;
+}
+
+static PyObject* Server_raylet_cancel(ServerObject* self, PyObject* args) {
+  // cancel(tid) -> (state, conn_id, frame|None)
+  //   state 0: unknown here; 1: removed from the queue (frame returned
+  //   so Python can fail the spec's return objects); 2: running on
+  //   conn_id (force-cancel kills that worker from Python).
+  Py_buffer tid;
+  if (!PyArg_ParseTuple(args, "y*", &tid)) return nullptr;
+  RayletCore* r = raylet_of(self);
+  if (!r) {
+    PyBuffer_Release(&tid);
+    return nullptr;
+  }
+  std::string t((const char*)tid.buf, size_t(tid.len));
+  PyBuffer_Release(&tid);
+  int state = 0;
+  uint64_t conn = 0;
+  std::string frame;
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    for (auto it = r->pending.begin(); it != r->pending.end(); ++it) {
+      if (it->tid == t) {
+        frame = std::move(it->assign);
+        r->pending.erase(it);
+        state = 1;
+        break;
+      }
+    }
+    if (state == 0) {
+      for (auto& [w, m] : r->inflight) {
+        if (m.count(t)) {
+          state = 2;
+          conn = w;
+          break;
+        }
+      }
+    }
+  }
+  if (state == 1)
+    return Py_BuildValue("(iKy#)", state, (unsigned long long)conn,
+                         frame.data(), Py_ssize_t(frame.size()));
+  return Py_BuildValue("(iKO)", state, (unsigned long long)conn, Py_None);
+}
+
+static PyObject* Server_raylet_drain_sealed(ServerObject* self, PyObject*) {
+  RayletCore* r = raylet_of(self);
+  if (!r) return nullptr;
+  std::vector<std::string> out;
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    out.swap(r->sealed);
+    r->sealed_marker = false;
+  }
+  PyObject* list = PyList_New(Py_ssize_t(out.size()));
+  if (!list) return nullptr;
+  for (size_t i = 0; i < out.size(); ++i) {
+    PyObject* b =
+        PyBytes_FromStringAndSize(out[i].data(), Py_ssize_t(out[i].size()));
+    if (!b) {
+      Py_DECREF(list);
+      return nullptr;
+    }
+    PyList_SET_ITEM(list, Py_ssize_t(i), b);
+  }
+  return list;
+}
+
+static PyObject* Server_raylet_stats(ServerObject* self, PyObject*) {
+  RayletCore* r = raylet_of(self);
+  if (!r) return nullptr;
+  uint64_t pending, idle, inflight = 0, dispatched, done, submitted;
+  double cpu;
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    pending = r->pending.size();
+    idle = r->idle.size();
+    for (auto& [w, m] : r->inflight) inflight += m.size();
+    dispatched = r->n_dispatched;
+    done = r->n_done;
+    submitted = r->n_submitted;
+    auto it = r->avail.find("CPU");
+    cpu = it == r->avail.end() ? 0.0 : it->second;
+  }
+  return Py_BuildValue(
+      "{s:K,s:K,s:K,s:K,s:K,s:K,s:d}", "pending",
+      (unsigned long long)pending, "idle", (unsigned long long)idle,
+      "inflight", (unsigned long long)inflight, "dispatched",
+      (unsigned long long)dispatched, "done", (unsigned long long)done,
+      "submitted", (unsigned long long)submitted, "cpu_available", cpu);
 }
 
 // next(timeout_ms) -> (conn_id, frame) | None; raises ConnectionError
@@ -589,6 +1373,9 @@ static PyObject* Server_next(ServerObject* self, PyObject* args) {
   Py_BEGIN_ALLOW_THREADS
   for (;;) {
     c->flush_replies();  // pool-thread replies drain on THIS thread
+    c->raylet_pump();    // dispatch queued plain tasks to idle workers
+    for (uint64_t did : c->pending_drops) c->drop(did);
+    c->pending_drops.clear();
     if (!c->ready.empty()) {
       conn_id = c->ready.front().first;
       frame = std::move(c->ready.front().second);
@@ -685,6 +1472,56 @@ static PyMethodDef Server_methods[] = {
     {"kick", (PyCFunction)Server_kick, METH_VARARGS,
      "kick(conn_id): close a connection"},
     {"close", (PyCFunction)Server_close, METH_NOARGS, ""},
+    {"raylet_enable", (PyCFunction)Server_raylet_enable, METH_VARARGS,
+     "raylet_enable(resources): turn on native plain-task dispatch; the "
+     "resource dict becomes the node ledger (single owner)"},
+    {"raylet_try_acquire", (PyCFunction)Server_raylet_try_acquire,
+     METH_VARARGS, "raylet_try_acquire({name: amount}) -> bool (atomic)"},
+    {"raylet_release", (PyCFunction)Server_raylet_release, METH_VARARGS,
+     "raylet_release({name: amount})"},
+    {"raylet_force_acquire", (PyCFunction)Server_raylet_force_acquire,
+     METH_VARARGS,
+     "raylet_force_acquire({name: amount}): unconditional deduct"},
+    {"raylet_snapshot", (PyCFunction)Server_raylet_snapshot, METH_NOARGS,
+     "raylet_snapshot() -> {name: available}"},
+    {"raylet_bind_worker", (PyCFunction)Server_raylet_bind_worker,
+     METH_VARARGS, "raylet_bind_worker(conn_id): register + mark idle"},
+    {"raylet_acquire_worker", (PyCFunction)Server_raylet_acquire_worker,
+     METH_NOARGS, "raylet_acquire_worker() -> conn_id | None"},
+    {"raylet_release_worker", (PyCFunction)Server_raylet_release_worker,
+     METH_VARARGS, "raylet_release_worker(conn_id): return to idle pool"},
+    {"raylet_submit", (PyCFunction)Server_raylet_submit, METH_VARARGS,
+     "raylet_submit(task_id, cpu, payload): enqueue a plain task"},
+    {"raylet_set_accept", (PyCFunction)Server_raylet_set_accept,
+     METH_VARARGS,
+     "raylet_set_accept(bool): route 0x10 SUBMITs natively or to Python"},
+    {"raylet_block_worker", (PyCFunction)Server_raylet_block_worker,
+     METH_VARARGS,
+     "raylet_block_worker(conn_id): release the running native task's CPU"},
+    {"raylet_unblock_worker", (PyCFunction)Server_raylet_unblock_worker,
+     METH_VARARGS, "raylet_unblock_worker(conn_id): re-deduct"},
+    {"raylet_reap_orphans", (PyCFunction)Server_raylet_reap_orphans,
+     METH_VARARGS,
+     "raylet_reap_orphans(conn_id) -> [assign frames of that dead "
+     "worker's tasks]"},
+    {"raylet_drain_infeasible",
+     (PyCFunction)Server_raylet_drain_infeasible, METH_NOARGS,
+     "raylet_drain_infeasible() -> [assign frames exceeding node totals]"},
+    {"raylet_cancel", (PyCFunction)Server_raylet_cancel, METH_VARARGS,
+     "raylet_cancel(task_id) -> (state, conn_id, frame|None)"},
+    {"raylet_steal_pending", (PyCFunction)Server_raylet_steal_pending,
+     METH_NOARGS,
+     "raylet_steal_pending() -> [assign frames] (queue moves to Python)"},
+    {"raylet_drain_sealed", (PyCFunction)Server_raylet_drain_sealed,
+     METH_NOARGS, "raylet_drain_sealed() -> [oid, ...]"},
+    {"raylet_drain_events", (PyCFunction)Server_raylet_drain_events,
+     METH_NOARGS,
+     "raylet_drain_events() -> [(task_id, name, state, ts), ...]"},
+    {"raylet_native_inflight",
+     (PyCFunction)Server_raylet_native_inflight, METH_NOARGS,
+     "raylet_native_inflight() -> {conn_id: task count}"},
+    {"raylet_stats", (PyCFunction)Server_raylet_stats, METH_NOARGS,
+     "raylet_stats() -> dispatch counters + ledger CPU"},
     {nullptr, nullptr, 0, nullptr}};
 
 static PyTypeObject ServerType = {
