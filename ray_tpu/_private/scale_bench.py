@@ -1,0 +1,220 @@
+"""Control-plane scale benchmark: the scaled-down one-host version of the
+reference's release benchmarks
+(/root/reference/release/benchmarks/README.md:11-14 — 2,000 nodes, 40k
+actors, 10k concurrent tasks, 1k placement groups; the committed
+perf_metrics JSONs record the sustained rates).
+
+One host cannot run 2,000 kernels, so each scenario exercises the REAL
+control-plane stack at a scaled envelope and records sustained rates:
+
+  tasks   — 50k queued plain tasks through the native raylet lane
+            (submit -> C++ queue -> dispatch -> DONE), sim-worker fleet
+            acknowledging instantly: measures the dispatch plane, not
+            user code (exactly what the reference's benchmark_throughput
+            mock tasks measure)
+  actors  — 1,000 actor creations through the Python policy lane + GCS
+            actor table to ALIVE, each claiming a (sim) worker
+  pgs     — 100 placement groups reserved/committed 2PC across 20
+            in-process nodes, then removed
+  nodes   — those 20 nodes registering + heartbeating
+
+Run: ``python -m ray_tpu._private.scale_bench [--quick]``; writes
+BENCH_scale.json at the repo root (tracked round-over-round like
+BENCH_core.json).  The pytest smoke (tests/test_scale_smoke.py) runs the
+same scenarios at 1/50 scale.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def _build_plain_spec():
+    from ray_tpu._private.task_spec import TaskSpec
+
+    return TaskSpec(
+        task_id=os.urandom(16), kind="task", fn_id=b"\x00" * 20,
+        args_blob=b"", return_ids=[os.urandom(20)],
+        resources={"CPU": 1}, name="scale_noop")
+
+
+def bench_tasks(n_tasks: int = 50_000, sim_workers: int = 16) -> dict:
+    """Queued-task storm through the native raylet."""
+    import ray_tpu
+    import ray_tpu.api as api
+    from ray_tpu._private.sim_workers import SimWorkerFleet
+
+    os.environ["RTPU_ALLOW_SIM_WORKERS"] = "1"
+    ray_tpu.init(min_workers=0, max_workers=0,
+                 resources={"CPU": float(sim_workers)},
+                 object_store_memory=1 << 27, ignore_reinit_error=True)
+    sched = api._global_node.scheduler
+    assert sched._raylet_native, "scale bench needs the native raylet"
+    fleet = SimWorkerFleet(sched.socket_path, sim_workers)
+    fleet.start()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if sched._node_srv.raylet_stats()["idle"] >= sim_workers:
+            break
+        time.sleep(0.05)
+
+    specs = [_build_plain_spec() for _ in range(n_tasks)]
+    base = sched._node_srv.raylet_stats()["done"]
+    t0 = time.monotonic()
+    for spec in specs:
+        sched.submit(spec)
+    t_submit = time.monotonic() - t0
+    target = base + n_tasks
+    while sched._node_srv.raylet_stats()["done"] < target:
+        if time.monotonic() - t0 > 600:
+            break
+        time.sleep(0.05)
+    t_total = time.monotonic() - t0
+    st = sched._node_srv.raylet_stats()
+    done = st["done"] - base
+    fleet.close()
+    ray_tpu.shutdown()
+    return {
+        "n_tasks": n_tasks,
+        "sim_workers": sim_workers,
+        "submit_per_s": round(n_tasks / t_submit, 1),
+        "dispatch_per_s": round(done / t_total, 1),
+        "completed": done,
+        "queue_peak": n_tasks,  # all queued before the fleet drains
+    }
+
+
+def bench_actors(n_actors: int = 1_000) -> dict:
+    """Actor-creation storm: submit -> dispatch -> GCS ALIVE."""
+    import ray_tpu
+    import ray_tpu.api as api
+    from ray_tpu._private import gcs as gcs_mod
+    from ray_tpu._private.sim_workers import SimWorkerFleet
+    from ray_tpu._private.task_spec import TaskSpec
+
+    os.environ["RTPU_ALLOW_SIM_WORKERS"] = "1"
+    ray_tpu.init(min_workers=0, max_workers=0,
+                 resources={"CPU": 4.0}, object_store_memory=1 << 27,
+                 ignore_reinit_error=True)
+    sched = api._global_node.scheduler
+    fleet = SimWorkerFleet(sched.socket_path, n_actors + 4)
+    fleet.start()
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        with sched._lock:
+            ready = sum(1 for w in sched._workers.values()
+                        if w.conn is not None)
+        if ready >= n_actors:
+            break
+        time.sleep(0.1)
+
+    actor_ids = [os.urandom(16) for _ in range(n_actors)]
+    t0 = time.monotonic()
+    for aid in actor_ids:
+        spec = TaskSpec(
+            task_id=os.urandom(16), kind="actor_creation",
+            fn_id=b"\x00" * 20, args_blob=b"",
+            return_ids=[os.urandom(20)], resources={},
+            actor_id=aid, name="ScaleActor")
+        sched.submit(spec)
+    t_submit = time.monotonic() - t0
+    gcs = sched.gcs
+    alive = 0
+    while time.monotonic() - t0 < 600:
+        alive = sum(1 for aid in actor_ids
+                    if (info := gcs.get_actor(aid)) is not None
+                    and info.state == gcs_mod.ALIVE)
+        if alive >= n_actors:
+            break
+        time.sleep(0.25)
+    t_total = time.monotonic() - t0
+    fleet.close()
+    ray_tpu.shutdown()
+    return {
+        "n_actors": n_actors,
+        "submit_per_s": round(n_actors / t_submit, 1),
+        "alive": alive,
+        "actors_alive_per_s": round(alive / t_total, 1),
+    }
+
+
+def bench_pgs_and_nodes(n_nodes: int = 20, n_pgs: int = 100) -> dict:
+    """20 in-process nodes + 100 placement groups (2PC reserve/commit)."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.placement_group import (
+        placement_group,
+        remove_placement_group,
+    )
+
+    os.environ.pop("RTPU_ALLOW_SIM_WORKERS", None)
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"min_workers": 0, "max_workers": 2,
+                                      "resources": {"CPU": 8.0},
+                                      "object_store_memory": 1 << 26})
+    t0 = time.monotonic()
+    for _ in range(n_nodes - 1):
+        cluster.add_node(min_workers=0, max_workers=0,
+                         resources={"CPU": 8.0},
+                         object_store_memory=1 << 26)
+    n_up = cluster.wait_for_nodes(timeout=120)
+    t_nodes = time.monotonic() - t0
+
+    pgs = []
+    t0 = time.monotonic()
+    for i in range(n_pgs):
+        pgs.append(placement_group([{"CPU": 1}], strategy="PACK"))
+    created = 0
+    deadline = time.monotonic() + 300
+    for pg in pgs:
+        try:
+            if pg.wait(max(1.0, deadline - time.monotonic())):
+                created += 1
+        except Exception:
+            pass
+    t_pgs = time.monotonic() - t0
+    for pg in pgs:
+        try:
+            remove_placement_group(pg)
+        except Exception:
+            pass
+    cluster.shutdown()
+    return {
+        "n_nodes": n_up,
+        "nodes_up_s": round(t_nodes, 2),
+        "n_pgs": n_pgs,
+        "pgs_created": created,
+        "pgs_per_s": round(created / t_pgs, 1) if t_pgs > 0 else 0.0,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="1/50-scale smoke (CI)")
+    ap.add_argument("--out", default="BENCH_scale.json")
+    args = ap.parse_args()
+    scale = 50 if args.quick else 1
+
+    record = {"scaled_down_from":
+              "reference release/benchmarks (2,000 nodes / 40k actors / "
+              "1k PGs on a cluster); one-host envelope"}
+    record["tasks"] = bench_tasks(n_tasks=50_000 // scale)
+    print(json.dumps({"tasks": record["tasks"]}), flush=True)
+    record["actors"] = bench_actors(n_actors=1_000 // scale)
+    print(json.dumps({"actors": record["actors"]}), flush=True)
+    record["pgs_nodes"] = bench_pgs_and_nodes(
+        n_nodes=max(3, 20 // scale), n_pgs=max(4, 100 // scale))
+    print(json.dumps({"pgs_nodes": record["pgs_nodes"]}), flush=True)
+
+    if not args.quick:
+        with open(args.out, "w") as f:
+            json.dump(record, f, indent=1)
+    print(json.dumps({"scale_bench": record}))
+
+
+if __name__ == "__main__":
+    main()

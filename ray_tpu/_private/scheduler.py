@@ -256,7 +256,8 @@ class Scheduler:
                 rows = [(w.proc.pid,
                          next((s.name or s.method_name or ""
                                for s in w.in_flight.values()), ""))
-                        for w in self._pool.workers.values() if w.alive]
+                        for w in self._pool.workers.values()
+                        if w.alive and w.proc is not None]
             return rows
 
         self.reporter = NodeStatsReporter(self.node_id, _live_workers)
@@ -1122,6 +1123,15 @@ class Scheduler:
             worker_id = bytes.fromhex(msg["worker_id"])
             with self._lock:
                 worker = self._workers.get(worker_id)
+                if (worker is None and not self._shutdown
+                        and os.environ.get("RTPU_ALLOW_SIM_WORKERS")
+                        == "1"):
+                    # Scale-harness mode: accept externally-registered
+                    # lightweight workers (no subprocess — the control
+                    # plane is what's under test; see
+                    # _private/sim_workers.py and scale_bench.py)
+                    worker = WorkerState(worker_id=worker_id, proc=None)
+                    self._pool.workers[worker_id] = worker
                 if worker is None:  # late registration after shutdown
                     ctx.close()
                     return False

@@ -126,13 +126,20 @@ class WorkerPool:
 
     @staticmethod
     def terminate_worker(w: WorkerState):
+        if w.proc is None:  # sim worker (scale harness): close its conn
+            if w.conn is not None:
+                try:
+                    w.conn.close()
+                except Exception:
+                    pass
+            return
         try:
             w.proc.terminate()
         except OSError:
             pass
 
     def shutdown_all(self):
-        workers = list(self.workers.values())
+        workers = [w for w in self.workers.values() if w.proc is not None]
         for w in workers:
             try:
                 w.proc.terminate()
