@@ -88,6 +88,11 @@ def chaos_should_fail(method: str, direction: str) -> bool:
     return False
 
 
+class ProtocolError(ConnectionError):
+    """A frame that violates the connection's dialect (e.g. a raw binary
+    raylet-lane frame arriving on a pickled request/response connection)."""
+
+
 class Connection:
     """A framed, thread-safe-for-send message connection."""
 
@@ -110,10 +115,15 @@ class Connection:
             self.sock.sendall(frame)
 
     def recv(self) -> dict | None:
-        """Receive one pickled message; None on clean EOF or when the
-        frame was a binary-dialect frame (callers of recv() never expect
-        those)."""
+        """Receive one pickled message; None on clean EOF.  A binary
+        (raw-dialect) frame on a pickled-dialect connection is a protocol
+        violation — raise, never map it to the EOF sentinel (callers such
+        as state.py / client.py treat None as a clean hang-up and would
+        silently drop the request)."""
         kind, msg = self.recv_any()
+        if kind == "raw":
+            raise ProtocolError(
+                "unexpected binary frame on a pickled-dialect connection")
         return msg if kind == "msg" else None
 
     def recv_any(self):

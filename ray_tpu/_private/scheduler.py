@@ -397,7 +397,7 @@ class Scheduler:
         # core_worker.cc); Python sees the task again only if its worker
         # dies (orphan reap -> retry policy).
         if (self._lane_accept and not self._draining
-                and is_plain_task(spec)):
+                and not self._shutdown and is_plain_task(spec)):
             spec.retries_left = spec.max_retries
             import pickle
 
@@ -538,6 +538,14 @@ class Scheduler:
                       "end_ts": None, "worker_id": None, "actor_id": None,
                       "ok": None}
                 self._task_events[tid] = ev
+            if ev["end_ts"] is not None:
+                # Python already recorded a terminal outcome for this task
+                # (cancel / infeasible fail / retry-exhausted).  First
+                # terminal wins: a stale ring event — non-terminal OR a
+                # racing FINISHED from a force-cancel — must not overwrite
+                # it, or the state API would contradict the error the
+                # caller received.
+                continue
             ev["state"] = state
             if state == "RUNNING" and ev["start_ts"] is None:
                 ev["start_ts"] = ts
@@ -1194,10 +1202,10 @@ class Scheduler:
             self.cancel(msg["task_id"], msg.get("force", False))
         elif t == "blocked":
             if ctx.worker is not None:
-                self._on_worker_blocked(ctx.worker)
+                self._on_worker_blocked(ctx.worker, msg.get("task_id"))
         elif t == "unblocked":
             if ctx.worker is not None:
-                self._on_worker_unblocked(ctx.worker)
+                self._on_worker_unblocked(ctx.worker, msg.get("task_id"))
         elif t == "rpc":
             def run_rpc():
                 try:
@@ -1754,7 +1762,8 @@ class Scheduler:
     # ------------------------------------------------------------------
     # Worker lifecycle events
     # ------------------------------------------------------------------
-    def _on_worker_blocked(self, worker: WorkerState):
+    def _on_worker_blocked(self, worker: WorkerState,
+                           task_id: Optional[bytes] = None):
         with self._lock:
             worker.blocked_count += 1
             # Only CPU is released while blocked: TPU chips (and custom
@@ -1778,10 +1787,15 @@ class Scheduler:
                 self._wake.notify_all()
             if self._raylet_native and worker.blocked_count == 1 \
                     and worker.conn_id is not None:
-                # a native-lane task blocking in get(): C++ tracks its CPU
-                self._node_srv.raylet_block_worker(worker.conn_id)
+                # a native-lane task blocking in get(): C++ tracks its CPU.
+                # Pass the blocking task's id so a stale notification cannot
+                # release the CPU of a NEWER task dispatched to the same
+                # conn after C++ consumed this task's DONE frame.
+                self._node_srv.raylet_block_worker(
+                    worker.conn_id, task_id or b"")
 
-    def _on_worker_unblocked(self, worker: WorkerState):
+    def _on_worker_unblocked(self, worker: WorkerState,
+                             task_id: Optional[bytes] = None):
         with self._lock:
             worker.blocked_count = max(0, worker.blocked_count - 1)
             if worker.blocked_count == 0 and worker.blocked_resources:
@@ -1803,7 +1817,8 @@ class Scheduler:
                     self._res_force_acquire(res)
             if self._raylet_native and worker.blocked_count == 0 \
                     and worker.conn_id is not None:
-                self._node_srv.raylet_unblock_worker(worker.conn_id)
+                self._node_srv.raylet_unblock_worker(
+                    worker.conn_id, task_id or b"")
 
     def _on_task_done(self, worker: WorkerState, msg: dict):
         task_id = msg["task_id"]

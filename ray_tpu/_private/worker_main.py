@@ -66,7 +66,8 @@ class WorkerRuntime:
             rpc_fn=self._rpc,
             worker_id=self.worker_id,
             block_notify_fn=lambda blocked: self.conn.send(
-                {"t": "blocked" if blocked else "unblocked"}),
+                {"t": "blocked" if blocked else "unblocked",
+                 "task_id": self.ctx.current_task_id}),
             seal_notify_fn=self._notify_sealed,
             gcs_address=os.environ.get("RTPU_GCS_ADDRESS") or None,
         )

@@ -570,24 +570,20 @@ struct ServerCore {
         r->sealed_marker = true;
         emit_sealed = true;
       }
+      // Infeasible tasks are routed at SUBMIT time (node totals are
+      // immutable after raylet_enable, so the check is O(1) per task and
+      // never needs a queue scan); the pump only publishes the wake-up so
+      // Python fails them — unconditionally, NOT gated on idle workers.
+      if (!r->infeasible.empty() && !r->infeasible_marker) {
+        r->infeasible_marker = true;
+        emit_infeasible = true;
+      }
       // First-fit over the WHOLE queue: a head task waiting for capacity
       // must not wedge smaller tasks behind it (the Python lane requeues
-      // unschedulable specs and keeps going — same semantics here), and
-      // a task whose demand exceeds node TOTALS is failed, not queued
-      // forever.
+      // unschedulable specs and keeps going — same semantics here).
       for (auto it = r->pending.begin();
            it != r->pending.end() && !r->idle.empty();) {
         RayletCore::Pending& p = *it;
-        auto tot = r->total.find("CPU");
-        if (p.cpu > (tot == r->total.end() ? 0.0 : tot->second)) {
-          r->infeasible.push_back(std::move(p.assign));
-          if (!r->infeasible_marker) {
-            r->infeasible_marker = true;
-            emit_infeasible = true;
-          }
-          it = r->pending.erase(it);
-          continue;
-        }
         if (p.cpu > 0) {
           std::map<std::string, double> need{{"CPU", p.cpu}};
           if (!r->try_acquire_locked(need)) {
@@ -661,8 +657,15 @@ struct ServerCore {
       std::lock_guard<std::mutex> g(r->mu);
       r->n_submitted++;
       r->push_event_locked(tid, name, 0);
-      r->pending.push_back(
-          {std::move(tid), std::move(name), cpu, std::move(assign)});
+      auto tot = r->total.find("CPU");
+      if (cpu > (tot == r->total.end() ? 0.0 : tot->second)) {
+        // demand exceeds node totals: fail fast even with zero idle
+        // workers — never queue what can never run
+        r->infeasible.push_back(std::move(assign));
+      } else {
+        r->pending.push_back(
+            {std::move(tid), std::move(name), cpu, std::move(assign)});
+      }
       return true;
     }
     if (k == 0x12) {  // DONE
@@ -1043,9 +1046,14 @@ static PyObject* Server_raylet_submit(ServerObject* self, PyObject* args) {
     std::lock_guard<std::mutex> g(r->mu);
     r->n_submitted++;
     r->push_event_locked(t, std::string(name, size_t(name_len)), 0);
-    r->pending.push_back({std::move(t),
-                          std::string(name, size_t(name_len)), cpu,
-                          std::move(assign)});
+    auto tot = r->total.find("CPU");
+    if (cpu > (tot == r->total.end() ? 0.0 : tot->second)) {
+      r->infeasible.push_back(std::move(assign));
+    } else {
+      r->pending.push_back({std::move(t),
+                            std::string(name, size_t(name_len)), cpu,
+                            std::move(assign)});
+    }
   }
   PyBuffer_Release(&tid);
   PyBuffer_Release(&payload);
@@ -1137,9 +1145,17 @@ static PyObject* Server_raylet_block_worker(ServerObject* self,
                                             PyObject* args) {
   // The worker's running native task entered a blocking get: release its
   // CPU back to the ledger so dependency chains cannot deadlock the node
-  // (reference: NotifyDirectCallTaskBlocked, node_manager.cc).
+  // (reference: NotifyDirectCallTaskBlocked, node_manager.cc).  When the
+  // notification names the blocking task, only that task's CPU is
+  // released — a stale "blocked" arriving after C++ already completed the
+  // task and dispatched a new one to the same conn must not credit the
+  // NEW task's CPU.
   unsigned long long conn_id;
-  if (!PyArg_ParseTuple(args, "K", &conn_id)) return nullptr;
+  const char* tid_buf = nullptr;
+  Py_ssize_t tid_len = 0;
+  if (!PyArg_ParseTuple(args, "K|y#", &conn_id, &tid_buf, &tid_len))
+    return nullptr;
+  std::string want(tid_buf ? tid_buf : "", (size_t)tid_len);
   RayletCore* r = raylet_of(self);
   if (!r) return nullptr;
   {
@@ -1147,6 +1163,7 @@ static PyObject* Server_raylet_block_worker(ServerObject* self,
     auto inf = r->inflight.find(conn_id);
     if (inf != r->inflight.end()) {
       for (auto& [tid, fl] : inf->second) {
+        if (!want.empty() && tid != want) continue;
         if (!fl.blocked) {
           fl.blocked = true;
           r->avail["CPU"] += fl.cpu;
@@ -1161,8 +1178,13 @@ static PyObject* Server_raylet_block_worker(ServerObject* self,
 static PyObject* Server_raylet_unblock_worker(ServerObject* self,
                                               PyObject* args) {
   // Unconditional re-deduct (transient oversubscription accepted).
+  // Matches the task-scoped release in raylet_block_worker.
   unsigned long long conn_id;
-  if (!PyArg_ParseTuple(args, "K", &conn_id)) return nullptr;
+  const char* tid_buf = nullptr;
+  Py_ssize_t tid_len = 0;
+  if (!PyArg_ParseTuple(args, "K|y#", &conn_id, &tid_buf, &tid_len))
+    return nullptr;
+  std::string want(tid_buf ? tid_buf : "", (size_t)tid_len);
   RayletCore* r = raylet_of(self);
   if (!r) return nullptr;
   {
@@ -1170,6 +1192,7 @@ static PyObject* Server_raylet_unblock_worker(ServerObject* self,
     auto inf = r->inflight.find(conn_id);
     if (inf != r->inflight.end()) {
       for (auto& [tid, fl] : inf->second) {
+        if (!want.empty() && tid != want) continue;
         if (fl.blocked) {
           fl.blocked = false;
           r->avail["CPU"] -= fl.cpu;
@@ -1497,9 +1520,12 @@ static PyMethodDef Server_methods[] = {
      "raylet_set_accept(bool): route 0x10 SUBMITs natively or to Python"},
     {"raylet_block_worker", (PyCFunction)Server_raylet_block_worker,
      METH_VARARGS,
-     "raylet_block_worker(conn_id): release the running native task's CPU"},
+     "raylet_block_worker(conn_id[, task_id]): release the blocking "
+     "task's CPU (all of the conn's tasks when task_id is omitted)"},
     {"raylet_unblock_worker", (PyCFunction)Server_raylet_unblock_worker,
-     METH_VARARGS, "raylet_unblock_worker(conn_id): re-deduct"},
+     METH_VARARGS,
+     "raylet_unblock_worker(conn_id[, task_id]): re-deduct the matching "
+     "task's CPU"},
     {"raylet_reap_orphans", (PyCFunction)Server_raylet_reap_orphans,
      METH_VARARGS,
      "raylet_reap_orphans(conn_id) -> [assign frames of that dead "

@@ -199,6 +199,35 @@ def test_infeasible_task_fails_fast(cluster):
         ray_tpu.get(ref, timeout=60)
 
 
+def test_infeasible_fails_fast_with_no_idle_workers(cluster):
+    """Infeasibility detection must not be gated on idle-worker
+    availability (advisor r4): with every worker busy, an infeasible
+    task still fails promptly instead of hanging in the C++ queue."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def blocker(key):
+        while not os.path.exists(key):
+            time.sleep(0.05)
+        return "held"
+
+    @ray_tpu.remote(num_cpus=512)
+    def impossible():
+        return 1
+
+    key = f"/tmp/rtpu_infeas_{os.urandom(4).hex()}"
+    blockers = [blocker.remote(key) for _ in range(8)]
+    time.sleep(0.5)  # let blockers occupy every CPU
+    ref = impossible.remote()
+    try:
+        with pytest.raises(ValueError, match="total resources"):
+            ray_tpu.get(ref, timeout=30)
+    finally:
+        open(key, "w").close()
+        ray_tpu.get(blockers, timeout=90)
+        os.unlink(key)
+
+
 def test_cancel_queued_native_task(cluster):
     import ray_tpu
     from ray_tpu.exceptions import TaskCancelledError
