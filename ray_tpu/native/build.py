@@ -55,19 +55,40 @@ def _source_hash(sources: list[str]) -> str:
     return h.hexdigest()[:16]
 
 
+# RTPU_SANITIZE=1 builds every native component with ASan+UBSan (separate
+# cache namespace, so sanitized and fast binaries coexist).  Used by
+# `make sanitize` — see Makefile — to run the native test files against
+# instrumented builds.
+_SANITIZE = os.environ.get("RTPU_SANITIZE", "0") == "1"
+_SAN_FLAGS = ["-fsanitize=address,undefined", "-fno-omit-frame-pointer",
+              "-g", "-O1"]
+
+
 def binary_path(name: str) -> str:
     """Return the path to a built native binary, compiling it if needed."""
     spec = _BINARIES[name]
     # headers participate in the cache key but not the compile line
     tag = _source_hash(spec["sources"] + spec.get("headers", []))
+    if _SANITIZE:
+        tag += "-asan"
     out = os.path.join(_BUILD_DIR,
                        f"{name}-{tag}{spec.get('suffix', '')}")
+    if _SANITIZE and spec.get("suffix") == ".so" \
+            and "asan" not in os.environ.get("LD_PRELOAD", ""):
+        # Loading an ASan-linked DSO into an uninstrumented interpreter
+        # aborts the process with a cryptic "ASan runtime does not come
+        # first" — fail actionably instead.
+        raise RuntimeError(
+            "RTPU_SANITIZE=1 requires libasan/libubsan in LD_PRELOAD; "
+            "use `make sanitize`")
     if os.path.exists(out):
         return out
     os.makedirs(_BUILD_DIR, exist_ok=True)
     srcs = [os.path.join(_NATIVE_DIR, s) for s in spec["sources"]]
     tmp = out + f".tmp.{os.getpid()}"
     flags = list(spec["flags"])
+    if _SANITIZE:
+        flags = [f for f in flags if not f.startswith("-O")] + _SAN_FLAGS
     if spec.get("python_ext"):
         import sysconfig
 
