@@ -72,6 +72,9 @@ class NodeInfo:
     # socket addresses other nodes use to reach this node
     sched_socket: str = ""
     store_socket: str = ""
+    # store daemon's TCP transfer listener ("host:port", "" = disabled):
+    # the daemon-to-daemon object data plane (shm_store.cc)
+    xfer_addr: str = ""
     is_head: bool = False
     # live load view, refreshed by heartbeats
     available: dict = field(default_factory=dict)

@@ -24,6 +24,14 @@ from ray_tpu.core.store_client import StoreClient, StoreServer
 DEFAULT_STORE_CAPACITY = 1 << 31  # 2 GiB host staging tier
 
 
+def _cluster_token_or_empty() -> str:
+    """This cluster's shared-secret token ("" for tokenless local
+    clusters) — authenticates store-daemon transfer peers too."""
+    from ray_tpu._private import protocol
+
+    return protocol.cluster_token() or ""
+
+
 def detect_num_tpu_chips() -> int:
     env = os.environ.get("RAY_TPU_NUM_CHIPS")
     if env is not None:
@@ -134,6 +142,11 @@ class Node:
             # memory pressure spills sealed objects to disk instead of
             # dropping them (reference: object spilling, SURVEY §2.1)
             spill_dir=os.path.join(self.session_dir, "spill"),
+            # daemon-to-daemon transfer plane: TCP clusters bind the
+            # node's interface; local (unix) clusters use loopback so
+            # in-process multi-node tests exercise the native path too
+            xfer_host=self.listen_host or "127.0.0.1",
+            cluster_token=_cluster_token_or_empty(),
         )
         if self.listen_host:
             sched_socket = f"{self.listen_host}:0"  # kernel-assigned port
@@ -179,7 +192,10 @@ class Node:
             gcs_address=self.gcs_address,
             node_resources=merged,
             min_workers=min_workers,
-            max_workers=max_workers or max(4, int(merged.get("CPU", 4)) * 2),
+            # None = size from CPUs; an EXPLICIT 0 means no real workers
+            # (scale harness / driver-only nodes), never the default
+            max_workers=(max(4, int(merged.get("CPU", 4)) * 2)
+                         if max_workers is None else max_workers),
             node_id=self.node_id,
             is_head=head,
             labels=self.labels,
@@ -187,10 +203,15 @@ class Node:
         # Register AFTER the scheduler binds: with TCP the advertised
         # address carries the kernel-assigned port.
         self.sched_address = self.scheduler.socket_path
+        xfer_addr = ""
+        if self.store_server.xfer_port:
+            xfer_addr = (f"{self.store_server.xfer_host}:"
+                         f"{self.store_server.xfer_port}")
         self.gcs.register_node(NodeInfo(
             self.node_id, resources=dict(merged), is_head=head,
             sched_socket=self.sched_address,
             store_socket=self.store_server.socket_path,
+            xfer_addr=xfer_addr,
             labels=self.labels))
         if head:
             # Job submission lives on the head (reference: JobManager in the

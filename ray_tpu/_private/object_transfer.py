@@ -11,6 +11,7 @@ messages.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -18,6 +19,8 @@ from typing import Callable, Optional
 
 from ray_tpu._private import protocol
 from ray_tpu._private.task_spec import FETCH_CHUNK
+
+_BAN_S = 5.0  # reference: pull retry ban window
 
 
 class _Partial:
@@ -50,6 +53,13 @@ class ObjectTransfer:
         self._is_shutdown = is_shutdown
         self._pulls: set[bytes] = set()  # oids with an in-flight pull
         self._pull_lock = threading.Lock()
+        # Pull ban list (reference: pull_manager.cc retry/ban): a location
+        # that failed a fetch is skipped until its ban expires, so a
+        # flapping peer does not absorb every retry while a healthy
+        # replica waits.
+        self._banned: dict[tuple[bytes, bytes], float] = {}
+        self._native_xfer = os.environ.get("RTPU_NATIVE_TRANSFER",
+                                           "1") != "0"
         # push side (reference: push_manager.cc)
         self._pushes: set[tuple[bytes, bytes]] = set()
         self._push_sem = threading.Semaphore(self._PUSH_CONCURRENCY)
@@ -163,13 +173,35 @@ class ObjectTransfer:
                 remote = [n for n in locs if n != self._node_id]
                 if not remote:
                     return  # not sealed anywhere else yet
+                now = time.monotonic()
                 for nid in remote:
+                    ban = self._banned.get((nid, oid))
+                    if ban is not None and now < ban:
+                        continue  # recently failed from here: skip
                     node = self._lookup_node(nid)
                     if node is None or not node.alive or not node.sched_socket:
                         continue
+                    # Native data plane first: the two store daemons
+                    # stream the extent directly (shm_store.cc); the
+                    # framed Python fetch is the fallback (chaos mode /
+                    # a peer without a transfer listener).
+                    if self._native_xfer and getattr(node, "xfer_addr", ""):
+                        try:
+                            if self._store.pull_remote(oid, node.xfer_addr):
+                                self.note_sealed(oid)
+                                return
+                        except Exception:
+                            pass  # daemon conn trouble: framed fallback
                     if self._fetch_from(node.sched_socket, oid):
                         self.note_sealed(oid)
                         return
+                    # both planes failed: ban this location briefly
+                    self._banned[(nid, oid)] = time.monotonic() + _BAN_S
+                    if len(self._banned) > 4096:
+                        cutoff = time.monotonic()
+                        self._banned = {k: v for k, v
+                                        in self._banned.items()
+                                        if v > cutoff}
                 time.sleep(0.1)
         finally:
             with self._pull_lock:
@@ -245,13 +277,31 @@ class ObjectTransfer:
                 return False
             self._pushes.add(key)
         threading.Thread(target=self._push_object,
-                         args=(key, node.sched_socket),
+                         args=(key, node.sched_socket,
+                               getattr(node, "xfer_addr", "")),
                          name="obj-push", daemon=True).start()
         return True
 
-    def _push_object(self, key, sched_addr: str):
+    def _push_object(self, key, sched_addr: str, xfer_addr: str = ""):
         oid = key[1]
         with self._push_sem:
+            if self._native_xfer and xfer_addr:
+                # native plane: one OP_PUSH to the local daemon, which
+                # streams the pinned extent to the peer daemon itself
+                try:
+                    if self._store.push_remote(oid, xfer_addr):
+                        # the pusher knows the copy landed: advertise the
+                        # peer's location (the peer daemon cannot reach
+                        # the GCS itself)
+                        try:
+                            self._gcs.add_object_location(oid, key[0])
+                        except Exception:
+                            pass
+                        with self._pull_lock:
+                            self._pushes.discard(key)
+                        return
+                except Exception:
+                    pass  # fall through to the framed chunk path
             try:
                 view = self._store.get(oid, 0)
                 if view is None:

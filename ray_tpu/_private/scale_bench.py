@@ -32,6 +32,19 @@ import os
 import time
 
 
+PROGRESS_STALL_S = 30.0
+_last_progress = [0.0]
+
+
+def _progress(label: str, done: int, total: int, t0: float):
+    """At most one status line per second, always flushed."""
+    now = time.monotonic()
+    if now - _last_progress[0] >= 1.0:
+        _last_progress[0] = now
+        print(f"[scale_bench] {label}: {done}/{total} "
+              f"({now - t0:.1f}s)", flush=True)
+
+
 def _build_plain_spec():
     from ray_tpu._private.task_spec import TaskSpec
 
@@ -56,9 +69,11 @@ def bench_tasks(n_tasks: int = 50_000, sim_workers: int = 16) -> dict:
     fleet = SimWorkerFleet(sched.socket_path, sim_workers)
     fleet.start()
     deadline = time.monotonic() + 30
-    while time.monotonic() < deadline:
-        if sched._node_srv.raylet_stats()["idle"] >= sim_workers:
-            break
+    while sched._node_srv.raylet_stats()["idle"] < sim_workers:
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"sim-worker fleet never became idle: "
+                f"{sched._node_srv.raylet_stats()}")
         time.sleep(0.05)
 
     specs = [_build_plain_spec() for _ in range(n_tasks)]
@@ -68,9 +83,23 @@ def bench_tasks(n_tasks: int = 50_000, sim_workers: int = 16) -> dict:
         sched.submit(spec)
     t_submit = time.monotonic() - t0
     target = base + n_tasks
-    while sched._node_srv.raylet_stats()["done"] < target:
-        if time.monotonic() - t0 > 600:
+    # Per-second progress + stall detection (no silent multi-minute
+    # spins): the drain must make progress every PROGRESS_STALL_S or the
+    # bench fails loudly with the stuck counters.
+    last_done, last_change = base, time.monotonic()
+    while True:
+        done_now = sched._node_srv.raylet_stats()["done"]
+        if done_now >= target:
             break
+        now = time.monotonic()
+        if done_now != last_done:
+            last_done, last_change = done_now, now
+        elif now - last_change > PROGRESS_STALL_S:
+            raise RuntimeError(
+                f"task drain stalled: {done_now - base}/{n_tasks} done, "
+                f"no progress for {PROGRESS_STALL_S}s "
+                f"(stats={sched._node_srv.raylet_stats()})")
+        _progress("tasks", done_now - base, n_tasks, t0)
         time.sleep(0.05)
     t_total = time.monotonic() - t0
     st = sched._node_srv.raylet_stats()
@@ -103,12 +132,16 @@ def bench_actors(n_actors: int = 1_000) -> dict:
     fleet = SimWorkerFleet(sched.socket_path, n_actors + 4)
     fleet.start()
     deadline = time.monotonic() + 60
-    while time.monotonic() < deadline:
+    while True:
         with sched._lock:
             ready = sum(1 for w in sched._workers.values()
                         if w.conn is not None)
         if ready >= n_actors:
             break
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"sim-worker fleet incomplete: {ready}/{n_actors} "
+                f"connected after 60s")
         time.sleep(0.1)
 
     actor_ids = [os.urandom(16) for _ in range(n_actors)]
@@ -123,12 +156,21 @@ def bench_actors(n_actors: int = 1_000) -> dict:
     t_submit = time.monotonic() - t0
     gcs = sched.gcs
     alive = 0
-    while time.monotonic() - t0 < 600:
+    last_alive, last_change = 0, time.monotonic()
+    while True:
         alive = sum(1 for aid in actor_ids
                     if (info := gcs.get_actor(aid)) is not None
                     and info.state == gcs_mod.ALIVE)
         if alive >= n_actors:
             break
+        now = time.monotonic()
+        if alive != last_alive:
+            last_alive, last_change = alive, now
+        elif now - last_change > PROGRESS_STALL_S:
+            raise RuntimeError(
+                f"actor creation stalled: {alive}/{n_actors} ALIVE, "
+                f"no progress for {PROGRESS_STALL_S}s")
+        _progress("actors", alive, n_actors, t0)
         time.sleep(0.25)
     t_total = time.monotonic() - t0
     fleet.close()
@@ -155,11 +197,14 @@ def bench_pgs_and_nodes(n_nodes: int = 20, n_pgs: int = 100) -> dict:
                       head_node_args={"min_workers": 0, "max_workers": 2,
                                       "resources": {"CPU": 8.0},
                                       "object_store_memory": 1 << 26})
+    # the driver must attach to the head before any PG API call
+    ray_tpu.init(_existing_node=cluster.head_node)
     t0 = time.monotonic()
-    for _ in range(n_nodes - 1):
+    for i in range(n_nodes - 1):
         cluster.add_node(min_workers=0, max_workers=0,
                          resources={"CPU": 8.0},
                          object_store_memory=1 << 26)
+        _progress("nodes", i + 2, n_nodes, t0)
     n_up = cluster.wait_for_nodes(timeout=120)
     t_nodes = time.monotonic() - t0
 
@@ -169,18 +214,23 @@ def bench_pgs_and_nodes(n_nodes: int = 20, n_pgs: int = 100) -> dict:
         pgs.append(placement_group([{"CPU": 1}], strategy="PACK"))
     created = 0
     deadline = time.monotonic() + 300
-    for pg in pgs:
+    for i, pg in enumerate(pgs):
         try:
             if pg.wait(max(1.0, deadline - time.monotonic())):
                 created += 1
         except Exception:
             pass
+        _progress("pgs", i + 1, n_pgs, t0)
     t_pgs = time.monotonic() - t0
+    if created < n_pgs:
+        print(f"[scale_bench] WARNING: only {created}/{n_pgs} PGs "
+              f"created within the deadline", flush=True)
     for pg in pgs:
         try:
             remove_placement_group(pg)
         except Exception:
             pass
+    ray_tpu.shutdown()
     cluster.shutdown()
     return {
         "n_nodes": n_up,

@@ -290,6 +290,9 @@ class Scheduler:
         # through _res_* so the two lanes cannot drift.
         self._raylet_native = False
         self._lane_accept = False  # plain submits ride the native lane
+        # forwarded specs executing on this node's native lane, keyed by
+        # task id: the origin is notified when the ring reports terminal
+        self._native_spilled: dict[bytes, TaskSpec] = {}
         self._conn_workers: dict[int, WorkerState] = {}
         self._last_grow_check = 0.0
         core = direct_mod.native_core()
@@ -302,11 +305,15 @@ class Scheduler:
                 self._node_srv.raylet_enable(
                     {k: float(v) for k, v in node_resources.items()})
                 self._raylet_native = True
-                # head nodes start single-node (lane on); worker nodes are
-                # by definition multi-node (policy path, so spillback and
-                # PG routing still apply) — the heartbeat keeps this fresh
-                self._lane_accept = is_head
-                self._node_srv.raylet_set_accept(self._lane_accept)
+                self._native_total_cpu = float(
+                    node_resources.get("CPU", 0.0))
+                # The lane is on for EVERY node, head or worker, single-
+                # or multi-node: locally-feasible plain tasks always
+                # dispatch in C++.  Spillback stays Python — the heartbeat
+                # balancer steals only the excess backlog a saturated node
+                # cannot run and hands it to the policy path.
+                self._lane_accept = True
+                self._node_srv.raylet_set_accept(True)
             self._accept_thread = threading.Thread(
                 target=self._native_serve_loop, name="sched-serve",
                 daemon=True)
@@ -397,7 +404,8 @@ class Scheduler:
         # core_worker.cc); Python sees the task again only if its worker
         # dies (orphan reap -> retry policy).
         if (self._lane_accept and not self._draining
-                and not self._shutdown and is_plain_task(spec)):
+                and not self._shutdown and is_plain_task(spec)
+                and self._native_can_take(spec)):
             spec.retries_left = spec.max_retries
             import pickle
 
@@ -446,7 +454,25 @@ class Scheduler:
     def submit_spilled(self, spec: TaskSpec):
         """Accept a spec forwarded by another node's scheduler (reference:
         the spillback re-lease in normal_task_submitter.cc:352).  Skips
-        actor registration — the originating node already did it."""
+        actor registration — the originating node already did it.
+
+        Plain specs ride this node's native lane (C++ dispatch even in a
+        multi-node cluster); the origin is notified from the event merge
+        when the ring reports the task terminal."""
+        if (self._lane_accept and not self._draining
+                and not self._shutdown and is_plain_task(spec)
+                and self._native_can_take(spec)):
+            import pickle
+
+            if spec.origin_node and spec.origin_node != self.node_id:
+                self._native_spilled[spec.task_id] = spec
+            self._node_srv.raylet_submit(
+                spec.task_id,
+                float((spec.resources or {}).get("CPU", 0)),
+                spec.name or "",
+                pickle.dumps(spec, protocol=5))
+            self._maybe_grow_native()
+            return
         with self._lock:
             if self._shutdown:
                 return
@@ -552,6 +578,11 @@ class Scheduler:
             elif state in ("FINISHED", "FAILED"):
                 ev["end_ts"] = ts
                 ev["ok"] = state == "FINISHED"
+                spilled = self._native_spilled.pop(tid, None)
+                if spilled is not None:
+                    # forwarded spec finished on this node's native lane:
+                    # tell the origin so its recovery record clears
+                    self._notify_origin(spilled)
                 exporter = getattr(self, "_event_exporter", None)
                 if exporter is None:
                     from ray_tpu.util.events import get_exporter
@@ -586,6 +617,82 @@ class Scheduler:
                         return True
             return False
 
+    def _native_can_take(self, spec: TaskSpec) -> bool:
+        """Route a plain spec into the C++ lane?  Locally feasible → yes.
+        Over local totals → only when no alive peer's totals could run it
+        either, so the C++ infeasible path fails it fast with the
+        single-node error; when a peer COULD run it, the Python policy
+        path must forward it instead (e.g. a 0-CPU driver node in a real
+        cluster forwards everything)."""
+        if self._pool.max_workers <= 0 and not self._pool.workers:
+            # a node that can never host a worker (driver-only shells,
+            # harness nodes) must leave plain tasks on the policy path —
+            # the C++ queue would hold them forever
+            return False
+        cpu = float((spec.resources or {}).get("CPU", 0))
+        if cpu <= self._native_total_cpu:
+            return True
+        for nid, n in self._cluster_nodes.items():
+            if nid == self.node_id or not n.alive:
+                continue
+            if float(n.resources.get("CPU", 0)) >= cpu:
+                return False
+        return True
+
+    def _balance_native_backlog(self, nodes, alive):
+        """Spillback bridge for the multi-node native lane: when the C++
+        queue holds more work than this node can absorb (idle workers +
+        spawnable headroom) and a live peer advertises free CPU, steal
+        just that excess off the BACK of the native queue and push it to
+        the Python policy path, whose load-aware placement forwards it.
+        The oldest tasks keep their native dispatch position; a node with
+        local capacity never gives work away."""
+        try:
+            st = self._node_srv.raylet_stats()
+        except Exception:
+            return
+        backlog = st.get("pending", 0)
+        if backlog <= 0:
+            return
+        # CPU is the binding constraint (workers spawn on demand): tasks
+        # beyond the ledger's free CPU cannot start here now.
+        try:
+            avail_cpu = float(
+                self._node_srv.raylet_snapshot().get("CPU", 0.0))
+        except Exception:
+            return
+        excess = backlog - int(avail_cpu)
+        if excess <= 0:
+            return
+        peer_free = 0.0
+        for nid, n in nodes.items():
+            if nid == self.node_id or nid not in alive:
+                continue
+            peer_free += max(0.0, float(n.available.get("CPU", 0.0))
+                             - float(getattr(n, "queued", 0)))
+        k = min(excess, int(peer_free))
+        if k <= 0:
+            return
+        import pickle
+
+        try:
+            frames = self._node_srv.raylet_steal_pending(k)
+        except Exception:
+            return
+        with self._lock:
+            for frame in frames:
+                try:
+                    tl = frame[1]
+                    spec = pickle.loads(frame[2 + tl:])
+                except Exception:
+                    continue
+                # back on the policy path: origin notification now comes
+                # from _on_task_done/_fail_task/_forward, not the ring
+                self._native_spilled.pop(spec.task_id, None)
+                self._pending.append(spec)
+                self._task_index[spec.task_id] = spec
+            self._wake.notify_all()
+
     def _steal_native_pending(self):
         """Move the native queue onto the Python pending deque (load-aware
         placement + spillback apply from here on)."""
@@ -604,6 +711,7 @@ class Scheduler:
                     spec = pickle.loads(frame[2 + tl:])
                 except Exception:
                     continue
+                self._native_spilled.pop(spec.task_id, None)
                 self._pending.append(spec)
                 self._task_index[spec.task_id] = spec
                 self._record_task_event_locked(spec, "PENDING")
@@ -735,16 +843,40 @@ class Scheduler:
                                strategy: str) -> bool:
         """Cluster-wide gang reservation: assign each bundle to a node by
         strategy, then 2PC-reserve (all nodes or none — rollback on any
-        failure)."""
-        assignment = self._assign_bundles(bundles, strategy)
-        if assignment is None:
+        failure).  A node that refuses (its live ledger is ahead of the
+        heartbeat-cached view, e.g. during a PG creation burst) is
+        excluded and the assignment retried, and successful reserves are
+        deducted from the cached view so back-to-back creations don't
+        funnel into the same stale-looking node."""
+        exclude: set[bytes] = set()
+        for _attempt in range(8):
+            assignment = self._assign_bundles(bundles, strategy, exclude)
+            if assignment is None:
+                return False
+            ok, failed_node = self._reserve_assignment(
+                pg_id, bundles, strategy, assignment)
+            if ok:
+                break
+            if failed_node is None:
+                return False
+            exclude.add(failed_node)
+        if not ok:
             return False
-        # group bundle indices per node
+        self.gcs.register_pg(pg_id, [dict(b) for b in bundles], strategy,
+                             assignment)
+        return True
+
+    def _reserve_assignment(self, pg_id: bytes, bundles: list[dict],
+                            strategy: str, assignment: list[bytes]):
+        """2PC-reserve one assignment.  Returns (ok, failed_node): on
+        failure every prior reserve is rolled back and the refusing node
+        is reported so the caller can exclude it and retry."""
         per_node: dict[bytes, dict[int, dict]] = {}
         for idx, node_id in enumerate(assignment):
             per_node.setdefault(node_id, {})[idx] = bundles[idx]
         reserved: list[bytes] = []
         ok = True
+        failed_node = None
         for node_id, subset in per_node.items():
             if node_id == self.node_id:
                 ok = self.pg_reserve(pg_id, subset, strategy)
@@ -758,8 +890,18 @@ class Scheduler:
                 except Exception:
                     ok = False
             if not ok:
+                failed_node = node_id
                 break
             reserved.append(node_id)
+            if node_id != self.node_id:
+                # deduct from the cached view NOW: a creation burst must
+                # not keep assigning into capacity this PG just took
+                info = self._cluster_nodes.get(node_id)
+                if info is not None:
+                    for b in subset.values():
+                        for k, v in b.items():
+                            info.available[k] = \
+                                info.available.get(k, 0) - v
         if not ok:
             for node_id in reserved:  # rollback
                 if node_id == self.node_id:
@@ -772,24 +914,45 @@ class Scheduler:
                                                  {"pg_id": pg_id})
                     except Exception:
                         pass
-            return False
-        self.gcs.register_pg(pg_id, [dict(b) for b in bundles], strategy,
-                             assignment)
-        return True
+                    # restore the cached-view deduction made above, or
+                    # the retry (and task placement until the next
+                    # heartbeat) sees phantom-consumed capacity
+                    info = self._cluster_nodes.get(node_id)
+                    if info is not None:
+                        for b in per_node[node_id].values():
+                            for k, v in b.items():
+                                info.available[k] = \
+                                    info.available.get(k, 0) + v
+        return ok, failed_node
 
-    def _assign_bundles(self, bundles: list[dict],
-                        strategy: str) -> Optional[list[bytes]]:
+    def _assign_bundles(self, bundles: list[dict], strategy: str,
+                        exclude: Optional[set] = None
+                        ) -> Optional[list[bytes]]:
         """Build the cluster availability view, then delegate to the bundle
         policy.  Reads the GCS directly (not the heartbeat-cached view): PG
-        creation is rare and must see nodes that joined in the last tick."""
+        creation is rare and must see nodes that joined in the last tick.
+        ``exclude``: nodes that refused a reserve this creation (stale
+        availability) — retried assignments skip them."""
         with self._lock:
             avail: dict[bytes, dict] = {self.node_id: self._res_snapshot()}
         try:
             nodes = {n.node_id: n for n in self.gcs.list_nodes()}
+            # keep live deductions made by _reserve_assignment: a GCS
+            # refresh must not resurrect capacity a concurrent burst of
+            # creations already took (heartbeats catch up within a tick)
+            prev = self._cluster_nodes
+            for nid, n in nodes.items():
+                old = prev.get(nid)
+                if old is not None and old is not n:
+                    for k, v in old.available.items():
+                        if v < n.available.get(k, 0):
+                            n.available[k] = v
             self._cluster_nodes = nodes
         except Exception:
             nodes = self._cluster_nodes
         for nid, n in nodes.items():
+            if exclude and nid in exclude:
+                continue
             if nid != self.node_id and n.alive:
                 avail[nid] = dict(n.available)
         return cluster_mod.assign_bundles(avail, bundles, strategy)
@@ -1610,6 +1773,13 @@ class Scheduler:
                     available = {} if self._draining \
                         else self._res_snapshot()
                     queued = len(self._pending)
+                if self._raylet_native:
+                    # peers must see native backlog too, or their
+                    # balancers would spill onto an already-loaded node
+                    try:
+                        queued += self._node_srv.raylet_stats()["pending"]
+                    except Exception:
+                        pass
                 self.gcs.heartbeat(self.node_id, available, queued)
                 if self.is_head:
                     self.gcs.check_node_health()
@@ -1627,18 +1797,22 @@ class Scheduler:
                     with self._lock:
                         self._wake.notify_all()
                 if self._raylet_native:
-                    # the native fast lane is a SINGLE-NODE optimization:
-                    # with peers alive, plain tasks need the Python policy
-                    # path (spillback, load-aware placement)
-                    accept = (self.is_head and not self._draining
-                              and not (alive - {self.node_id}))
+                    # Plain tasks dispatch in C++ on every node; only a
+                    # draining node routes submits to the policy path
+                    # (which refuses/forwards them).
+                    accept = not self._draining
                     if accept != self._lane_accept:
                         self._lane_accept = accept
                         self._node_srv.raylet_set_accept(accept)
                     if not accept:
-                        # reclaim anything queued during the transition
-                        # window so the policy path can spill it to peers
+                        # drain: reclaim the queue so the policy path can
+                        # spill it to peers
                         self._steal_native_pending()
+                    elif alive - {self.node_id}:
+                        # saturated? move excess backlog to the Python
+                        # policy path, which spills it to peers with
+                        # advertised free capacity
+                        self._balance_native_backlog(nodes, alive)
                     self._maybe_grow_native()
                     with self._lock:
                         # keep the event table/export pipeline current
@@ -1702,6 +1876,7 @@ class Scheduler:
         return True
 
     def _notify_origin(self, spec: TaskSpec):
+        self._native_spilled.pop(spec.task_id, None)
         if spec.origin_node and spec.origin_node != self.node_id:
             self._links.send(spec.origin_node,
                              {"t": "spilled_done", "task_id": spec.task_id})

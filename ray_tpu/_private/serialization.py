@@ -91,6 +91,20 @@ def serialized_size(value) -> tuple[int, object]:
     return 1 + len(blob), ("pickle", blob)
 
 
+def payload_parts(token) -> list:
+    """The payload as a list of buffers (header bytes + zero-copy views),
+    for vectored sends that skip the scratch-buffer assembly a contiguous
+    write_payload needs.  Concatenation of the parts == the write_payload
+    image."""
+    kind = token[0]
+    if kind == "array":
+        _, meta, arr = token
+        header = bytes([TAG_ARRAY]) + _U32.pack(len(meta)) + meta
+        return [header, arr.reshape(-1).view(np.uint8).data]
+    _, blob = token
+    return [bytes([TAG_PICKLE]), blob]
+
+
 def write_payload(buf: memoryview, token) -> None:
     kind = token[0]
     if kind == "array":

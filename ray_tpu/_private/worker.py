@@ -20,7 +20,8 @@ from typing import Callable, Optional
 import cloudpickle
 
 from ray_tpu._private import ids
-from ray_tpu._private.serialization import deserialize, serialized_size, write_payload
+from ray_tpu._private.serialization import (
+    deserialize, payload_parts, serialized_size, write_payload)
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.store_client import ObjectEvictedError, StoreClient
 from ray_tpu.exceptions import GetTimeoutError, ObjectLostError
@@ -381,6 +382,7 @@ class WorkerContext:
         oid = oid or ids.random_object_id()
         size, token = serialized_size(value)
         track_owned = track_owned and size >= _EAGER_DELETE_MIN
+        put_parts = getattr(self.store, "put_parts", None)
         if size <= _INLINE_PUT_MAX:
             # small object: serialize to a scratch buffer and ship it in
             # ONE daemon round trip (OP_PUT) — create/seal round trips
@@ -388,6 +390,12 @@ class WorkerContext:
             scratch = bytearray(size)
             write_payload(memoryview(scratch), token)
             self.store.put(oid, scratch)
+        elif put_parts is not None:
+            # large object: vectored OP_PUT — the raw array view streams
+            # onto the socket with no scratch copy, and the daemon copies
+            # into shm against its warm mapping, in parallel across
+            # clients (client-side mmap writes pay a soft fault per page)
+            put_parts(oid, payload_parts(token), size)
         else:
             buf = self.store.create(oid, size)
             try:

@@ -37,7 +37,7 @@ ST_VIEW = 8  # GET_INLINE: too big to inline; pin kept, (offset, size) back
 
 _OP_CREATE, _OP_SEAL, _OP_GET, _OP_RELEASE = 1, 2, 3, 4
 _OP_DELETE, _OP_CONTAINS, _OP_STATS, _OP_ABORT = 5, 6, 7, 8
-_OP_PUT, _OP_GET_INLINE = 9, 10
+_OP_PUT, _OP_GET_INLINE, _OP_PULL, _OP_PUSH = 9, 10, 11, 12
 
 # Objects at or below this come back as inline bytes from GET_INLINE (one
 # round trip, daemon-side copy, no pin/RELEASE); bigger ones come back as
@@ -76,22 +76,38 @@ class StoreServer:
     """Owns the store daemon process for a node."""
 
     def __init__(self, socket_path: str, shm_name: str, capacity: int,
-                 spill_dir: str = ""):
+                 spill_dir: str = "", xfer_host: str = "",
+                 cluster_token: str = ""):
         self.socket_path = socket_path
         self.shm_name = shm_name
         self.capacity = capacity
         self.spill_dir = spill_dir
+        self.xfer_host = xfer_host
+        # daemon-to-daemon transfer listener port (0 = disabled)
+        self.xfer_port = 0
         args = [binary_path("shm_store"), socket_path, shm_name,
                 str(capacity)]
-        if spill_dir:
+        if spill_dir or xfer_host:
             args.append(spill_dir)
+        if xfer_host:
+            args.append(xfer_host)
+        env = dict(os.environ)
+        if cluster_token:
+            env["RTPU_STORE_TOKEN"] = cluster_token  # env, never argv
         self._proc = subprocess.Popen(
             args,
             stdout=subprocess.PIPE,
+            env=env,
         )
         line = self._proc.stdout.readline()
         if b"READY" not in line:
             raise RuntimeError(f"shm_store failed to start: {line!r}")
+        parts = line.split()
+        if len(parts) > 1:
+            try:
+                self.xfer_port = int(parts[1])
+            except ValueError:
+                pass
 
     def shutdown(self):
         if self._proc.poll() is None:
@@ -247,6 +263,67 @@ class StoreClient:
             raise FileExistsError(f"object {oid.hex()} already exists")
         if status != ST_OK:
             raise RuntimeError(f"put failed: status={status}")
+
+    def put_parts(self, oid: bytes, parts, total: int) -> None:
+        """OP_PUT with a vectored payload: the parts stream straight onto
+        the socket (no client-side scratch assembly), and the daemon's
+        per-connection thread copies them into the fresh extent OUTSIDE
+        the store lock — so concurrent large puts from many clients
+        copy-in in parallel, against the daemon's always-warm mapping
+        (a fresh client mapping pays a soft page fault per 4KB, which
+        dominates large-put cost)."""
+        entry = self._checkout()
+        sock, nc = entry
+        try:
+            # bypass the native conn's single-buffer put: sendall on the
+            # same fd keeps framing; the conn is checked out exclusively
+            sock.sendall(_REQ.pack(_OP_PUT, oid, total, 0))
+            for part in parts:
+                sock.sendall(part)
+            status, _, _ = _RESP.unpack(self._recv_exact(sock, _RESP.size))
+        except BaseException:
+            sock.close()
+            raise
+        self._checkin(entry)
+        if status == ST_OOM:
+            raise StoreFullError(
+                f"object store full allocating {total} bytes")
+        if status == ST_EXISTS:
+            raise FileExistsError(f"object {oid.hex()} already exists")
+        if status != ST_OK:
+            raise RuntimeError(f"put failed: status={status}")
+
+    def _transfer_op(self, op: int, oid: bytes, addr: str):
+        """OP_PULL / OP_PUSH: ask the local daemon to move oid between its
+        segment and the peer daemon at ``addr`` ("host:port") — the data
+        plane never touches this process (see shm_store.cc transfer
+        plane).  Returns (status, size)."""
+        payload = addr.encode("utf-8")
+        entry = self._checkout()
+        sock, nc = entry
+        try:
+            sock.sendall(_REQ.pack(op, oid, len(payload), 0) + payload)
+            status, _, size = _RESP.unpack(
+                self._recv_exact(sock, _RESP.size))
+        except BaseException:
+            sock.close()
+            raise
+        self._checkin(entry)
+        return status, size
+
+    def pull_remote(self, oid: bytes, addr: str) -> bool:
+        """Pull oid from the peer store daemon at addr into the local
+        store (daemon-to-daemon stream).  True when the object is local
+        (pulled now or already present) and sealed."""
+        status, _ = self._transfer_op(_OP_PULL, oid, addr)
+        return status == ST_OK
+
+    def push_remote(self, oid: bytes, addr: str) -> bool:
+        """Push a locally-sealed oid to the peer store daemon at addr.
+        True when the peer holds the object afterwards (streamed now, or
+        it already had a copy)."""
+        status, _ = self._transfer_op(_OP_PUSH, oid, addr)
+        return status == ST_OK
 
     def get_bytes(self, oid: bytes, timeout_ms: int = 0):
         """Like get() but always ONE round trip: small objects come back

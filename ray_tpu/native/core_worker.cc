@@ -967,6 +967,59 @@ static PyObject* Server_raylet_snapshot(ServerObject* self, PyObject*) {
   return d;
 }
 
+static PyObject* Server_raylet_debug(ServerObject* self, PyObject*) {
+  // Introspection for tests/diagnosis: (idle ids, bound ids,
+  // {conn: [task ids]} inflight).  Not a hot path.
+  RayletCore* r = raylet_of(self);
+  if (!r) return nullptr;
+  std::vector<uint64_t> idle, bound;
+  std::vector<std::pair<uint64_t, std::vector<std::string>>> inflight;
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    idle.assign(r->idle.begin(), r->idle.end());
+    bound.assign(r->bound.begin(), r->bound.end());
+    for (auto& [cid, tasks] : r->inflight) {
+      std::vector<std::string> tids;
+      for (auto& [tid, _] : tasks) tids.push_back(tid);
+      if (!tids.empty()) inflight.emplace_back(cid, std::move(tids));
+    }
+  }
+  PyObject* d = PyDict_New();
+  PyObject* li = PyList_New(0);
+  for (auto v : idle) {
+    PyObject* o = PyLong_FromUnsignedLongLong(v);
+    PyList_Append(li, o);
+    Py_DECREF(o);
+  }
+  PyDict_SetItemString(d, "idle", li);
+  Py_DECREF(li);
+  PyObject* lb = PyList_New(0);
+  for (auto v : bound) {
+    PyObject* o = PyLong_FromUnsignedLongLong(v);
+    PyList_Append(lb, o);
+    Py_DECREF(o);
+  }
+  PyDict_SetItemString(d, "bound", lb);
+  Py_DECREF(lb);
+  PyObject* linf = PyDict_New();
+  for (auto& [cid, tids] : inflight) {
+    PyObject* key = PyLong_FromUnsignedLongLong(cid);
+    PyObject* tl = PyList_New(0);
+    for (auto& t : tids) {
+      PyObject* b = PyBytes_FromStringAndSize(t.data(),
+                                              Py_ssize_t(t.size()));
+      PyList_Append(tl, b);
+      Py_DECREF(b);
+    }
+    PyDict_SetItem(linf, key, tl);
+    Py_DECREF(key);
+    Py_DECREF(tl);
+  }
+  PyDict_SetItemString(d, "inflight", linf);
+  Py_DECREF(linf);
+  return d;
+}
+
 static PyObject* Server_raylet_bind_worker(ServerObject* self,
                                            PyObject* args) {
   unsigned long long conn_id;
@@ -1263,17 +1316,27 @@ static PyObject* Server_raylet_drain_infeasible(ServerObject* self,
 }
 
 static PyObject* Server_raylet_steal_pending(ServerObject* self,
-                                             PyObject*) {
-  // Drain the whole native queue back to Python (assign frames).  Used
-  // when the cluster stops being single-node: tasks accepted into the
-  // fast lane during the transition window move to the policy path so
-  // spillback/load-aware placement applies to them.
+                                             PyObject* args) {
+  // Move queued tasks back to Python (assign frames).  With no argument
+  // the whole queue drains (lane shutdown / drain).  With max_n, up to
+  // max_n tasks are stolen from the BACK of the queue — the newest
+  // submissions, which are the ones a saturated node's balancer spills
+  // to peers while the oldest keep their local dispatch position.
+  long long max_n = -1;
+  if (!PyArg_ParseTuple(args, "|L", &max_n)) return nullptr;
   RayletCore* r = raylet_of(self);
   if (!r) return nullptr;
   std::deque<RayletCore::Pending> out;
   {
     std::lock_guard<std::mutex> g(r->mu);
-    out.swap(r->pending);
+    if (max_n < 0 || size_t(max_n) >= r->pending.size()) {
+      out.swap(r->pending);
+    } else {
+      for (long long i = 0; i < max_n; ++i) {
+        out.push_front(std::move(r->pending.back()));
+        r->pending.pop_back();
+      }
+    }
   }
   PyObject* list = PyList_New(Py_ssize_t(out.size()));
   if (!list) return nullptr;
@@ -1509,6 +1572,8 @@ static PyMethodDef Server_methods[] = {
      "raylet_snapshot() -> {name: available}"},
     {"raylet_bind_worker", (PyCFunction)Server_raylet_bind_worker,
      METH_VARARGS, "raylet_bind_worker(conn_id): register + mark idle"},
+    {"raylet_debug", (PyCFunction)Server_raylet_debug, METH_NOARGS,
+     "raylet_debug() -> {idle, bound, inflight} introspection"},
     {"raylet_acquire_worker", (PyCFunction)Server_raylet_acquire_worker,
      METH_NOARGS, "raylet_acquire_worker() -> conn_id | None"},
     {"raylet_release_worker", (PyCFunction)Server_raylet_release_worker,
@@ -1536,8 +1601,9 @@ static PyMethodDef Server_methods[] = {
     {"raylet_cancel", (PyCFunction)Server_raylet_cancel, METH_VARARGS,
      "raylet_cancel(task_id) -> (state, conn_id, frame|None)"},
     {"raylet_steal_pending", (PyCFunction)Server_raylet_steal_pending,
-     METH_NOARGS,
-     "raylet_steal_pending() -> [assign frames] (queue moves to Python)"},
+     METH_VARARGS,
+     "raylet_steal_pending([max_n]) -> [assign frames]; no arg drains "
+     "all, max_n steals the newest from the queue back"},
     {"raylet_drain_sealed", (PyCFunction)Server_raylet_drain_sealed,
      METH_NOARGS, "raylet_drain_sealed() -> [oid, ...]"},
     {"raylet_drain_events", (PyCFunction)Server_raylet_drain_events,

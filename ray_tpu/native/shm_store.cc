@@ -52,7 +52,12 @@
 #include <vector>
 #include <array>
 
+#include <arpa/inet.h>
+#include <cerrno>
 #include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
 #include <sys/mman.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
@@ -64,7 +69,9 @@ namespace {
 
 constexpr uint8_t OP_CREATE = 1, OP_SEAL = 2, OP_GET = 3, OP_RELEASE = 4,
                   OP_DELETE = 5, OP_CONTAINS = 6, OP_STATS = 7, OP_ABORT = 8,
-                  OP_PUT = 9, OP_GET_INLINE = 10;
+                  OP_PUT = 9, OP_GET_INLINE = 10, OP_PULL = 11, OP_PUSH = 12;
+// Daemon-to-daemon transfer ops (TCP peer listener)
+constexpr uint8_t XFER_PULL = 1, XFER_PUSH = 2;
 constexpr uint8_t ST_OK = 0, ST_NOT_FOUND = 1, ST_EXISTS = 2, ST_OOM = 3,
                   ST_TIMEOUT = 4, ST_NOT_SEALED = 5, ST_ERR = 6,
                   ST_EVICTED = 7, ST_VIEW = 8;
@@ -519,6 +526,208 @@ bool DrainBytes(int fd, uint64_t n) {
   return true;
 }
 
+// ---------------------------------------------------------------------
+// Daemon-to-daemon object transfer (TCP peer plane).
+//
+// TPU-native redesign of the reference object manager
+// (/root/reference/src/ray/object_manager/object_manager.h:53,132 —
+// chunked gRPC Push/Pull through an ObjectBufferPool): here the two
+// store daemons stream the extent DIRECTLY between their shm segments
+// over one TCP connection — sender reads from its mapping, receiver
+// writes into a freshly created extent — so there is no chunk buffer
+// pool because there are no intermediate buffers at all, and no Python
+// byte ever touches the data plane.  Policy (location lookup, retry,
+// ban, dedup) stays host-side; see _private/object_transfer.py.
+//
+// Peer wire protocol (connector speaks first):
+//   auth:    u8 token_len | token bytes
+//   request: u8 xfer_op | u8[20] object_id
+//   XFER_PULL: response u8 status | u64 size | payload bytes
+//   XFER_PUSH: request continues u64 size; response u8 status; on OK the
+//              connector streams the payload, then reads u8 final status.
+// ---------------------------------------------------------------------
+
+std::string g_xfer_token;  // RTPU_STORE_TOKEN (empty = no auth)
+constexpr int kXferTimeoutSec = 30;
+
+void SetSockTimeouts(int fd) {
+  timeval tv{kXferTimeoutSec, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+}
+
+// One peer request per connection (transfers are large; setup cost is
+// noise, and per-connection framing keeps failure recovery trivial).
+void ServeTransferPeer(Store* store, uint8_t* base, int fd) {
+  SetSockTimeouts(fd);
+  uint8_t tl = 0;
+  if (!ReadFull(fd, &tl, 1)) { close(fd); return; }
+  std::string token(tl, '\0');
+  if (tl && !ReadFull(fd, token.data(), tl)) { close(fd); return; }
+  if (token != g_xfer_token) { close(fd); return; }
+  uint8_t hdr[1 + kIdLen];
+  if (!ReadFull(fd, hdr, sizeof hdr)) { close(fd); return; }
+  ObjectId id;
+  memcpy(id.data(), hdr + 1, kIdLen);
+  if (hdr[0] == XFER_PULL) {
+    uint64_t off = 0, size = 0;
+    uint8_t status = store->Get(id, 0, &off, &size);  // non-blocking probe
+    uint8_t resp[1 + 8];
+    resp[0] = status;
+    memcpy(resp + 1, &size, 8);
+    if (status != ST_OK) {
+      WriteFull(fd, resp, sizeof resp);
+      close(fd);
+      return;
+    }
+    // pin held across the stream: the extent cannot be evicted under us
+    bool ok = WriteFull(fd, resp, sizeof resp) &&
+              WriteFull(fd, base + off, size);
+    (void)ok;
+    store->Release(id);
+  } else if (hdr[0] == XFER_PUSH) {
+    uint64_t size = 0;
+    if (!ReadFull(fd, &size, 8)) { close(fd); return; }
+    uint64_t off = 0;
+    uint8_t status = store->Create(id, size, &off);
+    if (status == ST_EXISTS) {
+      // only report "already have it" when the copy is SEALED; an
+      // unsealed husk from a dying concurrent transfer is ST_ERR so
+      // the sender does not count the push as delivered
+      uint64_t sealed = 0, sz = 0;
+      if (!(store->Contains(id, &sealed, &sz) == ST_OK && sealed))
+        status = ST_ERR;
+    }
+    uint8_t st_byte = status;
+    if (!WriteFull(fd, &st_byte, 1) || status != ST_OK) {
+      close(fd);  // EXISTS/OOM: decline — the sender stops, no stream
+      return;
+    }
+    if (!ReadFull(fd, base + off, size)) {
+      store->Abort(id);  // half-written push: never leave a husk
+      close(fd);
+      return;
+    }
+    store->Seal(id);
+    st_byte = ST_OK;
+    WriteFull(fd, &st_byte, 1);
+  }
+  close(fd);
+}
+
+int DialPeer(const std::string& host, uint16_t port) {
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  std::string port_s = std::to_string(port);
+  if (getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) != 0)
+    return -1;
+  int fd = -1;
+  for (addrinfo* ai = res; ai; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    SetSockTimeouts(fd);
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  return fd;
+}
+
+bool SendAuthAndHeader(int fd, uint8_t op, const ObjectId& id) {
+  std::string pre;
+  pre.push_back(char(uint8_t(g_xfer_token.size())));
+  pre += g_xfer_token;
+  pre.push_back(char(op));
+  pre.append(reinterpret_cast<const char*>(id.data()), kIdLen);
+  return WriteFull(fd, pre.data(), pre.size());
+}
+
+// Local client asked us to PULL id from a peer daemon straight into our
+// segment.  Returns (status, size).
+std::pair<uint8_t, uint64_t> PullFromPeer(Store* store, uint8_t* base,
+                                          const ObjectId& id,
+                                          const std::string& host,
+                                          uint16_t port) {
+  {
+    uint64_t sealed = 0, size = 0;
+    if (store->Contains(id, &sealed, &size) == ST_OK && sealed)
+      return {ST_OK, size};  // raced: already local
+  }
+  int fd = DialPeer(host, port);
+  if (fd < 0) return {ST_ERR, 0};
+  if (!SendAuthAndHeader(fd, XFER_PULL, id)) { close(fd); return {ST_ERR, 0}; }
+  uint8_t resp[1 + 8];
+  if (!ReadFull(fd, resp, sizeof resp)) { close(fd); return {ST_ERR, 0}; }
+  uint64_t size = 0;
+  memcpy(&size, resp + 1, 8);
+  if (resp[0] != ST_OK) { close(fd); return {resp[0], 0}; }
+  uint64_t off = 0;
+  uint8_t status = store->Create(id, size, &off);
+  if (status == ST_EXISTS) {
+    close(fd);  // concurrent pull/compute won; drop the stream —
+    // but only claim success if that copy is actually SEALED (a
+    // half-written concurrent transfer that later aborts must not
+    // let us advertise a location we do not hold)
+    uint64_t sealed = 0, sz = 0;
+    if (store->Contains(id, &sealed, &sz) == ST_OK && sealed)
+      return {ST_OK, sz};
+    return {ST_NOT_SEALED, 0};
+  }
+  if (status != ST_OK) { close(fd); return {status, 0}; }
+  if (!ReadFull(fd, base + off, size)) {
+    store->Abort(id);
+    close(fd);
+    return {ST_ERR, 0};
+  }
+  close(fd);
+  store->Seal(id);
+  return {ST_OK, size};
+}
+
+// Local client asked us to PUSH a sealed local object to a peer daemon.
+uint8_t PushToPeer(Store* store, uint8_t* base, const ObjectId& id,
+                   const std::string& host, uint16_t port) {
+  uint64_t off = 0, size = 0;
+  uint8_t status = store->Get(id, 0, &off, &size);
+  if (status != ST_OK) return status;  // evicted since scheduling the push
+  int fd = DialPeer(host, port);
+  if (fd < 0) { store->Release(id); return ST_ERR; }
+  uint8_t final_st = ST_ERR;
+  if (SendAuthAndHeader(fd, XFER_PUSH, id) &&
+      WriteFull(fd, &size, 8)) {
+    uint8_t st = ST_ERR;
+    if (ReadFull(fd, &st, 1)) {
+      if (st == ST_OK) {
+        if (WriteFull(fd, base + off, size) && ReadFull(fd, &st, 1))
+          final_st = st;
+      } else if (st == ST_EXISTS) {
+        final_st = ST_OK;  // receiver already has it: push satisfied
+      }
+    }
+  }
+  close(fd);
+  store->Release(id);
+  return final_st;
+}
+
+void TransferListener(Store* store, uint8_t* base, int srv_fd) {
+  for (;;) {
+    int fd = accept(srv_fd, nullptr, nullptr);
+    if (fd < 0) {
+      // persistent failure (EMFILE under transfer fan-in): back off
+      // instead of busy-spinning the core the daemon shares with its
+      // own client threads
+      if (errno != EINTR) usleep(10'000);
+      continue;
+    }
+    std::thread(ServeTransferPeer, store, base, fd).detach();
+  }
+}
+
 // Per-client (not per-connection) ref bookkeeping: a client process may pool
 // several sockets, so a GET on one connection can be RELEASEd on another.
 // Pins are reclaimed when the client's last connection closes.
@@ -616,6 +825,34 @@ void ServeClient(Store* store, uint8_t* base, int fd) {
         r1 = arg0;
         break;
       }
+      case OP_PULL:
+      case OP_PUSH: {
+        // arg0 = addr payload length; payload is "host:port".  The
+        // transfer runs in THIS connection's thread — the client checked
+        // the conn out of its pool, so control traffic on other conns is
+        // never head-of-line-blocked by a large transfer.
+        std::string addr(arg0, '\0');
+        if (!ReadFull(fd, addr.data(), arg0)) {
+          conn_broken = true;
+          break;
+        }
+        size_t colon = addr.rfind(':');
+        if (colon == std::string::npos) {
+          status = ST_ERR;
+          break;
+        }
+        std::string host = addr.substr(0, colon);
+        uint16_t port = uint16_t(strtoul(addr.c_str() + colon + 1,
+                                         nullptr, 10));
+        if (op == OP_PULL) {
+          auto [st, sz] = PullFromPeer(store, base, id, host, port);
+          status = st;
+          r1 = sz;
+        } else {
+          status = PushToPeer(store, base, id, host, port);
+        }
+        break;
+      }
       case OP_GET_INLINE: {
         // arg0 = timeout_ms, arg1 = client's inline size cap
         status = store->Get(id, arg0, &r0, &r1);
@@ -676,10 +913,10 @@ void ServeClient(Store* store, uint8_t* base, int fd) {
 }  // namespace
 
 int main(int argc, char** argv) {
-  if (argc != 4 && argc != 5) {
+  if (argc < 4 || argc > 6) {
     fprintf(stderr,
             "usage: %s <socket_path> <shm_name> <capacity_bytes> "
-            "[spill_dir]\n",
+            "[spill_dir] [xfer_host]\n",
             argv[0]);
     return 2;
   }
@@ -687,7 +924,12 @@ int main(int argc, char** argv) {
   const char* sock_path = argv[1];
   const char* shm_name = argv[2];
   uint64_t capacity = strtoull(argv[3], nullptr, 10);
-  std::string spill_dir = argc == 5 ? argv[4] : "";
+  std::string spill_dir = argc >= 5 ? argv[4] : "";
+  // Optional TCP transfer listener (daemon-to-daemon data plane): bind
+  // an ephemeral port on xfer_host; the chosen port rides the READY
+  // line.  Auth token comes via env, never argv (ps-visible).
+  std::string xfer_host = argc == 6 ? argv[5] : "";
+  if (const char* tok = getenv("RTPU_STORE_TOKEN")) g_xfer_token = tok;
 
   // Create + size the shared memory segment.
   shm_unlink(shm_name);
@@ -725,13 +967,41 @@ int main(int argc, char** argv) {
     return 1;
   }
   listen(srv, 128);
-  // Signal readiness on stdout for the parent bootstrap.
-  printf("READY\n");
+
+  int xfer_port = 0;
+  if (!xfer_host.empty()) {
+    int tsrv = socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(tsrv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    sockaddr_in tin{};
+    tin.sin_family = AF_INET;
+    tin.sin_port = 0;  // ephemeral
+    if (inet_pton(AF_INET, xfer_host.c_str(), &tin.sin_addr) != 1)
+      tin.sin_addr.s_addr = htonl(INADDR_ANY);
+    if (bind(tsrv, reinterpret_cast<sockaddr*>(&tin), sizeof tin) == 0 &&
+        listen(tsrv, 64) == 0) {
+      sockaddr_in got{};
+      socklen_t glen = sizeof got;
+      getsockname(tsrv, reinterpret_cast<sockaddr*>(&got), &glen);
+      xfer_port = ntohs(got.sin_port);
+      std::thread(TransferListener, &store, static_cast<uint8_t*>(base),
+                  tsrv)
+          .detach();
+    } else {
+      close(tsrv);
+    }
+  }
+
+  // Signal readiness (+ transfer port) on stdout for the parent bootstrap.
+  printf("READY %d\n", xfer_port);
   fflush(stdout);
 
   for (;;) {
     int fd = accept(srv, nullptr, nullptr);
-    if (fd < 0) continue;
+    if (fd < 0) {
+      if (errno != EINTR) usleep(10'000);  // EMFILE: no busy-spin
+      continue;
+    }
     std::thread(ServeClient, &store, static_cast<uint8_t*>(base), fd)
         .detach();
   }

@@ -421,3 +421,69 @@ def test_node_label_scheduling(cluster):
                             labels={"zone": "nowhere"})
     cluster.wait_for_nodes()
     assert ray_tpu.get(r, timeout=120) == late.node_id.hex()
+
+
+def test_native_dispatch_on_three_node_cluster(cluster):
+    """The C++ fast lane stays ON in a multi-node cluster: every node
+    dispatches plain tasks natively (raylet_stats counters prove it), and
+    the Python balancer only bridges excess backlog to peers (round-5
+    redesign; previously any live peer turned the lane off)."""
+    node_b = _add_worker(cluster)
+    node_c = _add_worker(cluster)
+    all_nodes = [cluster.head_node, node_b, node_c]
+    for n in all_nodes:
+        assert n.scheduler._raylet_native and n.scheduler._lane_accept
+
+    before = {id(n): n.scheduler._node_srv.raylet_stats()["dispatched"]
+              for n in all_nodes}
+
+    @ray_tpu.remote
+    def where():
+        import time as _t
+
+        _t.sleep(0.3)  # hold the slot so the backlog must spread
+        import ray_tpu as rt
+
+        return rt.get_runtime_context().node_id_hex()
+
+    # 18 concurrent 1-CPU tasks on a 2+2+2 CPU cluster
+    homes = ray_tpu.get([where.remote() for _ in range(18)], timeout=180)
+    assert {n.node_id.hex() for n in all_nodes} <= set(homes)
+    for n in all_nodes:
+        after = n.scheduler._node_srv.raylet_stats()["dispatched"]
+        assert after > before[id(n)], \
+            f"node {n.node_id.hex()[:8]} never dispatched natively"
+
+
+def test_native_transfer_plane_pull_and_push(cluster):
+    """Cross-node object movement rides the store daemons' TCP data
+    plane (shm_store.cc XFER_PULL/XFER_PUSH): a pull between nodes moves
+    the extent daemon-to-daemon, and a proactive push lands in the peer
+    store without any Python chunk traffic."""
+    import numpy as np
+
+    wn = _add_worker(cluster)
+    head = cluster.head_node
+    # both daemons advertise a transfer listener
+    for n in (head, wn):
+        info = head.gcs.get_node(n.node_id)
+        assert info.xfer_addr, "transfer listener missing"
+
+    # seal an object on the head, pull it from the worker node's store
+    # via the native plane directly
+    data = np.arange(500_000, dtype=np.int64)
+    ref = ray_tpu.put(data)
+    oid = ref.binary()
+    assert head.scheduler._store.contains(oid)
+    head_info = head.gcs.get_node(head.node_id)
+    assert wn.scheduler._store.pull_remote(oid, head_info.xfer_addr)
+    assert wn.scheduler._store.contains(oid)
+
+    # push: head streams a second object into the worker daemon
+    ref2 = ray_tpu.put(np.ones(300_000, np.float32))
+    oid2 = ref2.binary()
+    wn_info = head.gcs.get_node(wn.node_id)
+    assert head.scheduler._store.push_remote(oid2, wn_info.xfer_addr)
+    assert wn.scheduler._store.contains(oid2)
+    # pushing again is satisfied by the existing copy (dedup at receiver)
+    assert head.scheduler._store.push_remote(oid2, wn_info.xfer_addr)

@@ -1,0 +1,33 @@
+"""Scale-bench smoke: the 1/50-scale envelope the full benchmark runs
+(reference: release/benchmarks/README.md — distributed_test at 2,000
+nodes / 40k actors / 1k PGs; here the one-host scaled envelope of
+`python -m ray_tpu._private.scale_bench`).
+
+Runs in-process (same entry points the bench uses) so a control-plane
+regression that would stall the full envelope fails CI in minutes.
+"""
+
+import json
+import subprocess
+import sys
+
+
+def test_scale_bench_quick_completes():
+    """--quick finishes, emits every scenario line, and the envelope
+    numbers are sane (all tasks done, all actors alive, all PGs
+    placed)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu._private.scale_bench", "--quick"],
+        capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    records = {}
+    for line in proc.stdout.splitlines():
+        if line.startswith("{"):
+            records.update(json.loads(line))
+    assert records["tasks"]["completed"] == records["tasks"]["n_tasks"]
+    assert records["tasks"]["dispatch_per_s"] > 100
+    assert records["actors"]["alive"] == records["actors"]["n_actors"]
+    assert records["pgs_nodes"]["pgs_created"] == \
+        records["pgs_nodes"]["n_pgs"]
+    assert records["pgs_nodes"]["n_nodes"] >= 3
