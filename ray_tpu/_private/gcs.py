@@ -122,6 +122,12 @@ class Gcs:
         self.lost_objects: set[bytes] = set()
         # pg_id -> {bundles, strategy, assignment: [node_id per bundle]}
         self.placement_groups: dict[bytes, dict] = {}
+        # first-class job / worker / task-event tables (reference:
+        # gcs_service.proto JobInfo:68 / WorkerInfo:363 / TaskInfo:860)
+        self.jobs: dict[str, dict] = {}
+        self.workers: dict[bytes, dict] = {}
+        self.task_events: "deque[dict]" = deque()
+        self._task_event_cap = 1 << 16
         self._persist_path = persist_path
         self._persist_timer: Optional[threading.Timer] = None
         if persist_path and os.path.exists(persist_path):
@@ -149,6 +155,9 @@ class Gcs:
                 "kv": dict(self.kv),
                 "placement_groups": {
                     k: dict(v) for k, v in self.placement_groups.items()},
+                "jobs": {k: dict(v) for k, v in self.jobs.items()},
+                "workers": {k: dict(v) for k, v in self.workers.items()},
+                "task_events": list(self.task_events),
             }
         tmp = self._persist_path + ".tmp"
         try:
@@ -187,6 +196,15 @@ class Gcs:
         self.named_actors = state.get("named_actors", {})
         self.kv = state.get("kv", {})
         self.placement_groups = state.get("placement_groups", {})
+        self.jobs = state.get("jobs", {})
+        self.workers = state.get("workers", {})
+        self.task_events = deque(state.get("task_events", []))
+        # restored workers belonged to the previous incarnation's
+        # processes — they are gone
+        for w in self.workers.values():
+            if w.get("state") != "DEAD":
+                w["state"] = "DEAD"
+                w["exit_detail"] = "GCS restarted; worker process lost"
         # Every restored actor lived on a node that predates this head
         # incarnation: mark restartable ones RESTARTING so the scheduler
         # recreates them, DEAD otherwise (reference:
@@ -436,6 +454,87 @@ class Gcs:
                     for pg_id, info in self.placement_groups.items()}
 
     # -- internal KV (function/class registry, cluster metadata) -----------
+    # -- job / worker / task-event tables ---------------------------------
+    def add_job(self, job_id: str, info: dict):
+        with self._lock:
+            self.jobs[job_id] = dict(info)
+            self._publish("jobs", {"ch": "jobs", "job_id": job_id})
+        self._mutated()
+
+    def update_job(self, job_id: str, fields: dict) -> bool:
+        with self._lock:
+            job = self.jobs.get(job_id)
+            if job is None:
+                return False
+            job.update(fields)
+            self._publish("jobs", {"ch": "jobs", "job_id": job_id})
+        self._mutated()
+        return True
+
+    def get_job(self, job_id: str) -> Optional[dict]:
+        with self._lock:
+            job = self.jobs.get(job_id)
+            return dict(job) if job else None
+
+    def list_jobs(self) -> list:
+        with self._lock:
+            return [dict(j) for j in self.jobs.values()]
+
+    _MAX_DEAD_WORKERS = 4096
+
+    def add_worker(self, worker_id: bytes, info: dict):
+        with self._lock:
+            self.workers[worker_id] = dict(info)
+            # bound the table: DEAD records are history, not state —
+            # evict the oldest ones past the cap (ALIVE rows always kept)
+            if len(self.workers) > 2 * self._MAX_DEAD_WORKERS:
+                dead = [(w.get("end_ts", 0.0), wid)
+                        for wid, w in self.workers.items()
+                        if w.get("state") == "DEAD"]
+                dead.sort()
+                for _, wid in dead[:len(dead) - self._MAX_DEAD_WORKERS]:
+                    del self.workers[wid]
+        self._mutated()
+
+    def update_worker(self, worker_id: bytes, fields: dict) -> bool:
+        with self._lock:
+            w = self.workers.get(worker_id)
+            if w is None:
+                return False
+            w.update(fields)
+        self._mutated()
+        return True
+
+    def list_workers(self) -> list:
+        with self._lock:
+            return [dict(w) for w in self.workers.values()]
+
+    _TEV_PERSIST_EVERY_S = 5.0
+
+    def add_task_events(self, events: list) -> int:
+        with self._lock:
+            self.task_events.extend(events)
+            while len(self.task_events) > self._task_event_cap:
+                self.task_events.popleft()
+            n = len(self.task_events)
+            # telemetry, not state: heartbeat-rate flushes from every
+            # node must not re-serialize the (up to 64k-entry) ring into
+            # the snapshot several times a second — persist on a slow
+            # cadence; any real state mutation still snapshots it
+            now = time.time()
+            due = now - getattr(self, "_tev_last_persist",
+                                0.0) > self._TEV_PERSIST_EVERY_S
+            if due:
+                self._tev_last_persist = now
+        if due:
+            self._mutated()
+        return n
+
+    def list_task_events(self, limit: int = 1000) -> list:
+        with self._lock:
+            evs = list(self.task_events)
+        return evs[-limit:]
+
     def kv_put(self, namespace: str, key: bytes, value: bytes):
         with self._lock:
             self.kv[(namespace, key)] = value
@@ -472,6 +571,9 @@ _GCS_METHODS = frozenset({
     "get_object_locations", "all_object_locations",
     "object_lost", "clear_object_lost",
     "register_pg", "get_pg", "remove_pg", "list_pgs",
+    "add_job", "update_job", "get_job", "list_jobs",
+    "add_worker", "update_worker", "list_workers",
+    "add_task_events", "list_task_events",
     "kv_put", "kv_get", "kv_del", "kv_keys",
     "check_node_health", "sub_poll", "broadcast_command",
 })

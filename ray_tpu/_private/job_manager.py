@@ -13,7 +13,7 @@ any client can poll it.
 from __future__ import annotations
 
 import os
-import pickle
+
 import signal
 import subprocess
 import threading
@@ -24,7 +24,7 @@ from typing import Optional
 
 from ray_tpu._private import runtime_env as runtime_env_mod
 
-_KV_NS = "job"
+
 
 
 class JobStatus:
@@ -78,14 +78,30 @@ class JobManager:
         self._procs: dict[str, subprocess.Popen] = {}
         self._lock = threading.Lock()
 
-    # -- KV-backed job table ----------------------------------------------
+    # -- GCS job table (first-class, not KV: the daemon owns the record
+    # and it survives a head restart — reference:
+    # gcs_service.proto JobInfoGcsService:68) -----------------------------
     def _save(self, info: JobInfo):
-        self._gcs.kv_put(_KV_NS, info.submission_id.encode(),
-                         pickle.dumps(info.to_dict()))
+        # add_job is insert-or-replace and _save always writes the full
+        # record, so one unconditional call — no probe round trip
+        self._gcs.add_job(info.submission_id, info.to_dict())
 
     def _load(self, submission_id: str) -> Optional[dict]:
-        raw = self._gcs.kv_get(_KV_NS, submission_id.encode())
-        return None if raw is None else pickle.loads(raw)
+        return self._gcs.get_job(submission_id)
+
+    def reconcile(self):
+        """Head (re)start: restored jobs whose supervisor died with the
+        previous head process can never finish — record the truth."""
+        for row in self._gcs.list_jobs():
+            if row.get("status") in (JobStatus.PENDING, JobStatus.RUNNING):
+                sid = row.get("submission_id")
+                with self._lock:
+                    if sid in self._procs:
+                        continue  # this incarnation supervises it
+                self._gcs.update_job(sid, {
+                    "status": JobStatus.FAILED,
+                    "message": "head restarted; job supervisor lost",
+                    "end_time": time.time()})
 
     # -- RPC surface -------------------------------------------------------
     def submit(self, entrypoint: str, runtime_env: Optional[dict] = None,
@@ -107,12 +123,8 @@ class JobManager:
         return self._load(submission_id)
 
     def list_jobs(self) -> list[dict]:
-        rows = []
-        for key in self._gcs.kv_keys(_KV_NS):
-            raw = self._gcs.kv_get(_KV_NS, key)
-            if raw is not None:
-                rows.append(pickle.loads(raw))
-        return sorted(rows, key=lambda r: r.get("start_time") or 0)
+        return sorted(self._gcs.list_jobs(),
+                      key=lambda r: r.get("start_time") or 0)
 
     def logs(self, submission_id: str) -> str:
         info = self._load(submission_id)

@@ -220,6 +220,9 @@ class Node:
 
             self.scheduler.job_manager = JobManager(
                 self.gcs, self.gcs_address, self.session_dir)
+            # restored PENDING/RUNNING jobs lost their supervisor with
+            # the previous head process: record the truth
+            self.scheduler.job_manager.reconcile()
             # Persisted-GCS recovery: re-create actors restored as
             # RESTARTING (no-op on a fresh control plane).
             self.scheduler.recover_restored_actors()

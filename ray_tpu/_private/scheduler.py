@@ -293,6 +293,9 @@ class Scheduler:
         # forwarded specs executing on this node's native lane, keyed by
         # task id: the origin is notified when the ring reports terminal
         self._native_spilled: dict[bytes, TaskSpec] = {}
+        # staged terminal task events for the batched GCS flush
+        self._tev_outbox: list[dict] = []
+        self._tev_dropped = 0
         self._conn_workers: dict[int, WorkerState] = {}
         self._last_grow_check = 0.0
         core = direct_mod.native_core()
@@ -321,6 +324,15 @@ class Scheduler:
             self._accept_thread = threading.Thread(
                 target=self._accept_loop, name="sched-accept", daemon=True
             )
+        # Eager cluster view: submit() consults _cluster_nodes (native-
+        # lane feasibility) before the first heartbeat tick — a joining
+        # driver node must see its peers immediately or a locally-
+        # infeasible task would be failed instead of forwarded.
+        try:
+            self._cluster_nodes = {n.node_id: n
+                                   for n in self.gcs.list_nodes()}
+        except Exception:
+            pass
         self._sched_thread = threading.Thread(
             target=self._schedule_loop, name="sched-loop", daemon=True
         )
@@ -481,6 +493,43 @@ class Scheduler:
             self._record_task_event(spec, "PENDING")
             self._wake.notify_all()
 
+    def _queue_gcs_task_event(self, ev: dict):
+        """Stage a terminal task event for the batched GCS flush
+        (reference: core_worker task_event_buffer.h — events ride ONE
+        periodic RPC, never the task hot path).  The outbox is bounded:
+        a 50k-task storm records drops instead of growing without limit."""
+        outbox = self._tev_outbox
+        if len(outbox) >= 4096:
+            self._tev_dropped += 1
+            return
+        outbox.append({
+            "task_id": ev["task_id"], "name": ev["name"] or "",
+            "kind": str(ev["kind"]), "state": ev["state"],
+            "node_id": self.node_id,
+            "submitted_ts": float(ev["submitted_ts"] or 0.0),
+            "start_ts": float(ev["start_ts"] or 0.0),
+            "end_ts": float(ev["end_ts"] or 0.0),
+            "ok": bool(ev["ok"]) if ev["ok"] is not None else None,
+        })
+
+    def _flush_gcs_task_events(self):
+        """Heartbeat-rate batch push of staged terminal events."""
+        if not self._tev_outbox:
+            return
+        batch, self._tev_outbox = self._tev_outbox, []
+        if self._tev_dropped:
+            batch.append({
+                "task_id": b"", "name": "<dropped>", "kind": "marker",
+                "state": "DROPPED", "node_id": self.node_id,
+                "submitted_ts": 0.0, "start_ts": 0.0,
+                "end_ts": time.time(), "ok": None,
+                "dropped": self._tev_dropped})
+            self._tev_dropped = 0
+        try:
+            self.gcs.add_task_events(batch)
+        except Exception:
+            pass  # best-effort: local tables still hold the events
+
     def _record_task_event(self, spec: TaskSpec, state: str,
                            worker_id: Optional[bytes] = None,
                            ok: Optional[bool] = None):
@@ -532,6 +581,7 @@ class Scheduler:
                     exporter.export_task_event(dict(ev))
                 except Exception:
                     pass
+            self._queue_gcs_task_event(ev)
 
     def list_task_events(self) -> list[dict]:
         with self._lock:
@@ -593,6 +643,7 @@ class Scheduler:
                         exporter.export_task_event(dict(ev))
                     except Exception:
                         pass
+                self._queue_gcs_task_event(ev)
 
     def cancel(self, task_id: bytes, force: bool = False) -> bool:
         """Cancel a pending task; with force, kill the running worker too."""
@@ -1316,6 +1367,17 @@ class Scheduler:
                     self._conn_workers[cid] = worker
                     self._node_srv.raylet_bind_worker(cid)
                 self._wake.notify_all()
+            # GCS worker table (reference: WorkerInfoGcsService,
+            # gcs_service.proto:363): lifecycle is cluster-visible and
+            # survives this scheduler process
+            try:
+                self.gcs.add_worker(worker_id, {
+                    "worker_id": worker_id, "node_id": self.node_id,
+                    "pid": (worker.proc.pid
+                            if worker.proc is not None else 0),
+                    "state": "ALIVE", "start_ts": time.time()})
+            except Exception:
+                pass
         elif t == "done":
             self._on_task_done(ctx.worker, msg)
         elif t == "submit":
@@ -1817,6 +1879,7 @@ class Scheduler:
                     with self._lock:
                         # keep the event table/export pipeline current
                         self._merge_native_events_locked()
+                self._flush_gcs_task_events()
                 now = time.monotonic()
                 if now - getattr(self, "_last_pg_reconcile", 0.0) > 5.0:
                     self._last_pg_reconcile = now
@@ -2158,6 +2221,14 @@ class Scheduler:
                                f"worker died executing {spec.name}"))
                     self._fail_task(spec, err)
             self._wake.notify_all()
+        # GCS worker-table update OUTSIDE the lock: a blocking RPC (head
+        # mid-restart reconnects for up to ~10s) must not stall dispatch
+        try:
+            self.gcs.update_worker(worker.worker_id, {
+                "state": "DEAD", "end_ts": time.time(),
+                "exit_detail": "worker process exited"})
+        except Exception:
+            pass
 
     def _cleanup_actor_kv(self, actor_id: bytes):
         """An actor is PERMANENTLY dead: drop its creation spec and, when

@@ -37,6 +37,7 @@
 #include <time.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <deque>
 #include <map>
 #include <set>
@@ -90,6 +91,19 @@ struct Gcs {
   std::map<std::string, std::set<std::string>> obj_locs;
   std::set<std::string> lost_objects;
   std::map<std::string, Value> pgs;  // pg_id -> DICT
+  // First-class job / worker / task-event tables (reference:
+  // gcs_service.proto JobInfoGcsService:68, WorkerInfoGcsService:363,
+  // TaskInfoGcsService:860) — head-side Python holds NO copy, so jobs
+  // and task events survive a head restart with the snapshot.
+  std::map<std::string, Value> jobs;     // submission_id -> DICT
+  std::map<std::string, Value> workers;  // worker_id -> DICT
+  std::deque<Value> task_events;         // bounded ring of DICTs
+  static constexpr size_t kTaskEventCap = 1 << 16;
+  static constexpr size_t kMaxDeadWorkers = 4096;
+  // task events are telemetry: persist them on a slow cadence, never at
+  // the heartbeat-flush rate (the ring alone can be multi-MB)
+  double tev_last_persist_mono = 0;
+  static constexpr double kTevPersistEveryS = 5.0;
   double death_timeout_s = 5.0;
 
   // pubsub event log
@@ -139,6 +153,17 @@ struct Gcs {
     for (auto& [id, pg] : pgs)
       vp.pairs->emplace_back(Value::Bytes(id), pg);
     state.set("placement_groups", vp);
+    Value vj = Value::Dict();
+    for (auto& [id, job] : jobs)
+      vj.pairs->emplace_back(Value::Str(id), job);
+    state.set("jobs", vj);
+    Value vw = Value::Dict();
+    for (auto& [id, w] : workers)
+      vw.pairs->emplace_back(Value::Bytes(id), w);
+    state.set("workers", vw);
+    Value vt = Value::List();
+    for (auto& ev : task_events) vt.push(ev);
+    state.set("task_events", vt);
 
     std::string data = wire::encode(state);
     std::string tmp = persist_path + ".tmp";
@@ -181,6 +206,26 @@ struct Gcs {
     if (const Value* vp = state.get("placement_groups"); vp && vp->pairs)
       for (auto& [k, v] : *vp->pairs)
         if (k.kind == Value::BYTES) pgs[k.s] = v;
+    if (const Value* vj = state.get("jobs"); vj && vj->pairs)
+      for (auto& [k, v] : *vj->pairs)
+        if (k.kind == Value::STR) jobs[k.s] = v;
+    if (const Value* vw = state.get("workers"); vw && vw->pairs)
+      for (auto& [k, v] : *vw->pairs)
+        if (k.kind == Value::BYTES) workers[k.s] = v;
+    if (const Value* vt = state.get("task_events"); vt && vt->items)
+      for (auto& ev : *vt->items) task_events.push_back(ev);
+
+    // Restored workers belonged to the previous incarnation's processes:
+    // they are gone (the reference's WorkerTable reports them DEAD on
+    // GCS failover the same way).
+    for (auto& [id, w] : workers) {
+      const Value* st = w.get("state");
+      if (!st || st->kind != Value::STR || st->s != kStateDead) {
+        w.set("state", Value::Str(kStateDead));
+        w.set("exit_detail",
+              Value::Str("GCS restarted; worker process lost"));
+      }
+    }
 
     // Restored actors lived on nodes that predate this incarnation: mark
     // restartable ones RESTARTING so the head scheduler recreates them,
@@ -524,6 +569,119 @@ static std::string dispatch(Gcs& g, const wire::Request& req,
       r = Value::Dict();
       for (auto& [id, pg] : g.pgs)
         r.pairs->emplace_back(Value::Bytes(id), pg);
+    } else if (m == "add_job") {
+      // (job_id, info DICT) — full record insert; publishes on "jobs"
+      std::string jid = arg_bytes(req, 0, "job_id");
+      const Value* info = arg(req, 1, "info");
+      if (!info || (info->kind != Value::DICT &&
+                    info->kind != Value::STRUCT))
+        throw wire::WireError("add_job needs an info dict");
+      g.jobs[jid] = *info;
+      Value ev = Value::Dict();
+      ev.set("ch", Value::Str("jobs"));
+      ev.set("job_id", Value::Str(jid));
+      g.publish("jobs", std::move(ev));
+      g.mutated();
+    } else if (m == "update_job") {
+      // (job_id, fields DICT) — merge; missing job returns False
+      std::string jid = arg_bytes(req, 0, "job_id");
+      auto it = g.jobs.find(jid);
+      if (it == g.jobs.end()) {
+        r = Value::Bool(false);
+      } else {
+        const Value* fields = arg(req, 1, "fields");
+        if (fields && fields->pairs) {
+          Value copy = it->second;
+          copy.pairs = std::make_shared<wire::ValuePairs>(
+              *it->second.pairs);
+          for (auto& [k, v] : *fields->pairs)
+            if (k.kind == Value::STR) copy.set(k.s.c_str(), v);
+          it->second = std::move(copy);
+        }
+        Value ev = Value::Dict();
+        ev.set("ch", Value::Str("jobs"));
+        ev.set("job_id", Value::Str(jid));
+        g.publish("jobs", std::move(ev));
+        g.mutated();
+        r = Value::Bool(true);
+      }
+    } else if (m == "get_job") {
+      auto it = g.jobs.find(arg_bytes(req, 0, "job_id"));
+      if (it != g.jobs.end()) r = it->second;
+    } else if (m == "list_jobs") {
+      r = Value::List();
+      for (auto& [_, job] : g.jobs) r.push(job);
+    } else if (m == "add_worker") {
+      std::string wid = arg_bytes(req, 0, "worker_id");
+      const Value* info = arg(req, 1, "info");
+      if (!info || (info->kind != Value::DICT &&
+                    info->kind != Value::STRUCT))
+        throw wire::WireError("add_worker needs an info dict");
+      g.workers[wid] = *info;
+      // bound the table: evict the oldest DEAD records past the cap
+      if (g.workers.size() > 2 * Gcs::kMaxDeadWorkers) {
+        std::vector<std::pair<double, std::string>> dead;
+        for (auto& [id, w] : g.workers) {
+          const Value* st = w.get("state");
+          if (st && st->kind == Value::STR && st->s == kStateDead) {
+            const Value* ts = w.get("end_ts");
+            dead.emplace_back(ts ? ts->as_f() : 0.0, id);
+          }
+        }
+        std::sort(dead.begin(), dead.end());
+        size_t drop = dead.size() > Gcs::kMaxDeadWorkers
+                          ? dead.size() - Gcs::kMaxDeadWorkers
+                          : 0;
+        for (size_t i = 0; i < drop; ++i) g.workers.erase(dead[i].second);
+      }
+      g.mutated();
+    } else if (m == "update_worker") {
+      std::string wid = arg_bytes(req, 0, "worker_id");
+      auto it = g.workers.find(wid);
+      if (it == g.workers.end()) {
+        r = Value::Bool(false);
+      } else {
+        const Value* fields = arg(req, 1, "fields");
+        if (fields && fields->pairs) {
+          Value copy = it->second;
+          copy.pairs = std::make_shared<wire::ValuePairs>(
+              *it->second.pairs);
+          for (auto& [k, v] : *fields->pairs)
+            if (k.kind == Value::STR) copy.set(k.s.c_str(), v);
+          it->second = std::move(copy);
+        }
+        g.mutated();
+        r = Value::Bool(true);
+      }
+    } else if (m == "list_workers") {
+      r = Value::List();
+      for (auto& [_, w] : g.workers) r.push(w);
+    } else if (m == "add_task_events") {
+      // (events LIST of DICT): batch append into the bounded ring —
+      // one RPC per flusher wakeup, mirroring the reference's
+      // task_event_buffer batching
+      const Value* evs = arg(req, 0, "events");
+      if (evs && evs->items) {
+        for (auto& ev : *evs->items) g.task_events.push_back(ev);
+        while (g.task_events.size() > Gcs::kTaskEventCap)
+          g.task_events.pop_front();
+        double now = mono_s();
+        if (now - g.tev_last_persist_mono > Gcs::kTevPersistEveryS) {
+          g.tev_last_persist_mono = now;
+          g.mutated();
+        }
+      }
+      r = Value::Int(int64_t(g.task_events.size()));
+    } else if (m == "list_task_events") {
+      // (limit) — newest-last window of the ring
+      const Value* lim = arg(req, 0, "limit");
+      size_t limit = lim ? size_t(lim->as_i()) : size_t(1000);
+      r = Value::List();
+      size_t start = g.task_events.size() > limit
+                         ? g.task_events.size() - limit
+                         : 0;
+      for (size_t i = start; i < g.task_events.size(); ++i)
+        r.push(g.task_events[i]);
     } else if (m == "broadcast_command") {
       // syncer COMMANDS channel (reference: ray_syncer.h:83): publish the
       // payload cluster-wide; schedulers subscribed to "commands" act
