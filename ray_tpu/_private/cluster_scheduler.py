@@ -15,7 +15,8 @@ import threading
 from typing import Callable, Optional
 
 from ray_tpu._private import protocol
-from ray_tpu._private.task_spec import MAX_SPILLS, TaskSpec
+from ray_tpu._private import flags as flags_mod
+from ray_tpu._private.task_spec import TaskSpec
 
 
 class PeerLinks:
@@ -100,7 +101,7 @@ def pick_spill_target(
     remote by available capacity).  Debits the cached view of the chosen
     node so the next task in the same pass picks a different node instead
     of dogpiling this one; the target's own heartbeat re-syncs truth."""
-    if spec.pg_id is not None or spec.spill_count >= MAX_SPILLS:
+    if spec.pg_id is not None or spec.spill_count >= flags_mod.get("RTPU_MAX_SPILLS"):
         return None  # PG bundles are reserved on this node
     if spec.node_affinity == node_id and not spec.affinity_soft:
         return None

@@ -193,6 +193,9 @@ def encode_direct_reply(request_first_byte: int, reply: dict) -> bytes:
 
 _MEMSTORE_MAX_ENTRIES = int(os.environ.get("RTPU_MEMSTORE_ENTRIES", 65536))
 _MEMSTORE_MAX_BYTES = int(os.environ.get("RTPU_MEMSTORE_BYTES", 256 << 20))
+# exactly-once resend dedup: completed inline payloads pinned per actor
+_DONE_BYTES_CAP = int(
+    os.environ.get("RTPU_DIRECT_DONE_BYTES_CAP", 32 << 20))
 
 
 class _Entry:
@@ -716,7 +719,7 @@ class DirectServer:
         # task_id -> reply dict (completed) | threading.Event (running)
         self._done: OrderedDict[bytes, dict] = OrderedDict()
         self._done_bytes = 0
-        self._done_bytes_cap = 32 << 20  # inline payloads pinned for dedup
+        self._done_bytes_cap = _DONE_BYTES_CAP
         self._running: dict[bytes, threading.Event] = {}
         self._state_lock = threading.Lock()
         self._thread = threading.Thread(
@@ -898,7 +901,7 @@ class NativeDirectServer(DirectServer):
                                 token.encode("utf-8"))
         self._done: OrderedDict[bytes, dict] = OrderedDict()
         self._done_bytes = 0
-        self._done_bytes_cap = 32 << 20
+        self._done_bytes_cap = _DONE_BYTES_CAP
         self._running: dict[bytes, threading.Event] = {}
         self._state_lock = threading.Lock()
         self._thread = threading.Thread(

@@ -127,7 +127,8 @@ class Gcs:
         self.jobs: dict[str, dict] = {}
         self.workers: dict[bytes, dict] = {}
         self.task_events: "deque[dict]" = deque()
-        self._task_event_cap = 1 << 16
+        self._task_event_cap = int(
+            os.environ.get("RTPU_GCS_TASK_EVENT_CAP", 1 << 16))
         self._persist_path = persist_path
         self._persist_timer: Optional[threading.Timer] = None
         if persist_path and os.path.exists(persist_path):
@@ -480,7 +481,8 @@ class Gcs:
         with self._lock:
             return [dict(j) for j in self.jobs.values()]
 
-    _MAX_DEAD_WORKERS = 4096
+    _MAX_DEAD_WORKERS = int(
+        os.environ.get("RTPU_GCS_MAX_DEAD_WORKERS", 4096))
 
     def add_worker(self, worker_id: bytes, info: dict):
         with self._lock:
@@ -509,7 +511,8 @@ class Gcs:
         with self._lock:
             return [dict(w) for w in self.workers.values()]
 
-    _TEV_PERSIST_EVERY_S = 5.0
+    _TEV_PERSIST_EVERY_S = float(
+        os.environ.get("RTPU_GCS_TEV_PERSIST_S", 5.0))
 
     def add_task_events(self, events: list) -> int:
         with self._lock:

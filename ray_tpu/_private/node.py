@@ -21,7 +21,7 @@ from ray_tpu._private.gcs import Gcs, GcsClient, GcsServer, NodeInfo
 from ray_tpu._private.scheduler import Scheduler
 from ray_tpu.core.store_client import StoreClient, StoreServer
 
-DEFAULT_STORE_CAPACITY = 1 << 31  # 2 GiB host staging tier
+DEFAULT_STORE_CAPACITY = 1 << 31  # default; see RTPU_STORE_CAPACITY
 
 
 def _cluster_token_or_empty() -> str:
@@ -353,6 +353,9 @@ def _default_store_capacity() -> int:
         import shutil
 
         free = shutil.disk_usage("/dev/shm").free
-        return min(DEFAULT_STORE_CAPACITY, max(1 << 28, int(free * 0.5)))
+        from ray_tpu._private import flags as flags_mod
+
+        cap = flags_mod.get("RTPU_STORE_CAPACITY")
+        return min(cap, max(1 << 28, int(free * 0.5)))
     except OSError:
         return 1 << 28

@@ -18,9 +18,9 @@ from collections import deque
 from typing import Callable, Optional
 
 from ray_tpu._private import protocol
-from ray_tpu._private.task_spec import FETCH_CHUNK
+from ray_tpu._private import flags as flags_mod
 
-_BAN_S = 5.0  # reference: pull retry ban window
+
 
 
 class _Partial:
@@ -60,9 +60,17 @@ class ObjectTransfer:
         self._banned: dict[tuple[bytes, bytes], float] = {}
         self._native_xfer = os.environ.get("RTPU_NATIVE_TRANSFER",
                                            "1") != "0"
+        # Flag reads at CONSTRUCTION time (not import): ObjectTransfer is
+        # built after the node adopts cluster-published flags, so head-set
+        # values reach every node (registry contract, flags.py).
+        self._ban_s = flags_mod.get("RTPU_PULL_BAN_S")
+        self._fetch_chunk = flags_mod.get("RTPU_FETCH_CHUNK")
+        self._flush_window_s = flags_mod.get("RTPU_SEAL_FLUSH_WINDOW_S")
+        self._partial_ttl_s = flags_mod.get("RTPU_PARTIAL_TTL_S")
         # push side (reference: push_manager.cc)
         self._pushes: set[tuple[bytes, bytes]] = set()
-        self._push_sem = threading.Semaphore(self._PUSH_CONCURRENCY)
+        self._push_sem = threading.Semaphore(
+            flags_mod.get("RTPU_PUSH_CONCURRENCY"))
         self._partials: dict = {}  # oid -> _Partial (direct-to-shm assembly)
         # Seal notifications batch: every sealed object needs its location
         # in the GCS directory, but one synchronous control-plane RPC per
@@ -96,7 +104,7 @@ class ObjectTransfer:
         except Exception:
             pass
 
-    _FLUSH_WINDOW_S = 0.01
+
 
     def _seal_flush_loop(self):
         last_sweep = time.monotonic()
@@ -107,11 +115,11 @@ class ObjectTransfer:
             # never be evicted — if the pusher died and no further push
             # ever arrives, only a timer reclaims that extent.
             now = time.monotonic()
-            if now - last_sweep >= self._PARTIAL_TTL_S / 4:
+            if now - last_sweep >= self._partial_ttl_s / 4:
                 last_sweep = now
                 with self._pull_lock:
                     for k in [k for k, v in self._partials.items()
-                              if now - v.ts > self._PARTIAL_TTL_S]:
+                              if now - v.ts > self._partial_ttl_s]:
                         self._drop_partial_locked(k)
             if not fired:
                 continue
@@ -120,7 +128,7 @@ class ObjectTransfer:
             # one RPC per seal on another thread — worse than the sync
             # path on a single-core host (GIL + CPU thrash).  A few ms of
             # accumulation turns thousands of seals into hundreds of RPCs.
-            time.sleep(self._FLUSH_WINDOW_S)
+            time.sleep(self._flush_window_s)
             self._seal_event.clear()
             batch = []
             try:
@@ -196,7 +204,7 @@ class ObjectTransfer:
                         self.note_sealed(oid)
                         return
                     # both planes failed: ban this location briefly
-                    self._banned[(nid, oid)] = time.monotonic() + _BAN_S
+                    self._banned[(nid, oid)] = time.monotonic() + self._ban_s
                     if len(self._banned) > 4096:
                         cutoff = time.monotonic()
                         self._banned = {k: v for k, v
@@ -220,7 +228,7 @@ class ObjectTransfer:
             while size is None or len(data) < size:
                 conn.send({"t": "rpc", "method": "fetch_object",
                            "params": {"oid": oid, "offset": len(data),
-                                      "chunk": FETCH_CHUNK}})
+                                      "chunk": self._fetch_chunk}})
                 resp = conn.recv()
                 if (resp is None or not resp.get("ok")
                         or not resp["result"]["found"]):
@@ -243,7 +251,8 @@ class ObjectTransfer:
             conn.close()
 
     def serve_fetch(self, oid: bytes, offset: int,
-                    chunk: int = FETCH_CHUNK) -> dict:
+                    chunk: int = 0) -> dict:
+        chunk = chunk or self._fetch_chunk
         view = self._store.get(oid, 0)
         if view is None:
             return {"found": False}
@@ -260,8 +269,8 @@ class ObjectTransfer:
     # concurrency; object_manager.h HandlePush on the receiver)
     # ------------------------------------------------------------------
 
-    _PUSH_CONCURRENCY = 2
-    _PARTIAL_TTL_S = 60.0
+
+
 
     def push(self, oid: bytes, node) -> bool:
         """Proactively send a locally-sealed object to a peer node.
@@ -314,7 +323,7 @@ class ObjectTransfer:
                         size = len(view)
                         off = 0
                         while True:
-                            chunk = bytes(view[off:off + FETCH_CHUNK])
+                            chunk = bytes(view[off:off + self._fetch_chunk])
                             conn.send({"t": "rpc", "method": "push_chunk",
                                        "params": {"oid": oid, "offset": off,
                                                   "size": size,

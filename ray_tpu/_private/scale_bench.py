@@ -32,7 +32,7 @@ import os
 import time
 
 
-PROGRESS_STALL_S = 30.0
+PROGRESS_STALL_S = float(os.environ.get("RTPU_SCALE_STALL_S", 30.0))
 _last_progress = [0.0]
 
 

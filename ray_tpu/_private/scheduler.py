@@ -31,6 +31,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ray_tpu._private import cluster_scheduler as cluster_mod
+from ray_tpu._private import flags
 from ray_tpu.util import scheduling_strategies as strategies_mod
 from ray_tpu._private import gcs as gcs_mod
 from ray_tpu._private.object_transfer import ObjectTransfer
@@ -201,8 +202,7 @@ class Scheduler:
         # task_id -> {name, kind, state, submitted/start/end timestamps,
         # worker}.  Bounded: oldest finished events are evicted.
         self._task_events: dict[bytes, dict] = {}
-        self._task_events_cap = int(
-            os.environ.get("RTPU_TASK_EVENTS_CAP", 20000))
+        self._task_events_cap = flags.get("RTPU_TASK_EVENTS_CAP")
         # Event-driven pull retries (armed by trigger_pull; drained by the
         # "objects" pubsub watcher thread, started on first use).
         self._wanted_oids: set[bytes] = set()
@@ -296,6 +296,8 @@ class Scheduler:
         # staged terminal task events for the batched GCS flush
         self._tev_outbox: list[dict] = []
         self._tev_dropped = 0
+        self._tev_outbox_cap = flags.get("RTPU_TEV_OUTBOX_CAP")
+        self._hb_interval = flags.get("RTPU_HEARTBEAT_INTERVAL_S")
         self._conn_workers: dict[int, WorkerState] = {}
         self._last_grow_check = 0.0
         core = direct_mod.native_core()
@@ -499,7 +501,7 @@ class Scheduler:
         periodic RPC, never the task hot path).  The outbox is bounded:
         a 50k-task storm records drops instead of growing without limit."""
         outbox = self._tev_outbox
-        if len(outbox) >= 4096:
+        if len(outbox) >= self._tev_outbox_cap:
             self._tev_dropped += 1
             return
         outbox.append({
@@ -900,7 +902,7 @@ class Scheduler:
         deducted from the cached view so back-to-back creations don't
         funnel into the same stale-looking node."""
         exclude: set[bytes] = set()
-        for _attempt in range(8):
+        for _attempt in range(flags.get("RTPU_PG_CREATE_RETRIES")):
             assignment = self._assign_bundles(bundles, strategy, exclude)
             if assignment is None:
                 return False
@@ -1887,7 +1889,9 @@ class Scheduler:
             except Exception:
                 if not self._shutdown:
                     traceback.print_exc()
-            time.sleep(0.25 if len(self._known_alive) > 1 else 0.5)
+            time.sleep(self._hb_interval
+                       if len(self._known_alive) > 1
+                       else 2 * self._hb_interval)
 
     def _forward(self, spec: TaskSpec, node_id: bytes) -> bool:
         """Hand a pending spec to another node (caller holds the lock).

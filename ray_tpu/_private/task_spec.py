@@ -10,6 +10,8 @@ bookkeeping (spill counts, affinity, origin node for spillback recovery).
 
 from __future__ import annotations
 
+import os
+
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -19,10 +21,11 @@ ACTOR_METHOD = "actor_method"
 
 # Cross-node object transfer chunk (reference: object_manager.h:53
 # object_chunk_size, ~1-5MB); bounds per-message memory during pulls.
-FETCH_CHUNK = 4 << 20
+FETCH_CHUNK = int(os.environ.get("RTPU_FETCH_CHUNK", 4 << 20))
 # A task may spill between nodes at most this many times before it settles
 # where it is (prevents forwarding ping-pong under racing load reports).
-MAX_SPILLS = 4
+MAX_SPILLS = 4  # default; spill decisions read the
+# RTPU_MAX_SPILLS flag at use time (cluster-adoption safe)
 
 
 @dataclass

@@ -26,13 +26,21 @@ from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.store_client import ObjectEvictedError, StoreClient
 from ray_tpu.exceptions import GetTimeoutError, ObjectLostError
 
-_GET_CHUNK_MS = 500  # blocking-get slice so Ctrl-C stays responsive
+_GET_CHUNK_MS = int(os.environ.get("RTPU_GET_CHUNK_MS", 500))  # blocking-get slice so Ctrl-C stays responsive
 _EAGER_DELETE_MIN = int(os.environ.get("RTPU_EAGER_DELETE_MIN", 64 * 1024))
 # Puts at or below this serialize into a scratch buffer and ride the
 # store's one-round-trip OP_PUT instead of create/write/seal (see
 # store_client.py put); the extra copy is trivial next to the saved
 # daemon round trip.
 _INLINE_PUT_MAX = int(os.environ.get("RTPU_INLINE_PUT_MAX", 64 * 1024))
+# how often a blocked get re-requests the cross-node pull
+_PULL_RETRY_S = float(os.environ.get("RTPU_PULL_RETRY_S", 2.0))
+# grace before a blocking wait notifies the scheduler (sub-ms
+# replies skip the notification round trip entirely)
+_BLOCK_GRACE_S = float(os.environ.get("RTPU_BLOCK_GRACE_S", 0.005))
+# owner-side lineage cap: oldest specs evicted past this
+_LINEAGE_MAX_BYTES = int(
+    os.environ.get("RTPU_LINEAGE_MAX_BYTES", 64 << 20))
 
 
 class WorkerContext:
@@ -320,7 +328,7 @@ class WorkerContext:
                     self._lineage_order.append(oid)
                     self._lineage_bytes += cost
                 self._lineage[oid] = spec
-            while (self._lineage_bytes > 64 << 20
+            while (self._lineage_bytes > _LINEAGE_MAX_BYTES
                    or len(self._lineage_order) > 100_000):
                 old = self._lineage_order.pop(0)
                 dropped = self._lineage.pop(old, None)
@@ -518,7 +526,7 @@ class WorkerContext:
             self._direct.flush_all()  # coalesced submits go out before we block
             # Short grace before declaring this worker blocked: sub-ms
             # replies (the common case) skip the scheduler notification.
-            if not self.memstore.wait_done(entry, 0.005):
+            if not self.memstore.wait_done(entry, _BLOCK_GRACE_S):
                 blocked = self._block_notify is not None
                 if blocked:
                     self._block_notify(True)
@@ -566,7 +574,7 @@ class WorkerContext:
                     # scheduler to pull it.  The pull exits immediately if
                     # the object isn't sealed anywhere yet, so re-request
                     # periodically for as long as we keep waiting.
-                    next_pull = time.monotonic() + 2.0
+                    next_pull = time.monotonic() + _PULL_RETRY_S
                     self.request_pull(oid)
                     # every copy may have died with its node: surface LOST
                     # instead of waiting forever (the owner's get loop
@@ -614,7 +622,7 @@ class WorkerContext:
             while True:
                 if time.monotonic() >= next_pull:
                     if fetch_local:
-                        next_pull = time.monotonic() + 2.0
+                        next_pull = time.monotonic() + _PULL_RETRY_S
                         for ref in pending:
                             if not self._has_local(ref.binary()):
                                 self.request_pull(ref.binary())

@@ -46,6 +46,8 @@ _OP_PUT, _OP_GET_INLINE, _OP_PULL, _OP_PUSH = 9, 10, 11, 12
 # host, but views keep large reads zero-copy for jax.device_put.
 # Env-tunable alongside RTPU_INLINE_PUT_MAX so put/get stay symmetric.
 INLINE_GET_MAX = int(os.environ.get("RTPU_INLINE_GET_MAX", 64 * 1024))
+# per-client daemon connection pool cap
+_POOL_MAX = int(os.environ.get("RTPU_STORE_POOL_MAX", 8))
 
 
 def _native_core():
@@ -184,7 +186,7 @@ class StoreClient:
 
     def _checkin(self, entry):
         with self._pool_lock:
-            if len(self._pool) < 8:
+            if len(self._pool) < _POOL_MAX:
                 self._pool.append(entry)
                 return
         entry[0].close()

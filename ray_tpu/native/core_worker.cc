@@ -460,7 +460,15 @@ struct RayletCore {
     double ts;
   };
   std::deque<Event> events;
-  static constexpr size_t kMaxEvents = 50000;
+  // flag-registry tunable (RTPU_RAYLET_EVENT_CAP, _private/flags.py)
+  size_t max_events = [] {
+    const char* v = getenv("RTPU_RAYLET_EVENT_CAP");
+    if (!v || !*v) return size_t(50000);
+    char* end = nullptr;
+    long long n = strtoll(v, &end, 10);
+    // garbage/non-positive falls back (registry _coerce contract)
+    return (end && *end == '\0' && n > 0) ? size_t(n) : size_t(50000);
+  }();
 
   void push_event_locked(const std::string& tid, const std::string& name,
                          uint8_t state) {
@@ -468,7 +476,7 @@ struct RayletCore {
     clock_gettime(CLOCK_REALTIME, &t);
     events.push_back({tid, name, state, double(t.tv_sec) +
                                             double(t.tv_nsec) * 1e-9});
-    while (events.size() > kMaxEvents) events.pop_front();
+    while (events.size() > max_events) events.pop_front();
   }
   uint64_t n_dispatched = 0, n_done = 0, n_submitted = 0;
   bool enabled = false;

@@ -98,34 +98,53 @@ struct Gcs {
   std::map<std::string, Value> jobs;     // submission_id -> DICT
   std::map<std::string, Value> workers;  // worker_id -> DICT
   std::deque<Value> task_events;         // bounded ring of DICTs
-  static constexpr size_t kTaskEventCap = 1 << 16;
-  static constexpr size_t kMaxDeadWorkers = 4096;
+  size_t task_event_cap = env_size("RTPU_GCS_TASK_EVENT_CAP", 1 << 16);
+  size_t max_dead_workers = env_size("RTPU_GCS_MAX_DEAD_WORKERS", 4096);
   // task events are telemetry: persist them on a slow cadence, never at
   // the heartbeat-flush rate (the ring alone can be multi-MB)
   double tev_last_persist_mono = 0;
-  static constexpr double kTevPersistEveryS = 5.0;
+  double tev_persist_every_s = env_f("RTPU_GCS_TEV_PERSIST_S", 5.0);
   double death_timeout_s = 5.0;
 
+  // Env-tunable caps/intervals (flag registry: _private/flags.py; the
+  // daemon inherits the head's env, which carries cluster-level flags)
+  // Garbage or non-positive values fall back to the default, matching
+  // the Python registry's _coerce contract — a typo must never unbound
+  // a ring or zero a timeout.
+  static size_t env_size(const char* name, size_t dflt) {
+    const char* v = getenv(name);
+    if (!v || !*v) return dflt;
+    char* end = nullptr;
+    long long n = strtoll(v, &end, 10);
+    return (end && *end == '\0' && n > 0) ? size_t(n) : dflt;
+  }
+  static double env_f(const char* name, double dflt) {
+    const char* v = getenv(name);
+    if (!v || !*v) return dflt;
+    char* end = nullptr;
+    double x = strtod(v, &end);
+    return (end && *end == '\0' && x > 0) ? x : dflt;
+  }
   // pubsub event log
   std::deque<Event> events;
   uint64_t next_seq = 1;
-  static constexpr size_t kRingCap = 16384;
+  size_t ring_cap = env_size("RTPU_GCS_RING_CAP", 16384);
 
   // persistence
   std::string persist_path;
   bool dirty = false;
   double snapshot_due_mono = 0;  // 0 = none pending
-  static constexpr double kDebounceS = 0.2;
+  double debounce_s = env_f("RTPU_GCS_SNAPSHOT_DEBOUNCE_S", 0.2);
 
   void publish(const std::string& channel, Value payload) {
     events.push_back(Event{next_seq++, channel, std::move(payload)});
-    while (events.size() > kRingCap) events.pop_front();
+    while (events.size() > ring_cap) events.pop_front();
   }
 
   void mutated() {
     if (persist_path.empty()) return;
     dirty = true;
-    if (snapshot_due_mono == 0) snapshot_due_mono = mono_s() + kDebounceS;
+    if (snapshot_due_mono == 0) snapshot_due_mono = mono_s() + debounce_s;
   }
 
   void snapshot() {
@@ -619,7 +638,7 @@ static std::string dispatch(Gcs& g, const wire::Request& req,
         throw wire::WireError("add_worker needs an info dict");
       g.workers[wid] = *info;
       // bound the table: evict the oldest DEAD records past the cap
-      if (g.workers.size() > 2 * Gcs::kMaxDeadWorkers) {
+      if (g.workers.size() > 2 * g.max_dead_workers) {
         std::vector<std::pair<double, std::string>> dead;
         for (auto& [id, w] : g.workers) {
           const Value* st = w.get("state");
@@ -629,8 +648,8 @@ static std::string dispatch(Gcs& g, const wire::Request& req,
           }
         }
         std::sort(dead.begin(), dead.end());
-        size_t drop = dead.size() > Gcs::kMaxDeadWorkers
-                          ? dead.size() - Gcs::kMaxDeadWorkers
+        size_t drop = dead.size() > g.max_dead_workers
+                          ? dead.size() - g.max_dead_workers
                           : 0;
         for (size_t i = 0; i < drop; ++i) g.workers.erase(dead[i].second);
       }
@@ -663,10 +682,10 @@ static std::string dispatch(Gcs& g, const wire::Request& req,
       const Value* evs = arg(req, 0, "events");
       if (evs && evs->items) {
         for (auto& ev : *evs->items) g.task_events.push_back(ev);
-        while (g.task_events.size() > Gcs::kTaskEventCap)
+        while (g.task_events.size() > g.task_event_cap)
           g.task_events.pop_front();
         double now = mono_s();
-        if (now - g.tev_last_persist_mono > Gcs::kTevPersistEveryS) {
+        if (now - g.tev_last_persist_mono > g.tev_persist_every_s) {
           g.tev_last_persist_mono = now;
           g.mutated();
         }

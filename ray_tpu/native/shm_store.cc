@@ -548,10 +548,19 @@ bool DrainBytes(int fd, uint64_t n) {
 // ---------------------------------------------------------------------
 
 std::string g_xfer_token;  // RTPU_STORE_TOKEN (empty = no auth)
-constexpr int kXferTimeoutSec = 30;
+// flag-registry tunable (RTPU_XFER_TIMEOUT_S, _private/flags.py)
+int g_xfer_timeout_s = [] {
+  const char* v = getenv("RTPU_XFER_TIMEOUT_S");
+  if (!v || !*v) return 30;
+  char* end = nullptr;
+  long n = strtol(v, &end, 10);
+  // garbage/non-positive would mean timeval{0,0} = NO timeout — the
+  // opposite of intent; fall back to the default instead
+  return (end && *end == '\0' && n > 0) ? int(n) : 30;
+}();
 
 void SetSockTimeouts(int fd) {
-  timeval tv{kXferTimeoutSec, 0};
+  timeval tv{g_xfer_timeout_s, 0};
   setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
   setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
   int one = 1;
