@@ -6,19 +6,32 @@ Counterpart of the reference's GPU objects / Ray Direct Transport
 called with ``.options(tensor_transport="device")`` keeps its return value
 in the producing actor's process — for ``jax.Array``s that means the
 buffers never leave HBM — and seals only a small marker into the object
-store. A consumer that ``get``s the ref triggers a pull: a hidden
-``__rtpu_apply__`` task on the producer serializes the value through the
-shm store (host-staging tier), and the consumer's ``jax.device_put`` moves
-it onto its own device. On multi-chip deployments the intended fast path is
-in-program ICI (both actors enter one jitted program via the mesh layer);
-this host relay is the general-topology fallback, exactly the role NIXL
-plays in the reference.
+store.
+
+Two transfer planes, picked per get:
+
+- **ICI (in-program)** — when producer and consumer are members of the
+  same runtime's mesh (single-controller SPMD: one process drives every
+  chip of its slice; threaded mesh actors share it), the get IS a jitted
+  reshard: ``jax.device_put(value, NamedSharding(mesh, target))``.  XLA
+  emits the chip-to-chip collectives over ICI and ZERO bytes touch the
+  shm store — see ``resolve_marker``/``get_device_object`` and
+  ``parallel/mesh.py`` ``active_mesh_context``.
+- **Host relay (fallback)** — across runtimes (actors on different
+  hosts/slices), a hidden ``__rtpu_apply__`` task on the producer
+  serializes the value through the shm store and the consumer's
+  ``jax.device_put`` moves it onto its own devices — the role NIXL plays
+  in the reference.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict
+from typing import Any, Dict, Optional
+
+# host-relay pulls performed by this process (tests assert the ICI path
+# leaves it untouched)
+RELAY_PULLS = 0
 
 # Producer-side residency table, per worker process: oid -> value.
 _resident: Dict[bytes, Any] = {}
@@ -69,17 +82,83 @@ def free_resident_for_actor() -> None:
         _resident.clear()
 
 
-def resolve_marker(marker: DeviceObjectMarker, timeout=None):
-    """Consumer side: pull the value from the producing actor."""
+_MISSING = object()  # a resident value may legitimately BE None
+
+
+def _ici_reshard(value, sharding):
+    """One jitted program moving device buffers to ``sharding`` — XLA
+    lowers the reshard to ICI collectives; no host copy, no store."""
+    import jax
+
+    return jax.device_put(value, sharding)
+
+
+def _resolve_sharding(sharding):
+    """Accept a NamedSharding, or a bare PartitionSpec resolved against
+    the ACTIVE mesh context (parallel/mesh.py) — how mesh members name a
+    placement without re-plumbing the mesh object."""
+    if sharding is None:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if isinstance(sharding, PartitionSpec):
+        from ray_tpu.parallel import mesh as mesh_mod
+
+        ctx = mesh_mod.active_mesh_context()
+        if ctx is None:
+            raise RuntimeError(
+                "a bare PartitionSpec needs an active mesh context "
+                "(parallel.mesh.set_active_mesh_context)")
+        return NamedSharding(ctx.mesh, sharding)
+    return sharding
+
+
+def resolve_marker(marker: DeviceObjectMarker, timeout=None,
+                   sharding=None):
+    """Consumer side: resolve a device object.
+
+    Same-runtime (the value is resident here — the consumer shares the
+    producer's process, i.e. they are members of one mesh): return the
+    device value directly, resharded in-program when ``sharding`` is
+    given.  Cross-runtime: host relay via the producer actor."""
     from ray_tpu import api
     from ray_tpu.core.actor import ActorHandle
 
+    sharding = _resolve_sharding(sharding)
     with _lock:
-        if marker.oid in _resident:  # consumer IS the producer: no RPC
-            return _resident[marker.oid]
+        value = _resident.get(marker.oid, _MISSING)
+    if value is not _MISSING:  # same runtime: ICI plane, no store bytes
+        return _ici_reshard(value, sharding) if sharding is not None \
+            else value
     handle = ActorHandle(marker.actor_id, "DeviceObjectOwner")
     ref = handle.__rtpu_apply__.remote(_fetch, marker.oid)
-    return api.get(ref, timeout=timeout)
+    value = api.get(ref, timeout=timeout)
+    global RELAY_PULLS
+    with _lock:
+        RELAY_PULLS += 1  # successful host-relay pulls only
+    if sharding is not None:
+        value = _ici_reshard(value, sharding)
+    return value
+
+
+def get_device_object(ref, sharding=None, timeout: Optional[float] = None):
+    """Get a device object, placing the result under ``sharding``.
+
+    ``sharding`` may be a ``NamedSharding`` or a bare ``PartitionSpec``
+    (resolved against the active mesh context).  Mesh members exchange
+    the array in one jitted program (ICI); cross-runtime consumers fall
+    back to the host relay, then ``jax.device_put`` onto their devices.
+    """
+    from ray_tpu._private import worker as worker_mod
+
+    ctx = worker_mod.global_worker()
+    value = ctx.get_object_raw(ref, timeout=timeout)
+    sharding = _resolve_sharding(sharding)
+    if isinstance(value, DeviceObjectMarker):
+        return resolve_marker(value, timeout=timeout, sharding=sharding)
+    if sharding is not None:
+        return _ici_reshard(value, sharding)
+    return value
 
 
 def free_device_object(ref) -> bool:

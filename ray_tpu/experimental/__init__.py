@@ -6,7 +6,9 @@ RDT surface).
 
 from ray_tpu._private.device_objects import (
     DeviceObjectMarker,
+    get_device_object,
     free_device_object,
 )
 
-__all__ = ["DeviceObjectMarker", "free_device_object"]
+__all__ = ["DeviceObjectMarker", "free_device_object",
+           "get_device_object"]

@@ -150,3 +150,20 @@ class MeshContext:
     @property
     def num_devices(self) -> int:
         return self.mesh.size
+
+
+# Process-global active mesh context.  Mesh members (the Train worker
+# group, threaded mesh actors) install it so device-object exchange can
+# take the in-program ICI path: a get between members of one runtime is
+# a jitted reshard (jax.device_put with the target NamedSharding — XLA
+# emits the ICI collectives), never a host relay through the shm store.
+_ACTIVE_CTX: Optional[MeshContext] = None
+
+
+def set_active_mesh_context(ctx: Optional[MeshContext]) -> None:
+    global _ACTIVE_CTX
+    _ACTIVE_CTX = ctx
+
+
+def active_mesh_context() -> Optional[MeshContext]:
+    return _ACTIVE_CTX

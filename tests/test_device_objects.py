@@ -90,3 +90,87 @@ def test_object_store_transport_unchanged(cluster):
     np.testing.assert_allclose(np.asarray(ray_tpu.get(ref)),
                                [0.0, 1.0, 2.0])
     ray_tpu.kill(p)
+
+
+def test_mesh_member_exchange_rides_ici_not_store(cluster):
+    """Mesh members exchange a sharded jax.Array in ONE jitted program
+    (the get IS a reshard — jax.device_put with the target NamedSharding,
+    lowered by XLA to ICI collectives): zero bytes cross the shm store
+    and the host-relay counter stays untouched.  The host relay remains
+    the cross-runtime fallback (previous tests)."""
+    import ray_tpu
+
+    @ray_tpu.remote(max_concurrency=2)
+    class MeshMember:
+        """One single-controller runtime driving every mesh device; the
+        producer and consumer roles are members of its mesh."""
+
+        def __init__(self):
+            import jax
+            from ray_tpu.parallel import mesh as mesh_mod
+
+            n = len(jax.devices())
+            cfg = mesh_mod.MeshConfig(tp=n)
+            self.mesh = mesh_mod.create_mesh(cfg)
+            mesh_mod.set_active_mesh_context(
+                mesh_mod.MeshContext(mesh=self.mesh))
+
+        def produce(self, n):
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            x = jnp.arange(n, dtype=jnp.float32)
+            return jax.device_put(
+                x, NamedSharding(self.mesh, PartitionSpec("tp")))
+
+        def consume(self, ref_box):
+            # nested so arg resolution leaves the REF intact — the get
+            # below is the exchange under test
+            ref = ref_box[0]
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from ray_tpu._private import device_objects as dev_mod
+            from ray_tpu._private.worker import global_worker
+            from ray_tpu.experimental import get_device_object
+
+            # instrument THIS consumer's store client: the exchange must
+            # never stage payload bytes (writes) into the shm store
+            store = global_worker().store
+            writes = []
+
+            def spy(name, orig):
+                def wrapped(*a, **kw):
+                    writes.append(name)
+                    return orig(*a, **kw)
+                return wrapped
+
+            originals = {}
+            for name in ("put", "put_parts", "create"):
+                originals[name] = getattr(store, name)
+                setattr(store, name, spy(name, originals[name]))
+            relay_before = dev_mod.RELAY_PULLS
+            try:
+                # bare PartitionSpec: resolved against the ACTIVE mesh
+                # context — the mesh-membership plumbing under test
+                out = get_device_object(
+                    ref, sharding=PartitionSpec())  # replicate
+            finally:
+                for name, orig in originals.items():
+                    setattr(store, name, orig)
+            # sharded -> replicated moved ONLY over the device plane
+            assert dev_mod.RELAY_PULLS == relay_before, "host relay used"
+            assert not writes, f"payload staged through the store: {writes}"
+            n_shards = len(out.sharding.device_set)
+            return float(out.sum()), n_shards
+
+    m = MeshMember.remote()
+    ref = m.produce.options(tensor_transport="device").remote(64)
+    # marker sealed before consume starts: with max_concurrency=2 the
+    # two methods otherwise overlap and the spy would catch produce's
+    # own marker put
+    ray_tpu.wait([ref], timeout=60)
+    total, n_shards = ray_tpu.get(m.consume.remote([ref]), timeout=120)
+    assert total == float(sum(range(64)))
+    assert n_shards >= 1
+    ray_tpu.kill(m)
