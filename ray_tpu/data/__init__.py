@@ -202,9 +202,19 @@ read_bigquery = _gated_reader(
     "read_bigquery", "google-cloud-bigquery",
     "runs a BQ Storage API read session, one stream per read task",
     import_name="google.cloud.bigquery")
-read_mongo = _gated_reader(
-    "read_mongo", "pymongo",
-    "partitions a collection by _id ranges, one cursor per read task")
+def read_mongo(uri: str, database: str, collection: str, *,
+               filter: Optional[dict] = None,
+               projection: Optional[dict] = None,
+               override_num_blocks: Optional[int] = None) -> Dataset:
+    """Rows of a MongoDB collection, partitioned by `_id` ranges — one
+    independent range cursor per read task, spoken over the raw OP_MSG
+    wire protocol (data/mongo.py), no pymongo."""
+    from ray_tpu.data.mongo import mongo_tasks
+
+    return _read("ReadMongo",
+                 mongo_tasks(uri, database, collection,
+                             _par(override_num_blocks), filter=filter,
+                             projection=projection))
 read_lance = _gated_reader(
     "read_lance", "pylance",
     "reads dataset fragments, one per read task", import_name="lance")
@@ -218,9 +228,15 @@ read_databricks_tables = _gated_reader(
     "read_databricks_tables", "databricks-sql-connector",
     "pages results through the Databricks SQL statement API",
     import_name="databricks.sql")
-read_audio = _gated_reader(
-    "read_audio", "soundfile",
-    "decodes PCM per file with sample-rate metadata")
+def read_audio(paths, *,
+               override_num_blocks: Optional[int] = None) -> Dataset:
+    """Decoded audio per file: {amplitude float32[ch, samples],
+    sample_rate, path}.  PCM/float WAV decodes natively (stdlib);
+    other containers use `soundfile` when installed (data/audio.py)."""
+    from ray_tpu.data.audio import audio_tasks
+
+    return _read("ReadAudio",
+                 audio_tasks(paths, _par(override_num_blocks)))
 
 
 def read_iceberg(table_dir: str, *, snapshot_id: Optional[int] = None,
@@ -289,14 +305,9 @@ __all__ = [
     "from_torch",
     "read_audio",
     "read_avro",
-    "read_bigquery",
     "read_binary_files",
     "read_clickhouse",
-    "read_databricks_tables",
-    "read_delta_sharing",
-    "read_hudi",
     "read_iceberg",
-    "read_lance",
     "read_mongo",
     "read_videos",
     "read_csv",

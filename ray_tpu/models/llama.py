@@ -57,6 +57,16 @@ class LlamaConfig:
                            n_heads=4, n_kv_heads=2, d_ff=256,
                            max_seq_len=256, remat=False)
 
+    @staticmethod
+    def llama3_8b_dry(vocab_size: int = 512) -> "LlamaConfig":
+        """8B-SHAPED dry config: the llama3_8b geometry ratios (4:1 GQA,
+        3.5x FFN, head_dim 32) at tiny scale, so a dry run exercises the
+        EXACT sharding structure of the v5e-16 8B recipe
+        (train/llama3.py) without 8B of parameters."""
+        return LlamaConfig(vocab_size=vocab_size, d_model=256, n_layers=4,
+                           n_heads=8, n_kv_heads=2, d_ff=896,
+                           max_seq_len=512, remat=True, loss_chunk=128)
+
 
 def param_logical_specs(cfg: LlamaConfig):
     """Logical sharding spec tree, mirroring init()'s param tree."""

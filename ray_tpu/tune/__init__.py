@@ -14,7 +14,12 @@ from ray_tpu.tune.schedulers import (
     PopulationBasedTraining,
     TrialScheduler,
 )
-from ray_tpu.tune.searchers import OptunaSearch, TPESearcher
+from ray_tpu.tune.searchers import (
+    AnnealingSearcher,
+    BOHBSearcher,
+    OptunaSearch,
+    TPESearcher,
+)
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
     Searcher,
@@ -28,6 +33,8 @@ from ray_tpu.tune.search import (
 from ray_tpu.tune.tuner import Result, ResultGrid, TuneConfig, Tuner
 
 __all__ = [
+    "AnnealingSearcher",
+    "BOHBSearcher",
     "OptunaSearch",
     "TPESearcher",
     "ASHAScheduler",

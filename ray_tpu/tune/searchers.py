@@ -204,3 +204,144 @@ class OptunaSearch(Searcher):
             self._study.tell(trial, state=2)  # PRUNED
             return
         self._study.tell(trial, float(result[self.metric]))
+
+
+class AnnealingSearcher(Searcher):
+    """Simulated-annealing search (reference: tune/search/ — hyperopt's
+    ``anneal`` suggester plays this role there).
+
+    Proposals perturb the best configuration seen so far with a radius
+    that cools geometrically per completed trial; a worse incumbent is
+    still adopted with probability exp(delta / T), so early exploration
+    escapes local optima and late trials exploit.  Numpy-free and
+    air-gap friendly like TPESearcher.
+    """
+
+    def __init__(self, metric: str, mode: str = "max",
+                 initial_radius: float = 0.5, cooling: float = 0.95,
+                 initial_temp: float = 1.0,
+                 seed: Optional[int] = None):
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be 'min' or 'max'")
+        self.metric = metric
+        self.mode = mode
+        self._radius = initial_radius
+        self._cooling = cooling
+        self._temp = initial_temp
+        self._rng = random.Random(seed)
+        self._space: Dict[str, Any] = {}
+        self._inflight: Dict[str, Dict[str, Any]] = {}
+        self._incumbent: Optional[tuple[Dict[str, Any], float]] = None
+        self._n_done = 0
+
+    def set_search_space(self, param_space: Dict[str, Any]
+                         ) -> "AnnealingSearcher":
+        for k, v in param_space.items():
+            if isinstance(v, GridSearch):
+                raise ValueError("grid_search belongs to "
+                                 "BasicVariantGenerator")
+            self._space[k] = v
+        return self
+
+    def _perturb_dim(self, dom: Domain, center: Any, radius: float) -> Any:
+        if isinstance(dom, Choice):
+            if self._rng.random() < radius:
+                return self._rng.choice(dom.options)
+            return center
+        if isinstance(dom, (Uniform, QUniform, RandInt)):
+            lo, hi = float(dom.low), float(dom.high)
+            x = float(center) + self._rng.gauss(0, radius * (hi - lo))
+            x = min(max(x, lo), hi)
+        elif isinstance(dom, LogUniform):
+            llo, lhi = math.log(dom.low), math.log(dom.high)
+            lx = math.log(max(float(center), 1e-300)) + self._rng.gauss(
+                0, radius * (lhi - llo))
+            x = math.exp(min(max(lx, llo), lhi))
+        else:
+            return dom.sample(self._rng)
+        if isinstance(dom, RandInt):
+            return int(min(max(round(x), dom.low), dom.high - 1))
+        if isinstance(dom, QUniform):
+            return round(x / dom.q) * dom.q
+        return x
+
+    # -- Searcher ABC ------------------------------------------------------
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if not self._space:
+            raise RuntimeError("call set_search_space(param_space) first")
+        if self._incumbent is None:
+            cfg = {k: (v.sample(self._rng) if isinstance(v, Domain) else v)
+                   for k, v in self._space.items()}
+        else:
+            center, _ = self._incumbent
+            radius = self._radius * (self._cooling ** self._n_done)
+            cfg = {k: (self._perturb_dim(v, center.get(k), radius)
+                       if isinstance(v, Domain) else v)
+                   for k, v in self._space.items()}
+        self._inflight[trial_id] = cfg
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict],
+                          error: bool = False) -> None:
+        cfg = self._inflight.pop(trial_id, None)
+        if cfg is None or error or not result or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "min":
+            score = -score
+        self._n_done += 1
+        if self._incumbent is None:
+            self._incumbent = (cfg, score)
+            return
+        _, inc_score = self._incumbent
+        temp = max(self._temp * (self._cooling ** self._n_done), 1e-9)
+        if score >= inc_score or self._rng.random() < math.exp(
+                min(0.0, (score - inc_score) / temp)):
+            self._incumbent = (cfg, score)
+
+
+class BOHBSearcher(TPESearcher):
+    """BOHB's model side (Falkner et al., ICML 2018): TPE density models
+    fed per-fidelity, pairing with the HyperBand scheduler
+    (tune/schedulers.py) the way the reference pairs TuneBOHB with
+    HyperBandForBOHB.
+
+    Observations are grouped by the budget they were measured at
+    (``budget_key`` in the reported result, default
+    "training_iteration"); suggestions come from the KDE of the HIGHEST
+    budget that has accumulated ``n_initial_points`` results — low-rung
+    early-stopped trials guide the search until real high-fidelity
+    evidence exists, then the model upgrades to it.
+    """
+
+    def __init__(self, metric: str, mode: str = "max",
+                 budget_key: str = "training_iteration",
+                 n_initial_points: int = 5, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        super().__init__(metric, mode, n_initial_points, gamma,
+                         n_candidates, seed)
+        self.budget_key = budget_key
+        self._by_budget: Dict[float, List[tuple[Dict[str, Any], float]]] = {}
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict],
+                          error: bool = False) -> None:
+        cfg = self._inflight.pop(trial_id, None)
+        if cfg is None or error or not result or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "min":
+            score = -score
+        budget = float(result.get(self.budget_key, 0) or 0)
+        self._by_budget.setdefault(budget, []).append((cfg, score))
+        # the pooled view keeps the random-phase counter in sync
+        self._observed.append((cfg, score))
+
+    def _split(self):
+        # highest fidelity with enough evidence wins; else pool
+        for budget in sorted(self._by_budget, reverse=True):
+            obs = self._by_budget[budget]
+            if len(obs) >= self.n_initial:
+                ranked = sorted(obs, key=lambda t: -t[1])
+                n_good = max(1, int(math.ceil(self.gamma * len(ranked))))
+                return ranked[:n_good], ranked[n_good:]
+        return super()._split()

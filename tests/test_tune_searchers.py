@@ -2,6 +2,8 @@
 suggestion sees completed results. Mirrors reference tune/tests/
 test_searchers.py in shape."""
 
+import math
+
 import pytest
 
 
@@ -130,3 +132,98 @@ def test_custom_searcher_plugin_contract(ray_cluster, tmp_path):
                for res, err in searcher.completed.values())
     best = results.get_best_result()
     assert best.metrics["score"] == 50
+
+
+def test_annealing_converges_on_quadratic():
+    """Simulated annealing: late proposals concentrate near the optimum
+    and the best score approaches it."""
+    from ray_tpu.tune.search import choice, uniform
+    from ray_tpu.tune.searchers import AnnealingSearcher
+
+    def score(cfg):
+        return -(cfg["x"] - 0.7) ** 2 + (0.5 if cfg["y"] == "c" else 0.0)
+
+    s = AnnealingSearcher(metric="s", mode="max", seed=3)
+    s.set_search_space({"x": uniform(0.0, 1.0),
+                        "y": choice(["a", "b", "c"])})
+    best = -1e9
+    late_xs = []
+    for i in range(80):
+        tid = f"t{i}"
+        cfg = s.suggest(tid)
+        val = score(cfg)
+        best = max(best, val)
+        if i >= 60:
+            late_xs.append(cfg["x"])
+        s.on_trial_complete(tid, {"s": val})
+    assert best > 0.45
+    assert sum(abs(x - 0.7) < 0.2 for x in late_xs) >= len(late_xs) // 2
+
+
+def test_annealing_min_mode_and_log_dims():
+    from ray_tpu.tune.search import loguniform
+    from ray_tpu.tune.searchers import AnnealingSearcher
+
+    s = AnnealingSearcher(metric="loss", mode="min", seed=1)
+    s.set_search_space({"lr": loguniform(1e-5, 1e-1)})
+    best = 1e9
+    for i in range(60):
+        tid = f"t{i}"
+        cfg = s.suggest(tid)
+        loss = (math.log10(cfg["lr"]) + 3) ** 2  # optimum lr=1e-3
+        best = min(best, loss)
+        s.on_trial_complete(tid, {"loss": loss})
+    assert best < 0.5
+
+
+def test_bohb_prefers_high_fidelity_evidence():
+    """BOHB groups observations per budget: once the top rung has enough
+    results, its KDE drives suggestions — low-rung noise (which points to
+    the WRONG optimum here) stops steering the search."""
+    from ray_tpu.tune.search import uniform
+    from ray_tpu.tune.searchers import BOHBSearcher
+
+    s = BOHBSearcher(metric="s", mode="max", n_initial_points=5, seed=0)
+    s.set_search_space({"x": uniform(0.0, 1.0)})
+    # low-fidelity rung: misleading scores favoring x near 0.1
+    for i in range(12):
+        tid = f"lo{i}"
+        cfg = s.suggest(tid)
+        s.on_trial_complete(
+            tid, {"s": -(cfg["x"] - 0.1) ** 2, "training_iteration": 1})
+    # high-fidelity rung: truth favors x near 0.9
+    for i in range(12):
+        tid = f"hi{i}"
+        cfg = s.suggest(tid)
+        s.on_trial_complete(
+            tid, {"s": -(cfg["x"] - 0.9) ** 2, "training_iteration": 9})
+    late = [s.suggest(f"probe{i}") for i in range(8)]
+    near_true = sum(abs(c["x"] - 0.9) < 0.25 for c in late)
+    near_decoy = sum(abs(c["x"] - 0.1) < 0.25 for c in late)
+    assert near_true > near_decoy
+
+
+def test_bohb_with_hyperband_in_tuner(cluster):
+    """BOHB + HyperBand end to end through the Tuner, the reference's
+    TuneBOHB + HyperBandForBOHB pairing."""
+    from ray_tpu import tune
+
+    def trainable(config):
+        for it in range(4):
+            tune.report({"score": -(config["p"] - 0.5) ** 2 - 0.01 * it,
+                         "training_iteration": it + 1})
+
+    searcher = tune.BOHBSearcher(metric="score", mode="max",
+                                 n_initial_points=3, seed=0)
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"p": tune.uniform(0.0, 1.0)},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=8,
+            search_alg=searcher,
+            scheduler=tune.HyperBandScheduler(max_t=4,
+                                              reduction_factor=2)),
+    )
+    results = tuner.fit()
+    best = results.get_best_result(metric="score", mode="max")
+    assert best.metrics["score"] > -0.3
