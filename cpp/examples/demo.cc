@@ -30,7 +30,9 @@ int main(int argc, char** argv) {
   auto got = client->KvGet("cppdemo", "greeting");
   CHECK(got && *got == "hello-from-cpp", "kv roundtrip");
   auto keys = client->KvKeys("cppdemo");
-  CHECK(keys.size() == 1 && keys[0] == "greeting", "kv_keys");
+  bool has_greeting = false;
+  for (auto& k : keys) has_greeting |= (k == "greeting");
+  CHECK(has_greeting, "kv_keys");
 
   // -- cluster state ------------------------------------------------------
   auto nodes = client->ListNodes();
@@ -73,6 +75,33 @@ int main(int argc, char** argv) {
   // remote exception surfaces as !ok
   auto r5 = actor->Call("boom", {});
   CHECK(!r5.ok, "remote exception not surfaced");
+
+  // -- object Put/Get -----------------------------------------------------
+  Value obj = Value::Dict();
+  obj.set("kind", Value::Str("cpp-object"));
+  Value vec = Value::List();
+  for (int i = 0; i < 5; ++i) vec.push(Value::Int(i * i));
+  obj.set("squares", vec);
+  std::string oid = client->Put(obj);
+  CHECK(oid.size() == 20, "Put failed");
+  auto back = client->Get(oid);
+  CHECK(back && back->get("kind") && back->get("kind")->s == "cpp-object",
+        "Get roundtrip kind");
+  CHECK(back->get("squares") && back->get("squares")->items &&
+            back->get("squares")->items->size() == 5 &&
+            (*back->get("squares")->items)[4].as_i() == 16,
+        "Get roundtrip payload");
+  // publish the oid so the Python side of the test can read OUR object
+  client->KvPut("cppdemo", "oid", oid);
+  // and read an object a PYTHON put sealed, when the test staged one
+  auto py_oid = client->KvGet("cppdemo", "py_oid");
+  if (py_oid) {
+    auto py_obj = client->Get(*py_oid);
+    CHECK(py_obj && py_obj->get("from") &&
+              py_obj->get("from")->s == "python",
+          "cross-language Get");
+    printf("CROSS-LANG-OK\n");
+  }
 
   // per-caller FIFO across a burst
   for (int i = 0; i < 20; ++i) {

@@ -55,6 +55,7 @@ struct NodeInfo {
   std::string node_id;  // raw bytes
   bool alive = false;
   bool is_head = false;
+  std::string store_socket;  // node-local shm store daemon (unix path)
 };
 
 struct ActorInfo {
@@ -116,17 +117,40 @@ class Client {
   // Resolve + open a direct channel; nullptr when the actor is not ALIVE.
   std::unique_ptr<ActorHandle> GetActorHandle(const std::string& name);
 
+  // -- objects -----------------------------------------------------------
+  // Put/Get against the LOCAL node's shm store daemon (the first alive
+  // node whose store socket exists on this host), in the framework's
+  // store payload format (TAG_PICKLE + plain-data pickle) — Python
+  // ray_tpu.get() reads C++ puts and vice versa.  Put publishes the
+  // object's location to the GCS directory so remote nodes can pull it.
+  // Returns the 20-byte object id ("" on failure).
+  std::string Put(const wire::Value& value);
+  // Get by object id; nullopt on miss/timeout, throws std::runtime_error
+  // for stored errors or non-plain-data payloads (e.g. arrays).
+  std::optional<wire::Value> Get(const std::string& object_id,
+                                 int timeout_ms = 10000);
+
   // One wire-codec RPC against the GCS (public: escape hatch for methods
   // without a typed wrapper).  Throws wire::WireError on protocol errors,
   // std::runtime_error on a remote error response.
   wire::Value CallGcs(const std::string& method,
                       const std::vector<wire::Value>& args);
 
+  ~Client();
+
  private:
   Client(std::unique_ptr<Connection> conn, std::string token)
       : conn_(std::move(conn)), token_(std::move(token)) {}
+  // one persistent store-daemon connection, resolved+dialed on first
+  // Put/Get and reused (the daemon's OP_PUT/GET_INLINE are one round
+  // trip; re-resolving the socket and re-handshaking per call would
+  // triple it).  Re-dialed transparently after a drop.
+  int store_conn();
   std::unique_ptr<Connection> conn_;
   std::string token_;
+  std::string store_sock_;
+  std::string store_node_;
+  int store_fd_ = -1;
 };
 
 // Plain-data pickle codec (exposed for tests).

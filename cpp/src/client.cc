@@ -723,6 +723,7 @@ std::vector<NodeInfo> Client::ListNodes() {
       if (auto* f = n.get("node_id")) info.node_id = f->s;
       if (auto* f = n.get("alive")) info.alive = f->truthy();
       if (auto* f = n.get("is_head")) info.is_head = f->truthy();
+      if (auto* f = n.get("store_socket")) info.store_socket = f->s;
       out.push_back(std::move(info));
     }
   return out;
@@ -745,6 +746,170 @@ std::unique_ptr<ActorHandle> Client::GetActorHandle(const std::string& name) {
   auto conn = Connection::Dial(info->addr, token_);
   if (!conn) return nullptr;
   return std::make_unique<ActorHandle>(std::move(*info), std::move(conn));
+}
+
+// ---------------------------------------------------------------------------
+// Object Put/Get against the local shm store daemon.
+//
+// Speaks store_client.py's fixed-frame protocol (shm_store.cc): 37-byte
+// request <u8 op | 20s oid | u64 arg0 | u64 arg1>, 17-byte response
+// <u8 status | u64 r0 | u64 r1>.  Payloads are the framework's store
+// format: one tag byte (0 = pickle) + a plain-data pickle — the same
+// bytes Python's serialization.deserialize reads, so objects are fully
+// interoperable across the language boundary
+// (reference: cpp/include/ray/api.h Put/Get over the plasma client).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+constexpr uint8_t kOpPut = 9, kOpGetInline = 10;
+constexpr uint8_t kStOk = 0, kStNotFound = 1, kStTimeout = 4,
+                  kStNotSealed = 5, kStEvicted = 7;
+constexpr uint8_t kTagPickle = 0, kTagError = 1;
+
+int dial_store(const std::string& path) {
+  int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  struct sockaddr_un sa {};
+  sa.sun_family = AF_UNIX;
+  if (path.size() >= sizeof(sa.sun_path)) {
+    ::close(fd);
+    return -1;
+  }
+  memcpy(sa.sun_path, path.c_str(), path.size() + 1);
+  if (::connect(fd, (struct sockaddr*)&sa, sizeof(sa)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  std::string client_id = random_bytes(20);  // per-conn ref bookkeeping key
+  if (!send_all(fd, client_id.data(), 20)) {
+    ::close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+std::string pack_store_req(uint8_t op, const std::string& oid20,
+                           uint64_t a0, uint64_t a1) {
+  std::string req(37, '\0');
+  req[0] = char(op);
+  memcpy(&req[1], oid20.data(), 20);
+  memcpy(&req[21], &a0, 8);
+  memcpy(&req[29], &a1, 8);
+  return req;
+}
+
+// first alive node whose store socket exists on THIS host
+bool local_store(Client& c, std::string* sock, std::string* node_id) {
+  for (auto& n : c.ListNodes()) {
+    if (!n.alive || n.store_socket.empty()) continue;
+    if (::access(n.store_socket.c_str(), F_OK) == 0) {
+      *sock = n.store_socket;
+      *node_id = n.node_id;
+      return true;
+    }
+  }
+  return false;
+}
+
+}  // namespace
+
+Client::~Client() {
+  if (store_fd_ >= 0) ::close(store_fd_);
+}
+
+int Client::store_conn() {
+  if (store_fd_ >= 0) return store_fd_;
+  if (store_sock_.empty() &&
+      !local_store(*this, &store_sock_, &store_node_))
+    return -1;
+  store_fd_ = dial_store(store_sock_);
+  return store_fd_;
+}
+
+std::string Client::Put(const wire::Value& value) {
+  std::string payload;
+  payload.push_back(char(kTagPickle));
+  payload.push_back(char(0x80));  // PROTO 3 pickle of the bare value
+  payload.push_back(3);
+  try {
+    pickle_value(payload, value);
+  } catch (const std::exception&) {
+    return "";  // unpicklable kind: the documented "" failure, no throw
+  }
+  payload.push_back('.');
+  std::string oid = random_bytes(20);
+  int fd = store_conn();
+  if (fd < 0) return "";
+  std::string req = pack_store_req(kOpPut, oid, payload.size(), 0);
+  uint8_t resp[17];
+  bool ok = send_all(fd, req.data(), req.size()) &&
+            send_all(fd, payload.data(), payload.size()) &&
+            recv_all(fd, (char*)resp, sizeof resp) && resp[0] == kStOk;
+  if (!ok) {
+    ::close(store_fd_);  // drop the (possibly desynced) conn
+    store_fd_ = -1;
+    return "";
+  }
+  // location directory entry: remote nodes resolve + pull through it
+  try {
+    CallGcs("add_object_location",
+            {wire::Value::Bytes(oid), wire::Value::Bytes(store_node_)});
+  } catch (const std::exception&) {
+    // best-effort: same-node gets still work
+  }
+  return oid;
+}
+
+std::optional<wire::Value> Client::Get(const std::string& object_id,
+                                       int timeout_ms) {
+  int fd = store_conn();
+  if (fd < 0) return std::nullopt;
+  // huge inline cap: every object comes back as bytes (the zero-copy
+  // view path needs the shm mapping, which a convenience client skips)
+  std::string req = pack_store_req(kOpGetInline, object_id,
+                                   uint64_t(timeout_ms), ~0ull);
+  uint8_t resp[17];
+  if (!send_all(fd, req.data(), req.size()) ||
+      !recv_all(fd, (char*)resp, sizeof resp)) {
+    ::close(store_fd_);
+    store_fd_ = -1;
+    return std::nullopt;
+  }
+  uint8_t status = resp[0];
+  uint64_t inline_flag, size;
+  memcpy(&inline_flag, resp + 1, 8);
+  memcpy(&size, resp + 9, 8);
+  if (status == kStNotFound || status == kStTimeout ||
+      status == kStNotSealed || status == kStEvicted) {
+    return std::nullopt;  // clean miss: the conn stays usable
+  }
+  if (status != kStOk || !inline_flag) {
+    // daemon-side error (ST_ERR etc.) must be distinguishable from a
+    // plain miss; !inline_flag cannot happen under the ~0 cap
+    throw std::runtime_error("store get failed, status " +
+                             std::to_string(int(status)));
+  }
+  std::string payload(size, '\0');
+  bool ok = recv_all(fd, payload.data(), size);
+  if (!ok) {
+    ::close(store_fd_);
+    store_fd_ = -1;
+    return std::nullopt;
+  }
+  if (payload.empty()) return std::nullopt;
+  uint8_t tag = uint8_t(payload[0]);
+  if (tag == kTagError)
+    throw std::runtime_error("object holds a stored task error");
+  if (tag != kTagPickle)
+    throw std::runtime_error(
+        "object payload is not plain data (array payloads need the "
+        "Python client)");
+  wire::Value out;
+  if (!UnpickleValue(payload.data() + 1, payload.size() - 1, &out))
+    throw std::runtime_error(
+        "object pickle uses opcodes outside the plain-data subset");
+  return out;
 }
 
 }  // namespace rtpu

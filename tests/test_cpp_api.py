@@ -61,18 +61,31 @@ def test_cpp_client_against_cluster(ray_cluster, demo_binary):
 
     actor = ray_tpu.remote(CppDemo).options(name="cppdemo").remote()
     ray_tpu.get(actor.echo.remote(0))  # ALIVE + direct server up
+    # stage a Python object for the C++ side to Get (cross-language read)
+    py_ref = ray_tpu.put({"from": "python", "n": 7})
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    w.rpc("kv_put", {"namespace": "cppdemo", "key": b"py_oid",
+                     "value": py_ref.binary()})
     gcs_addr = api._global_node.gcs_address
     proc = subprocess.run([demo_binary, gcs_addr, "cppdemo"],
                           capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     assert "DEMO-OK" in proc.stdout
     assert "actor=CppDemo" in proc.stdout
+    assert "CROSS-LANG-OK" in proc.stdout  # C++ read the Python object
     # the KV write from C++ is visible from Python
-    from ray_tpu._private.worker import global_worker
-
-    w = global_worker()
     assert w.rpc("kv_get", {"namespace": "cppdemo",
                             "key": b"greeting"}) == b"hello-from-cpp"
+    # ...and Python reads the object the C++ client Put (store format is
+    # shared; the oid rode the KV table)
+    from ray_tpu.core.object_ref import ObjectRef
+
+    cpp_oid = w.rpc("kv_get", {"namespace": "cppdemo", "key": b"oid"})
+    obj = ray_tpu.get(ObjectRef(cpp_oid), timeout=30)
+    assert obj["kind"] == "cpp-object"
+    assert obj["squares"] == [0, 1, 4, 9, 16]
     ray_tpu.kill(actor)
 
 
