@@ -125,7 +125,11 @@ def serving_bench() -> dict:
         # throughput so queueing is real but bounded.
         import threading as _threading
 
-        n_req, arrival_rate = 24, 3.0  # req/s
+        # 96 requests ≈ a 27s sustained window — long enough that the
+        # continuous-batching engine reaches steady state (slots cycling,
+        # queue depth stable) instead of the r4 burst that finished before
+        # the batcher filled (VERDICT weak #6: "24 requests ... is a toy")
+        n_req, arrival_rate = 96, 3.5  # req/s
         results: list = [None] * n_req
         t0 = time.monotonic()
 

@@ -102,6 +102,12 @@ class Gcs:
     EVENT_RING = 16384
 
     def __init__(self, persist_path: Optional[str] = None):
+        if persist_path and persist_path.startswith("redis://"):
+            # the Redis-backed store client lives in the native daemon
+            # (gcs_server.cc RedisPersist); the Python fallback is file-only
+            raise ValueError(
+                "redis:// GCS persistence requires the native GCS daemon "
+                "(unset RTPU_PYTHON_GCS)")
         self._lock = threading.RLock()
         # pubsub event log (reference: gcs_server/pubsub_handler.cc —
         # long-poll subscriptions over a bounded ring of change events)

@@ -24,6 +24,7 @@
 #include <arpa/inet.h>
 #include <errno.h>
 #include <fcntl.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <signal.h>
@@ -38,6 +39,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <memory>
 #include <deque>
 #include <map>
 #include <set>
@@ -82,6 +84,199 @@ struct Waiter {  // a parked sub_poll long-poll
   uint64_t cursor;
   double deadline_mono;  // <=0: no timeout (shouldn't happen; client sends one)
 };
+
+// ---------------------------------------------------------------------------
+// Pluggable persistence backends (reference:
+// src/ray/gcs/store_client/redis_store_client.h — the GCS tables behind
+// a swappable store client).  The snapshot blob is identical across
+// backends (wire-encoded state dict), so a head can move between them.
+// ---------------------------------------------------------------------------
+
+struct PersistBackend {
+  virtual ~PersistBackend() = default;
+  virtual bool store(const std::string& blob) = 0;
+  // false = backend unreachable (NOT the same as "no snapshot": a head
+  // must never start empty and overwrite durable state just because the
+  // store was briefly down); true with empty *blob = genuinely absent.
+  virtual bool load(std::string* blob) = 0;
+};
+
+struct FilePersist : PersistBackend {
+  std::string path;
+  explicit FilePersist(std::string p) : path(std::move(p)) {}
+
+  bool store(const std::string& blob) override {
+    std::string tmp = path + ".tmp";
+    FILE* f = fopen(tmp.c_str(), "wb");
+    if (!f) return false;
+    bool ok = fwrite(blob.data(), 1, blob.size(), f) == blob.size();
+    ok = fclose(f) == 0 && ok;
+    if (ok) rename(tmp.c_str(), path.c_str());  // atomic swap
+    return ok;
+  }
+
+  bool load(std::string* blob) override {
+    blob->clear();
+    FILE* f = fopen(path.c_str(), "rb");
+    if (!f) return true;  // absent: a fresh cluster
+    char buf[1 << 16];
+    size_t n;
+    while ((n = fread(buf, 1, sizeof buf, f)) > 0) blob->append(buf, n);
+    fclose(f);
+    return true;
+  }
+};
+
+// RESP (Redis Serialization Protocol) backend: SET/GET of the snapshot
+// blob against any Redis-compatible server — the durable external
+// control-plane store the reference uses for GCS fault tolerance.
+// URL: redis://host:port[/key]
+struct RedisPersist : PersistBackend {
+  std::string host, key;
+  int port;
+  int fd = -1;
+
+  RedisPersist(std::string h, int p, std::string k)
+      : host(std::move(h)), key(std::move(k)), port(p) {}
+  ~RedisPersist() override {
+    if (fd >= 0) close(fd);
+  }
+
+  static constexpr int kIoTimeoutS = 5;
+
+  bool ensure() {
+    if (fd >= 0) return true;
+    // hostname or numeric address (getaddrinfo covers both); timeouts
+    // are set BEFORE connect — this runs on the single epoll control
+    // thread, and a blackholed Redis must degrade, never hang the GCS
+    struct addrinfo hints, *res = nullptr;
+    memset(&hints, 0, sizeof hints);
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    std::string port_s = std::to_string(port);
+    if (getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) != 0)
+      return false;
+    for (struct addrinfo* ai = res; ai; ai = ai->ai_next) {
+      fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+      if (fd < 0) continue;
+      struct timeval tv = {kIoTimeoutS, 0};
+      setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+      if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+      close(fd);
+      fd = -1;
+    }
+    freeaddrinfo(res);
+    return fd >= 0;
+  }
+
+  bool write_all(const std::string& data) {
+    size_t off = 0;
+    while (off < data.size()) {
+      ssize_t n = send(fd, data.data() + off, data.size() - off,
+                       MSG_NOSIGNAL);
+      if (n <= 0) return false;
+      off += size_t(n);
+    }
+    return true;
+  }
+
+  bool read_line(std::string* line) {
+    line->clear();
+    char c;
+    while (true) {
+      ssize_t n = recv(fd, &c, 1, 0);
+      if (n <= 0) return false;
+      if (c == '\r') {
+        if (recv(fd, &c, 1, 0) <= 0) return false;  // consume \n
+        return true;
+      }
+      line->push_back(c);
+    }
+  }
+
+  bool read_exact(std::string* out, size_t n) {
+    out->resize(n);
+    size_t off = 0;
+    while (off < n) {
+      ssize_t r = recv(fd, out->data() + off, n - off, 0);
+      if (r <= 0) return false;
+      off += size_t(r);
+    }
+    char crlf[2];
+    return recv(fd, crlf, 2, MSG_WAITALL) == 2;
+  }
+
+  static std::string cmd(const std::vector<std::string>& parts) {
+    std::string out = "*" + std::to_string(parts.size()) + "\r\n";
+    for (auto& p : parts)
+      out += "$" + std::to_string(p.size()) + "\r\n" + p + "\r\n";
+    return out;
+  }
+
+  bool store(const std::string& blob) override {
+    for (int attempt = 0; attempt < 2; ++attempt) {
+      if (!ensure()) return false;
+      std::string reply;
+      if (write_all(cmd({"SET", key, blob})) && read_line(&reply) &&
+          !reply.empty() && reply[0] == '+')
+        return true;
+      close(fd);  // stale/broken conn: one reconnect attempt
+      fd = -1;
+    }
+    return false;
+  }
+
+  bool load(std::string* blob) override {
+    blob->clear();
+    // a few connect attempts: a briefly-restarting Redis at head boot
+    // must not be mistaken for "no snapshot"
+    for (int attempt = 0; attempt < 5; ++attempt) {
+      if (ensure()) break;
+      struct timespec ts = {0, 300 * 1000 * 1000};
+      nanosleep(&ts, nullptr);
+    }
+    if (fd < 0) return false;  // unreachable: caller decides (fatal)
+    std::string reply;
+    if (!write_all(cmd({"GET", key})) || !read_line(&reply) ||
+        reply.empty() || reply[0] != '$') {
+      close(fd);
+      fd = -1;
+      return false;
+    }
+    long long n = atoll(reply.c_str() + 1);
+    if (n < 0) return true;  // $-1: key absent — fresh cluster
+    if (!read_exact(blob, size_t(n))) {
+      close(fd);
+      fd = -1;
+      blob->clear();
+      return false;
+    }
+    return true;
+  }
+};
+
+std::unique_ptr<PersistBackend> make_persist(const std::string& spec) {
+  if (spec.empty()) return nullptr;
+  if (spec.rfind("redis://", 0) == 0) {
+    std::string rest = spec.substr(8);
+    std::string key = "rtpu:gcs";
+    auto slash = rest.find('/');
+    if (slash != std::string::npos) {
+      if (slash + 1 < rest.size()) key = rest.substr(slash + 1);
+      rest = rest.substr(0, slash);
+    }
+    auto colon = rest.rfind(':');
+    int port = 6379;
+    std::string host = rest;
+    if (colon != std::string::npos) {
+      host = rest.substr(0, colon);
+      port = atoi(rest.c_str() + colon + 1);
+    }
+    return std::make_unique<RedisPersist>(host, port, key);
+  }
+  return std::make_unique<FilePersist>(spec);
+}
 
 struct Gcs {
   std::map<std::string, Value> actors;       // actor_id -> STRUCT(1)
@@ -130,8 +325,8 @@ struct Gcs {
   uint64_t next_seq = 1;
   size_t ring_cap = env_size("RTPU_GCS_RING_CAP", 16384);
 
-  // persistence
-  std::string persist_path;
+  // persistence (pluggable: file | redis — see make_persist)
+  std::unique_ptr<PersistBackend> persist;
   bool dirty = false;
   double snapshot_due_mono = 0;  // 0 = none pending
   double debounce_s = env_f("RTPU_GCS_SNAPSHOT_DEBOUNCE_S", 0.2);
@@ -142,14 +337,14 @@ struct Gcs {
   }
 
   void mutated() {
-    if (persist_path.empty()) return;
+    if (!persist) return;
     dirty = true;
     if (snapshot_due_mono == 0) snapshot_due_mono = mono_s() + debounce_s;
   }
 
   void snapshot() {
     snapshot_due_mono = 0;
-    if (persist_path.empty() || !dirty) return;
+    if (!persist || !dirty) return;
     dirty = false;
     Value state = Value::Dict();
     Value va = Value::Dict();
@@ -185,25 +380,24 @@ struct Gcs {
     state.set("task_events", vt);
 
     std::string data = wire::encode(state);
-    std::string tmp = persist_path + ".tmp";
-    FILE* f = fopen(tmp.c_str(), "wb");
-    if (!f) return;  // best effort; next mutation retries
-    bool ok = fwrite(data.data(), 1, data.size(), f) == data.size();
-    ok = fclose(f) == 0 && ok;
-    if (ok)
-      rename(tmp.c_str(), persist_path.c_str());
-    else
+    if (!persist->store(data)) {
+      // re-arm the timer OURSELVES: with a network backend a transient
+      // failure must retry even if no further mutation ever arrives
       dirty = true;
+      snapshot_due_mono = mono_s() + 1.0;
+    }
   }
 
   void restore() {
-    FILE* f = fopen(persist_path.c_str(), "rb");
-    if (!f) return;
     std::string data;
-    char buf[1 << 16];
-    size_t n;
-    while ((n = fread(buf, 1, sizeof buf, f)) > 0) data.append(buf, n);
-    fclose(f);
+    if (!persist->load(&data)) {
+      // the durable store exists but is unreachable: starting EMPTY and
+      // later overwriting it would destroy the persisted control plane
+      fprintf(stderr,
+              "FATAL: GCS persistence backend unreachable at startup\n");
+      exit(1);
+    }
+    if (data.empty()) return;
     Value state;
     try {
       state = wire::decode(data);
@@ -964,7 +1158,7 @@ struct Server {
     struct epoll_event evs[64];
     for (;;) {
       if (g_stop) {  // SIGTERM/SIGINT: flush durable state, then exit
-        gcs.dirty = gcs.dirty || !gcs.persist_path.empty();
+        gcs.dirty = gcs.dirty || bool(gcs.persist);
         gcs.snapshot();
         return 0;
       }
@@ -1042,8 +1236,8 @@ int main(int argc, char** argv) {
   Server srv;
   srv.parent_pid = parent_pid_arg;
   srv.gcs.death_timeout_s = death_timeout;
-  srv.gcs.persist_path = persist;
-  if (!persist.empty()) srv.gcs.restore();
+  srv.gcs.persist = make_persist(persist);
+  if (srv.gcs.persist) srv.gcs.restore();
   const char* tok = getenv("RTPU_CLUSTER_TOKEN");
   srv.token = tok ? tok : "";
 
