@@ -143,3 +143,51 @@ def test_tcp_control_plane_requires_cluster_token(tcp_cluster):
         assert resp in (b"", struct.pack("<I", 2) + b"NO"), resp
     finally:
         s.close()
+
+
+def test_native_transfer_plane_over_tcp(tcp_cluster):
+    """Across real OS-process nodes over TCP: both store daemons
+    advertise transfer listeners, and a pull through the native plane
+    (token-authed XFER_PULL between daemons) lands the object in the
+    head's store."""
+    c, _ = tcp_cluster
+    head = c.head_node
+    # fresh external node: earlier tests in this module kill theirs
+    ext = c.add_node(external=True, resources={"CPU": 2.0}, min_workers=1)
+    c.wait_for_nodes(timeout=90)
+    nodes = {n.node_id: n for n in head.gcs.list_nodes()}
+    assert all(n.xfer_addr for n in nodes.values() if n.alive), \
+        "every TCP node must advertise a transfer listener"
+
+    # produce a large object ON the external node, then get it from the
+    # driver (head): the bytes cross via the daemon-to-daemon plane
+    @ray_tpu.remote
+    def produce(n):
+        import numpy as _np
+
+        return _np.arange(n, dtype=_np.int64)
+
+    target = ext.node_id.hex()
+    ref = produce.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            target)).remote(400_000)
+    # Cut the framed Python fallback on the head for the duration: the
+    # object can now arrive ONLY through the native daemon plane — a
+    # silently-broken XFER_PULL fails the test instead of falling back.
+    transfer = head.scheduler._transfer
+    fallbacks = []
+    orig_fetch = transfer._fetch_from
+
+    def no_fallback(addr, oid):
+        fallbacks.append(oid)
+        return False
+
+    transfer._fetch_from = no_fallback
+    try:
+        arr = ray_tpu.get(ref, timeout=120)
+    finally:
+        transfer._fetch_from = orig_fetch
+    assert arr.shape == (400_000,) and int(arr[-1]) == 399_999
+    assert not fallbacks, "pull used the framed fallback, not XFER_PULL"
+    # after the pull the head's own store holds a sealed copy
+    assert head.scheduler._store.contains(ref.binary())
