@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import itertools
 import os
+import struct
 import threading
 import time
 import traceback
@@ -218,14 +219,18 @@ class Scheduler:
         # work — graceful scale-down runs this before termination.
         self._draining = False
         self._memory_monitor = None
-        threshold = float(
+        self._mm_threshold = float(
             os.environ.get("RTPU_MEMORY_MONITOR_THRESHOLD", 0.95))
-        if threshold > 0:
+        if self._mm_threshold > 0:
             from ray_tpu._private.memory_monitor import MemoryMonitor
 
             self._memory_monitor = MemoryMonitor(
-                threshold, self._handle_memory_pressure)
-            self._memory_monitor.start()
+                self._mm_threshold, self._handle_memory_pressure)
+            # started below: with the native node server, sampling +
+            # threshold detection run in the C++ epoll loop (reference:
+            # memory_monitor.h is C++ for the same reason) and Python
+            # keeps only the victim policy; the Python thread is the
+            # fallback for non-native transports
 
         self._store = StoreClient(store_socket, shm_name, store_capacity)
         self._listener, self.socket_path = listener_addr(socket_path)
@@ -329,10 +334,16 @@ class Scheduler:
             self._accept_thread = threading.Thread(
                 target=self._native_serve_loop, name="sched-serve",
                 daemon=True)
+            if self._memory_monitor is not None:
+                self._set_native_memory_monitor(
+                    self._mm_threshold, self._memory_monitor._interval,
+                    self._memory_monitor._cooldown)
         else:
             self._accept_thread = threading.Thread(
                 target=self._accept_loop, name="sched-accept", daemon=True
             )
+            if self._memory_monitor is not None:
+                self._memory_monitor.start()
         # Eager cluster view: submit() consults _cluster_nodes (native-
         # lane feasibility) before the first heartbeat tick — a joining
         # driver node must see its peers immediately or a locally-
@@ -1282,6 +1293,11 @@ class Scheduler:
                         self.note_sealed(oid)
                 elif frame == b"\x7f":  # infeasible tasks to fail
                     self._fail_native_infeasible()
+                elif frame[:1] == b"\x7e" and len(frame) >= 17:
+                    # native memory monitor crossing: C++ sampled and
+                    # rate-limited; Python owns victim policy + kill
+                    used, total = struct.unpack("<QQ", frame[1:17])
+                    self._on_native_memory_pressure(used, total)
                 continue
             if not frame:  # disconnect marker
                 ctx = ctxs.pop(conn_id, None)
@@ -2127,6 +2143,29 @@ class Scheduler:
             # ACTOR_METHOD: worker stays bound to the actor; nothing to release.
             self._wake.notify_all()
         self._notify_origin(spec)
+
+    def _on_native_memory_pressure(self, used: int, total: int):
+        """0x7e marker from the C++ monitor: run the kill policy (the
+        native side already applied interval + cooldown gating).  A
+        straggler marker emitted before a disable is dropped, and a
+        crossing that found no victim clears the native cooldown so the
+        next interval can respond while memory keeps climbing."""
+        if not getattr(self, "_mm_native_enabled", False):
+            return  # marker raced a disable: never kill on stale signal
+        try:
+            killed = self._handle_memory_pressure(
+                used, total, self._mm_threshold)
+            self._node_srv.memory_monitor_ack(bool(killed))
+        except Exception:
+            traceback.print_exc()  # pressure handling must not kill serve
+
+    def _set_native_memory_monitor(self, threshold: float,
+                                   interval_s: float, cooldown_s: float):
+        """(En/dis)able the C++ monitor; the enabled flag gates marker
+        handling so a straggler emitted pre-disable is dropped."""
+        self._mm_native_enabled = threshold > 0
+        self._node_srv.memory_monitor_enable(threshold, interval_s,
+                                             cooldown_s)
 
     def _handle_memory_pressure(self, used: int, total: int,
                                 threshold: float) -> bool:

@@ -45,6 +45,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <deque>
 #include <map>
 #include <mutex>
@@ -545,6 +546,83 @@ struct ServerCore {
   std::deque<std::pair<uint64_t, std::string>> out_queue;
   std::mutex dummy_send_mu;  // sends are single-threaded; kept for helpers
   RayletCore* raylet = nullptr;
+  // Native memory monitor (reference: src/ray/common/memory_monitor.h —
+  // a C++ timer sampling cgroup/meminfo usage).  Sampling + threshold
+  // detection run here in the epoll loop (no GIL, no Python thread); on
+  // a crossing, a 0x7e marker frame wakes Python, which owns the victim
+  // policy and the kill (our C++/Python split everywhere).
+  // atomics: enable/ack are called from Python threads while the serve
+  // thread reads these GIL-free inside Server_next
+  std::atomic<double> mm_threshold{0};  // 0 = disabled
+  std::atomic<double> mm_interval_s{1.0};
+  std::atomic<double> mm_cooldown_s{5.0};
+  std::atomic<double> mm_next_check{0};
+  std::atomic<double> mm_last_fire{0};
+
+  static bool node_mem_usage(uint64_t* used, uint64_t* total) {
+    // cgroup v2 first (containerized nodes), /proc/meminfo fallback
+    FILE* f = fopen("/sys/fs/cgroup/memory.max", "r");
+    if (f) {
+      char buf[64] = {0};
+      bool have = fgets(buf, sizeof buf, f) != nullptr;
+      fclose(f);
+      if (have && strncmp(buf, "max", 3) != 0) {
+        uint64_t limit = strtoull(buf, nullptr, 10);
+        FILE* g = fopen("/sys/fs/cgroup/memory.current", "r");
+        if (g && limit > 0) {
+          char cur[64] = {0};
+          bool ok = fgets(cur, sizeof cur, g) != nullptr;
+          fclose(g);
+          if (ok) {
+            *used = strtoull(cur, nullptr, 10);
+            *total = limit;
+            return true;
+          }
+        } else if (g) {
+          fclose(g);
+        }
+      }
+    }
+    f = fopen("/proc/meminfo", "r");
+    if (!f) return false;
+    uint64_t total_kb = 0, avail_kb = 0;
+    char line[256];
+    while (fgets(line, sizeof line, f)) {
+      if (sscanf(line, "MemTotal: %lu kB", &total_kb) == 1) continue;
+      if (sscanf(line, "MemAvailable: %lu kB", &avail_kb) == 1) continue;
+    }
+    fclose(f);
+    if (total_kb == 0) return false;
+    *total = total_kb * 1024;
+    *used = (total_kb > avail_kb ? total_kb - avail_kb : 0) * 1024;
+    return true;
+  }
+
+  static double mono_now() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return double(ts.tv_sec) + double(ts.tv_nsec) * 1e-9;
+  }
+
+  // Serve-thread only: sample on the interval; emit ONE 0x7e marker
+  // (u64 used | u64 total, LE) per crossing, rate-limited by cooldown.
+  void memory_check() {
+    double thr = mm_threshold.load();
+    if (thr <= 0) return;
+    double now = mono_now();
+    if (now < mm_next_check.load()) return;
+    mm_next_check.store(now + mm_interval_s.load());
+    uint64_t used = 0, total = 0;
+    if (!node_mem_usage(&used, &total) || total == 0) return;
+    if (double(used) / double(total) < thr) return;
+    if (now - mm_last_fire.load() < mm_cooldown_s.load()) return;
+    mm_last_fire.store(now);
+    std::string frame(17, '\0');
+    frame[0] = char(0x7e);
+    memcpy(frame.data() + 1, &used, 8);
+    memcpy(frame.data() + 9, &total, 8);
+    ready.emplace_back(0, std::move(frame));
+  }
   std::vector<uint64_t> pending_drops;  // conns to drop after event loop
 
   void drop(uint64_t id) {
@@ -973,6 +1051,33 @@ static PyObject* Server_raylet_snapshot(ServerObject* self, PyObject*) {
     Py_DECREF(val);
   }
   return d;
+}
+
+static PyObject* Server_memory_monitor_enable(ServerObject* self,
+                                              PyObject* args) {
+  // (threshold_fraction, interval_s, cooldown_s); threshold 0 disables.
+  double threshold, interval, cooldown;
+  if (!PyArg_ParseTuple(args, "ddd", &threshold, &interval, &cooldown))
+    return nullptr;
+  ServerCore* c = self->core;
+  c->mm_threshold.store(threshold);
+  c->mm_interval_s.store(interval > 0 ? interval : 1.0);
+  c->mm_cooldown_s.store(cooldown >= 0 ? cooldown : 5.0);
+  c->mm_next_check.store(0);
+  raylet_wake(c);  // re-enter epoll with the capped timeout
+  Py_RETURN_NONE;
+}
+
+static PyObject* Server_memory_monitor_ack(ServerObject* self,
+                                           PyObject* args) {
+  // Python reports the crossing's outcome.  No victim killed -> clear
+  // the cooldown so the next interval can fire again: a no-op crossing
+  // must not suppress pressure response while memory keeps climbing
+  // (Python's check_once only cooled down after a SUCCESSFUL kill).
+  int killed;
+  if (!PyArg_ParseTuple(args, "p", &killed)) return nullptr;
+  if (!killed) self->core->mm_last_fire.store(0);
+  Py_RETURN_NONE;
 }
 
 static PyObject* Server_raylet_debug(ServerObject* self, PyObject*) {
@@ -1464,10 +1569,15 @@ static PyObject* Server_next(ServerObject* self, PyObject* args) {
   uint64_t conn_id = 0;
   std::string frame;
   bool got = false;
+  // absolute caller deadline so monitor ticks never extend a finite wait
+  double deadline = timeout_ms >= 0
+                        ? ServerCore::mono_now() + timeout_ms / 1000.0
+                        : -1.0;
   Py_BEGIN_ALLOW_THREADS
   for (;;) {
     c->flush_replies();  // pool-thread replies drain on THIS thread
     c->raylet_pump();    // dispatch queued plain tasks to idle workers
+    c->memory_check();   // native memory monitor (emits 0x7e markers)
     for (uint64_t did : c->pending_drops) c->drop(did);
     c->pending_drops.clear();
     if (!c->ready.empty()) {
@@ -1478,8 +1588,30 @@ static PyObject* Server_next(ServerObject* self, PyObject* args) {
       break;
     }
     struct epoll_event evs[32];
-    int n = epoll_wait(c->epfd, evs, 32, int(timeout_ms));
-    if (n == 0) break;  // timeout
+    // the memory monitor needs periodic wakeups even when the caller
+    // waits forever: cap the block at the sampling interval and treat
+    // that expiry as a tick, not a caller timeout
+    long eff_ms;
+    if (deadline < 0) {
+      eff_ms = -1;
+    } else {
+      double rem = (deadline - ServerCore::mono_now()) * 1000.0;
+      eff_ms = rem > 0 ? long(rem) + 1 : 0;
+    }
+    bool tick_only = false;
+    if (c->mm_threshold.load() > 0) {
+      long mm_ms = long(c->mm_interval_s.load() * 1000);
+      if (mm_ms < 1) mm_ms = 1;
+      if (eff_ms < 0 || eff_ms > mm_ms) {
+        eff_ms = mm_ms;
+        tick_only = true;
+      }
+    }
+    int n = epoll_wait(c->epfd, evs, 32, int(eff_ms));
+    if (n == 0) {
+      if (tick_only) continue;  // monitor tick, caller budget remains
+      break;  // caller timeout
+    }
     if (n < 0) {
       if (errno == EINTR) continue;
       c->closed = true;
@@ -1582,6 +1714,13 @@ static PyMethodDef Server_methods[] = {
      METH_VARARGS, "raylet_bind_worker(conn_id): register + mark idle"},
     {"raylet_debug", (PyCFunction)Server_raylet_debug, METH_NOARGS,
      "raylet_debug() -> {idle, bound, inflight} introspection"},
+    {"memory_monitor_enable", (PyCFunction)Server_memory_monitor_enable,
+     METH_VARARGS,
+     "memory_monitor_enable(threshold, interval_s, cooldown_s): native "
+     "usage sampling in the epoll loop; 0x7e markers wake Python"},
+    {"memory_monitor_ack", (PyCFunction)Server_memory_monitor_ack,
+     METH_VARARGS,
+     "memory_monitor_ack(killed): no-kill crossings clear the cooldown"},
     {"raylet_acquire_worker", (PyCFunction)Server_raylet_acquire_worker,
      METH_NOARGS, "raylet_acquire_worker() -> conn_id | None"},
     {"raylet_release_worker", (PyCFunction)Server_raylet_release_worker,

@@ -487,3 +487,65 @@ def test_native_transfer_plane_pull_and_push(cluster):
     assert wn.scheduler._store.contains(oid2)
     # pushing again is satisfied by the existing copy (dedup at receiver)
     assert head.scheduler._store.push_remote(oid2, wn_info.xfer_addr)
+
+
+def test_pull_ban_skips_failing_location(cluster):
+    """The pull retry/ban path (reference: pull_manager.cc): a location
+    whose fetch fails is banned for RTPU_PULL_BAN_S and the puller moves
+    to the next replica instead of hammering the broken one."""
+    import time as _t
+
+    import numpy as np
+
+    wn = _add_worker(cluster)
+    head = cluster.head_node
+    data = np.arange(200_000, dtype=np.int64)
+    ref = ray_tpu.put(data)  # sealed on the head
+    oid = ref.binary()
+    # the location publish is batched (seal-flush window): the pull can
+    # only attempt a replica once the directory lists one
+    deadline = time.monotonic() + 10
+    while not head.gcs.get_object_locations(oid):
+        assert time.monotonic() < deadline, "location never published"
+        time.sleep(0.05)
+
+    transfer = wn.scheduler._transfer
+    # break BOTH planes toward the head: pulls must fail, get banned,
+    # then succeed after we heal the native plane
+    orig_pull = wn.scheduler._store.pull_remote
+    orig_fetch = transfer._fetch_from
+    attempts = []
+    wn.scheduler._store.pull_remote = (
+        lambda o, addr: attempts.append(("native", addr)) or False)
+    transfer._fetch_from = (
+        lambda addr, o: attempts.append(("framed", addr)) and False)
+    try:
+        transfer.trigger_pull(oid)
+        deadline = _t.monotonic() + 10
+        while not attempts and _t.monotonic() < deadline:
+            _t.sleep(0.05)
+        assert attempts, "pull never attempted the broken location"
+        _t.sleep(0.3)  # let the pull thread finish banning
+        banned = dict(transfer._banned)
+        assert any(key[1] == oid for key in banned), \
+            f"failing location was not banned: {banned}"
+        n_before = len(attempts)
+        # banned: an immediate re-trigger must NOT re-hit the location
+        transfer.trigger_pull(oid)
+        _t.sleep(0.5)
+        assert len(attempts) == n_before, \
+            "banned location was re-attempted inside the ban window"
+    finally:
+        wn.scheduler._store.pull_remote = orig_pull
+        transfer._fetch_from = orig_fetch
+    # heal + expire the ban: the pull must now succeed
+    transfer._banned.clear()
+    got = None
+    deadline = _t.monotonic() + 30
+    while _t.monotonic() < deadline:
+        transfer.trigger_pull(oid)
+        if wn.scheduler._store.contains(oid):
+            got = True
+            break
+        _t.sleep(0.2)
+    assert got, "pull did not recover after the ban cleared"
