@@ -221,9 +221,19 @@ read_lance = _gated_reader(
 read_hudi = _gated_reader(
     "read_hudi", "hudi",
     "reads file slices from the latest commit timeline")
-read_delta_sharing = _gated_reader(
-    "read_delta_sharing", "delta-sharing",
-    "reads presigned parquet file URLs from the sharing server")
+def read_delta_sharing(url: str, *, limit: Optional[int] = None,
+                       override_num_blocks: Optional[int] = None) -> Dataset:
+    """Rows of a Delta Sharing table snapshot
+    (``<profile-file>#share.schema.table``), spoken over the open REST
+    protocol directly — presigned parquet files decode per read task
+    (data/delta_sharing.py, no `delta-sharing` wheel)."""
+    from ray_tpu.data.delta_sharing import delta_sharing_tasks
+
+    ds = _read("ReadDeltaSharing",
+               delta_sharing_tasks(url, _par(override_num_blocks),
+                                   limit=limit))
+    # limitHint is advisory (servers MAY ignore it): enforce client-side
+    return ds.limit(limit) if limit is not None else ds
 read_databricks_tables = _gated_reader(
     "read_databricks_tables", "databricks-sql-connector",
     "pages results through the Databricks SQL statement API",
@@ -308,6 +318,7 @@ __all__ = [
     "read_binary_files",
     "read_clickhouse",
     "read_iceberg",
+    "read_delta_sharing",
     "read_mongo",
     "read_videos",
     "read_csv",
