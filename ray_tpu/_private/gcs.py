@@ -588,6 +588,27 @@ _GCS_METHODS = frozenset({
 })
 
 
+# Idempotent GCS methods: reads, keyed upserts, and set-adds — safe to
+# re-issue across a head restart.  Deliberately excluded:
+# add_task_events (append: duplicates), broadcast_command (re-delivery),
+# sub_poll (held long-poll: the subscriber loop owns its retry).
+_RETRYABLE_METHODS = frozenset({
+    "kv_get", "kv_keys", "kv_put", "kv_del",
+    "get_actor", "get_actor_by_name", "list_actors", "update_actor",
+    "register_node", "list_nodes", "get_node", "heartbeat",
+    "mark_node_dead", "check_node_health",
+    "add_object_location", "add_object_locations",
+    "remove_object_location", "get_object_locations",
+    "all_object_locations", "object_lost", "clear_object_lost",
+    "register_pg", "get_pg", "remove_pg", "list_pgs",
+    "add_job", "update_job", "get_job", "list_jobs",
+    "add_worker", "update_worker", "list_workers", "list_task_events",
+})
+# ~3s of patience across 4 reconnects: covers a head-daemon restart
+# without hiding a genuinely dead control plane for long
+_RETRY_BACKOFF_S = (0.1, 0.3, 0.8, 1.8)
+
+
 class GcsServer:
     def __init__(self, gcs: Gcs, socket_path: str):
         self.gcs = gcs
@@ -682,25 +703,51 @@ class GcsClient:
         from ray_tpu._private.protocol import chaos_should_fail
 
         req = wire.encode_request(method, args, kwargs)
-        with self._lock:
+        # Retry policy (reference: rpc/retryable_grpc_client.h): methods
+        # in _RETRYABLE are IDEMPOTENT (reads, keyed upserts, set-adds)
+        # and survive a restarting head with reconnect + backoff; the
+        # rest keep strict one-reconnect semantics, bounding (not fully
+        # eliminating — a response lost after the server applied the
+        # request is still resent once, as before) duplication of
+        # non-idempotent calls.  Backoff sleeps run OUTSIDE the client
+        # lock so other threads' calls aren't serialized behind a dead
+        # head's retry window.
+        attempts = (len(_RETRY_BACKOFF_S) + 1
+                    if method in _RETRYABLE_METHODS else 2)
+        data = None
+        for attempt in range(attempts):
+            if attempt > 0:
+                time.sleep(_RETRY_BACKOFF_S[attempt - 1])
             try:
-                if chaos_should_fail(method, "req"):
-                    raise ConnectionResetError(
-                        f"rpc chaos: injected {method} request failure")
-                self._conn.send_frame(req)
-                data = self._conn.recv_frame()
-                if data is not None and chaos_should_fail(method, "resp"):
-                    raise ConnectionResetError(
-                        f"rpc chaos: injected {method} response failure")
+                with self._lock:
+                    if attempt > 0:
+                        old, self._conn = self._conn, self._connect()
+                        try:
+                            old.close()
+                        except OSError:
+                            pass
+                    if chaos_should_fail(method, "req"):
+                        raise ConnectionResetError(
+                            f"rpc chaos: injected {method} request failure")
+                    self._conn.send_frame(req)
+                    data = self._conn.recv_frame()
+                    if data is not None and chaos_should_fail(method,
+                                                              "resp"):
+                        raise ConnectionResetError(
+                            f"rpc chaos: injected {method} response "
+                            f"failure")
+                if data is not None:
+                    break
+            except ConnectionError as e:
+                # a version-mismatch handshake failure is permanent:
+                # surface the actionable message, never backoff past it
+                if "version mismatch" in str(e):
+                    raise
+                data = None
             except OSError:
                 data = None
-            if data is None:
-                # one reconnect attempt (head may have restarted the server)
-                self._conn = self._connect()
-                self._conn.send_frame(req)
-                data = self._conn.recv_frame()
-                if data is None:
-                    raise ConnectionError("GCS connection lost")
+        if data is None:
+            raise ConnectionError("GCS connection lost")
         ok, payload = wire.decode_response(data)
         if not ok:
             raise payload

@@ -6,6 +6,7 @@ failure rate on the framed-protocol layer still completes work through
 retries and worker replacement.
 """
 
+import pytest
 import subprocess
 import sys
 import textwrap
@@ -152,3 +153,42 @@ def test_cluster_survives_scoped_pull_chaos():
                           env=env, cwd="/root/repo")
     assert proc.returncode == 0, proc.stderr[-4000:]
     assert "PULL CHAOS SURVIVED" in proc.stdout
+
+
+def test_gcs_retry_policy_idempotent_vs_not(ray_cluster):
+    """The typed RPC retry layer (reference: retryable_grpc_client):
+    idempotent GCS methods absorb several injected connection failures
+    with reconnect+backoff; non-idempotent ones keep strict
+    one-reconnect semantics so they can never be duplicated."""
+    import ray_tpu.api as api
+    from ray_tpu._private import protocol
+
+    gcs = api._global_node.gcs
+    orig = dict(protocol._CHAOS_METHODS)
+    try:
+        # methods chosen so BACKGROUND control-plane traffic never
+        # consumes the injection budget (heartbeats/event flushes use
+        # other methods): get_job for the retryable side,
+        # broadcast_command for the strict side.
+        gcs.add_job("retry-job", {"submission_id": "retry-job",
+                                  "entrypoint": "true",
+                                  "status": "SUCCEEDED", "message": "",
+                                  "start_time": 1.0, "end_time": 2.0,
+                                  "metadata": {}, "runtime_env": {},
+                                  "log_path": ""})
+        # 3 consecutive failures: beyond one reconnect, within the
+        # retryable budget (4 backoffs)
+        protocol._CHAOS_METHODS.clear()
+        protocol._CHAOS_METHODS["get_job"] = [3, 1.0, 0.0]
+        assert gcs.get_job("retry-job")["status"] == "SUCCEEDED"
+        assert protocol._CHAOS_METHODS["get_job"][0] == 0  # all consumed
+
+        # non-idempotent: broadcast_command gives up after one reconnect
+        protocol._CHAOS_METHODS.clear()
+        protocol._CHAOS_METHODS["broadcast_command"] = [3, 1.0, 0.0]
+        with pytest.raises(ConnectionError):
+            gcs.broadcast_command({"type": "noop"})
+        assert protocol._CHAOS_METHODS["broadcast_command"][0] == 1
+    finally:
+        protocol._CHAOS_METHODS.clear()
+        protocol._CHAOS_METHODS.update(orig)
