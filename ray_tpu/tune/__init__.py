@@ -17,6 +17,7 @@ from ray_tpu.tune.schedulers import (
 from ray_tpu.tune.searchers import (
     AnnealingSearcher,
     BOHBSearcher,
+    GPSearcher,
     OptunaSearch,
     TPESearcher,
 )
@@ -35,6 +36,7 @@ from ray_tpu.tune.tuner import Result, ResultGrid, TuneConfig, Tuner
 __all__ = [
     "AnnealingSearcher",
     "BOHBSearcher",
+    "GPSearcher",
     "OptunaSearch",
     "TPESearcher",
     "ASHAScheduler",

@@ -29,6 +29,29 @@ from ray_tpu.tune.search import (
 )
 
 
+def _quantize(dom: Domain, x: float):
+    """Apply a numeric domain's integer/quantum rounding to x (shared
+    by every adaptive searcher's decode/perturb path)."""
+    if isinstance(dom, RandInt):
+        return int(np.clip(round(x), dom.low, dom.high - 1))
+    if isinstance(dom, QUniform):
+        return round(x / dom.q) * dom.q
+    return x
+
+
+def _record_completion(searcher, trial_id: str, result, error: bool):
+    """Common on_trial_complete bookkeeping: pop the in-flight config,
+    negate scores under mode='min', append to .observed-style storage.
+    Returns (cfg, score) or None when the trial carries no signal."""
+    cfg = searcher._inflight.pop(trial_id, None)
+    if cfg is None or error or not result or searcher.metric not in result:
+        return None
+    score = float(result[searcher.metric])
+    if searcher.mode == "min":
+        score = -score
+    return cfg, score
+
+
 class TPESearcher(Searcher):
     """Tree-structured Parzen Estimator (Bergstra et al., NeurIPS 2011).
 
@@ -79,13 +102,9 @@ class TPESearcher(Searcher):
 
     def on_trial_complete(self, trial_id: str, result: Optional[dict],
                           error: bool = False) -> None:
-        cfg = self._inflight.pop(trial_id, None)
-        if cfg is None or error or not result or self.metric not in result:
-            return
-        score = float(result[self.metric])
-        if self.mode == "min":
-            score = -score
-        self._observed.append((cfg, score))
+        rec = _record_completion(self, trial_id, result, error)
+        if rec is not None:
+            self._observed.append(rec)
 
     # -- TPE internals -----------------------------------------------------
     def _split(self):
@@ -147,11 +166,7 @@ class TPESearcher(Searcher):
         x = float(cands[int(np.argmax(score))])
         if log:
             x = math.exp(x)
-        if isinstance(dom, RandInt):
-            return int(np.clip(round(x), dom.low, dom.high - 1))
-        if isinstance(dom, QUniform):
-            return round(x / dom.q) * dom.q
-        return x
+        return _quantize(dom, x)
 
 
 class OptunaSearch(Searcher):
@@ -259,11 +274,7 @@ class AnnealingSearcher(Searcher):
             x = math.exp(min(max(lx, llo), lhi))
         else:
             return dom.sample(self._rng)
-        if isinstance(dom, RandInt):
-            return int(min(max(round(x), dom.low), dom.high - 1))
-        if isinstance(dom, QUniform):
-            return round(x / dom.q) * dom.q
-        return x
+        return _quantize(dom, x)
 
     # -- Searcher ABC ------------------------------------------------------
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
@@ -345,3 +356,145 @@ class BOHBSearcher(TPESearcher):
                 n_good = max(1, int(math.ceil(self.gamma * len(ranked))))
                 return ranked[:n_good], ranked[n_good:]
         return super()._split()
+
+
+class GPSearcher(Searcher):
+    """Bayesian optimization with a numpy Gaussian process + expected
+    improvement (the reference's `bayesopt` integration role, without
+    the wheel).
+
+    Numeric dimensions are normalized to [0,1] (log-space for
+    LogUniform); an RBF-kernel GP posterior over observed scores scores
+    ``n_candidates`` random probes by EI and suggests the argmax.
+    Categorical dimensions fall back to smoothed best-arm sampling.
+    O(n^3) in observations — intended for the <=few-hundred-trial budgets
+    HPO sweeps actually run.
+    """
+
+    def __init__(self, metric: str, mode: str = "max",
+                 n_initial_points: int = 6, n_candidates: int = 256,
+                 length_scale: float = 0.2, noise: float = 1e-4,
+                 xi: float = 0.01, seed: Optional[int] = None):
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be 'min' or 'max'")
+        self.metric = metric
+        self.mode = mode
+        self.n_initial = n_initial_points
+        self.n_candidates = n_candidates
+        self.length_scale = length_scale
+        self.noise = noise
+        self.xi = xi
+        self._rng = random.Random(seed)
+        self._np_rng = np.random.default_rng(seed)
+        self._space: Dict[str, Any] = {}
+        self._numeric: List[str] = []
+        self._inflight: Dict[str, Dict[str, Any]] = {}
+        self._observed: List[tuple[Dict[str, Any], float]] = []
+
+    def set_search_space(self, param_space: Dict[str, Any]) -> "GPSearcher":
+        for k, v in param_space.items():
+            if isinstance(v, GridSearch):
+                raise ValueError("grid_search belongs to "
+                                 "BasicVariantGenerator")
+            self._space[k] = v
+            if isinstance(v, (Uniform, LogUniform, QUniform, RandInt)):
+                self._numeric.append(k)
+        return self
+
+    # -- unit-cube encoding -------------------------------------------------
+    def _bounds(self, dom):
+        if isinstance(dom, LogUniform):
+            return math.log(dom.low), math.log(dom.high), True
+        return float(dom.low), float(dom.high), False
+
+    def _encode(self, cfg: Dict[str, Any]) -> np.ndarray:
+        xs = []
+        for k in self._numeric:
+            lo, hi, log = self._bounds(self._space[k])
+            v = float(cfg[k])
+            if log:
+                v = math.log(max(v, 1e-300))
+            xs.append((v - lo) / max(hi - lo, 1e-12))
+        return np.asarray(xs)
+
+    def _decode_dim(self, k: str, u: float) -> Any:
+        dom = self._space[k]
+        lo, hi, log = self._bounds(dom)
+        v = lo + u * (hi - lo)
+        if log:
+            v = math.exp(v)
+        return _quantize(dom, v)
+
+    # -- GP posterior + EI --------------------------------------------------
+    def _ei_argmax(self) -> np.ndarray:
+        X = np.stack([self._encode(c) for c, _ in self._observed])
+        y = np.asarray([s for _, s in self._observed])
+        y_mean, y_std = y.mean(), max(y.std(), 1e-9)
+        yn = (y - y_mean) / y_std
+
+        def rbf(a, b):
+            d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+            return np.exp(-0.5 * d2 / self.length_scale ** 2)
+
+        K = rbf(X, X) + self.noise * np.eye(len(X))
+        L = np.linalg.cholesky(K)
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+
+        cand = self._np_rng.random((self.n_candidates, X.shape[1]))
+        Ks = rbf(cand, X)
+        mu = Ks @ alpha
+        v = np.linalg.solve(L, Ks.T)
+        var = np.clip(1.0 - (v ** 2).sum(0), 1e-12, None)
+        sigma = np.sqrt(var)
+        best = yn.max()
+        z = (mu - best - self.xi) / sigma
+        # standard-normal pdf/cdf without scipy
+        pdf = np.exp(-0.5 * z * z) / math.sqrt(2 * math.pi)
+        cdf = 0.5 * (1.0 + np.vectorize(math.erf)(z / math.sqrt(2)))
+        ei = (mu - best - self.xi) * cdf + sigma * pdf
+        return cand[int(np.argmax(ei))]
+
+    # -- Searcher ABC ------------------------------------------------------
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if not self._space:
+            raise RuntimeError("call set_search_space(param_space) first")
+        if len(self._observed) < self.n_initial:
+            cfg = {k: (v.sample(self._rng) if isinstance(v, Domain) else v)
+                   for k, v in self._space.items()}
+        else:
+            # numeric dims via GP+EI; categorical via best-arm — which
+            # also carries a categorical-ONLY space past random search
+            u = self._ei_argmax() if self._numeric else None
+            cfg = {}
+            for i, k in enumerate(self._numeric):
+                cfg[k] = self._decode_dim(k, float(u[i]))
+            for k, v in self._space.items():
+                if k in cfg:
+                    continue
+                if isinstance(v, Choice):
+                    cfg[k] = self._best_arm(k, v.options)
+                elif isinstance(v, Domain):
+                    cfg[k] = v.sample(self._rng)
+                else:
+                    cfg[k] = v
+        self._inflight[trial_id] = cfg
+        return cfg
+
+    def _best_arm(self, name: str, options) -> Any:
+        # smoothed mean score per category; epsilon-greedy pick
+        if self._rng.random() < 0.1:
+            return self._rng.choice(options)
+        sums = {o: 0.0 for o in options}
+        counts = {o: 1.0 for o in options}
+        for cfg, score in self._observed:
+            o = cfg.get(name)
+            if o in sums:
+                sums[o] += score
+                counts[o] += 1
+        return max(options, key=lambda o: sums[o] / counts[o])
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict],
+                          error: bool = False) -> None:
+        rec = _record_completion(self, trial_id, result, error)
+        if rec is not None:
+            self._observed.append(rec)

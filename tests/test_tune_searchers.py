@@ -227,3 +227,49 @@ def test_bohb_with_hyperband_in_tuner(cluster):
     results = tuner.fit()
     best = results.get_best_result(metric="score", mode="max")
     assert best.metrics["score"] > -0.3
+
+
+def test_gp_searcher_converges_on_quadratic():
+    """GP+EI: after the random phase, suggestions concentrate near the
+    optimum and beat a pure-random budget of the same size."""
+    from ray_tpu.tune.search import uniform
+    from ray_tpu.tune.searchers import GPSearcher
+
+    def score(cfg):
+        return -(cfg["x"] - 0.42) ** 2 - 0.5 * (cfg["y"] - 0.1) ** 2
+
+    s = GPSearcher(metric="s", mode="max", n_initial_points=6, seed=0)
+    s.set_search_space({"x": uniform(0.0, 1.0), "y": uniform(0.0, 1.0)})
+    best = -1e9
+    late = []
+    for i in range(40):
+        tid = f"t{i}"
+        cfg = s.suggest(tid)
+        val = score(cfg)
+        best = max(best, val)
+        if i >= 30:
+            late.append(cfg)
+        s.on_trial_complete(tid, {"s": val})
+    assert best > -0.01, best
+    assert sum(abs(c["x"] - 0.42) < 0.2 for c in late) >= len(late) // 2
+
+
+def test_gp_searcher_log_and_int_dims(cluster):
+    from ray_tpu import tune
+
+    def trainable(config):
+        tune.report({"loss": (math.log10(config["lr"]) + 2) ** 2
+                     + 0.01 * abs(config["width"] - 32)})
+
+    searcher = tune.GPSearcher(metric="loss", mode="min",
+                               n_initial_points=4, seed=1)
+    res = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.loguniform(1e-5, 1e0),
+                     "width": tune.randint(8, 65)},
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    num_samples=16,
+                                    search_alg=searcher),
+    ).fit()
+    best = res.get_best_result(metric="loss", mode="min")
+    assert best.metrics["loss"] < 1.0
