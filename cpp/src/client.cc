@@ -793,7 +793,8 @@ std::string pack_store_req(uint8_t op, const std::string& oid20,
                            uint64_t a0, uint64_t a1) {
   std::string req(37, '\0');
   req[0] = char(op);
-  memcpy(&req[1], oid20.data(), 20);
+  // short ids zero-pad, long ids truncate: never read past oid20's end
+  memcpy(&req[1], oid20.data(), oid20.size() < 20 ? oid20.size() : 20);
   memcpy(&req[21], &a0, 8);
   memcpy(&req[29], &a1, 8);
   return req;
@@ -863,6 +864,7 @@ std::string Client::Put(const wire::Value& value) {
 
 std::optional<wire::Value> Client::Get(const std::string& object_id,
                                        int timeout_ms) {
+  if (object_id.size() != 20) return std::nullopt;  // not a valid ObjectRef id
   int fd = store_conn();
   if (fd < 0) return std::nullopt;
   // huge inline cap: every object comes back as bytes (the zero-copy

@@ -382,6 +382,29 @@ class Gcs:
                                     "alive": False})
         return True
 
+    def drop_node_objects(self, node_id: bytes) -> int:
+        """The node's store daemon restarted empty (crash + supervised
+        respawn): drop the node from every object's location set WITHOUT
+        marking the node dead.  Objects whose last copy lived there are
+        tombstoned LOST exactly as in mark_node_dead, so owners re-execute
+        lineage.  Idempotent; returns how many objects lost their last
+        copy."""
+        lost = 0
+        with self._lock:
+            for oid, locs in list(self.object_locations.items()):
+                if node_id not in locs:
+                    continue
+                locs.discard(node_id)
+                if not locs:
+                    del self.object_locations[oid]
+                    if len(self.lost_objects) >= 1_000_000:
+                        self.lost_objects.pop()
+                    self.lost_objects.add(oid)
+                    lost += 1
+                    self._publish("objects", {"ch": "objects", "oid": oid,
+                                              "lost": True})
+        return lost
+
     def check_node_health(self) -> list[bytes]:
         """Mark nodes silent past the timeout dead; returns their ids."""
         now = time.time()
@@ -575,7 +598,8 @@ class Gcs:
 _GCS_METHODS = frozenset({
     "register_actor", "update_actor", "get_actor", "get_actor_by_name",
     "list_actors", "register_node", "list_nodes", "get_node", "heartbeat",
-    "mark_node_dead", "add_object_location", "add_object_locations",
+    "mark_node_dead", "drop_node_objects",
+    "add_object_location", "add_object_locations",
     "remove_object_location",
     "get_object_locations", "all_object_locations",
     "object_lost", "clear_object_lost",
@@ -596,7 +620,7 @@ _RETRYABLE_METHODS = frozenset({
     "kv_get", "kv_keys", "kv_put", "kv_del",
     "get_actor", "get_actor_by_name", "list_actors", "update_actor",
     "register_node", "list_nodes", "get_node", "heartbeat",
-    "mark_node_dead", "check_node_health",
+    "mark_node_dead", "drop_node_objects", "check_node_health",
     "add_object_location", "add_object_locations",
     "remove_object_location", "get_object_locations",
     "all_object_locations", "object_lost", "clear_object_lost",

@@ -14,6 +14,7 @@ from __future__ import annotations
 import glob
 import os
 import sys
+import threading
 import time
 from typing import Optional
 
@@ -96,10 +97,10 @@ class Node:
         self.listen_host = (listen_host
                             if listen_host is not None
                             else os.environ.get("RTPU_LISTEN_HOST") or None)
-        if self.listen_host:
-            from ray_tpu._private import protocol as _protocol
+        from ray_tpu._private import protocol as _protocol
 
-            if gcs_address is not None:
+        if gcs_address is not None:
+            if self.listen_host:
                 # joining node: a token embedded in the address wins, else
                 # RTPU_CLUSTER_TOKEN must already hold the head's token
                 tok, gcs_address = _protocol.split_token_addr(gcs_address)
@@ -112,10 +113,15 @@ class Node:
                         "token: set RTPU_CLUSTER_TOKEN or use a "
                         "token@host:port address")
                 _protocol.ensure_cluster_token()
-            else:
-                # head: generate the cluster token (exported via env so
-                # worker processes and external nodes inherit it)
-                _protocol.ensure_cluster_token()
+            # local (unix-socket) joining nodes adopt the head's token via
+            # the GCS flag sync below — which runs BEFORE the store daemon
+            # spawns, so its transfer plane authenticates against the head
+        else:
+            # head: generate the cluster token even for local unix-socket
+            # clusters (exported via env so worker processes and external
+            # nodes inherit it) — the store daemons' loopback TCP transfer
+            # plane must always be token-authed
+            _protocol.ensure_cluster_token()
         ts = time.strftime("%Y-%m-%d_%H-%M-%S")
         self.session_dir = session_dir or (
             f"/tmp/ray_tpu/session_{ts}_{os.getpid()}_{self.node_id[:3].hex()}"
@@ -135,19 +141,6 @@ class Node:
 
         capacity = object_store_memory or _default_store_capacity()
         shm_name = f"rtpu_{os.getpid()}_{self.node_id[:4].hex()}"
-        self.store_server = StoreServer(
-            socket_path=os.path.join(self.session_dir, "store.sock"),
-            shm_name=shm_name,
-            capacity=capacity,
-            # memory pressure spills sealed objects to disk instead of
-            # dropping them (reference: object spilling, SURVEY §2.1)
-            spill_dir=os.path.join(self.session_dir, "spill"),
-            # daemon-to-daemon transfer plane: TCP clusters bind the
-            # node's interface; local (unix) clusters use loopback so
-            # in-process multi-node tests exercise the native path too
-            xfer_host=self.listen_host or "127.0.0.1",
-            cluster_token=_cluster_token_or_empty(),
-        )
         if self.listen_host:
             sched_socket = f"{self.listen_host}:0"  # kernel-assigned port
         else:
@@ -183,6 +176,22 @@ class Node:
             self.gcs_server = None
             self.gcs_address = gcs_address
         self._sync_cluster_flags()
+        # The store daemon spawns AFTER the GCS flag sync so a joining
+        # node's transfer plane is token-authed with the head's cluster
+        # token (the token rides the propagated flags for local nodes).
+        self.store_server = StoreServer(
+            socket_path=os.path.join(self.session_dir, "store.sock"),
+            shm_name=shm_name,
+            capacity=capacity,
+            # memory pressure spills sealed objects to disk instead of
+            # dropping them (reference: object spilling, SURVEY §2.1)
+            spill_dir=os.path.join(self.session_dir, "spill"),
+            # daemon-to-daemon transfer plane: TCP clusters bind the
+            # node's interface; local (unix) clusters use loopback so
+            # in-process multi-node tests exercise the native path too
+            xfer_host=self.listen_host or "127.0.0.1",
+            cluster_token=_cluster_token_or_empty(),
+        )
         self.scheduler = Scheduler(
             socket_path=sched_socket,
             store_socket=self.store_server.socket_path,
@@ -213,6 +222,14 @@ class Node:
             store_socket=self.store_server.socket_path,
             xfer_addr=xfer_addr,
             labels=self.labels))
+        # Store-daemon supervision (tentpole of the store-plane robustness
+        # work): the daemon is the node's one unsupervised single point of
+        # failure — watch it and turn a crash into a recoverable incident.
+        self._store_sup_stop = threading.Event()
+        self._store_sup = threading.Thread(
+            target=self._supervise_store, name="store-supervisor",
+            daemon=True)
+        self._store_sup.start()
         if head:
             # Job submission lives on the head (reference: JobManager in the
             # dashboard head process, dashboard/modules/job/job_manager.py).
@@ -251,6 +268,64 @@ class Node:
                                     self.dashboard_url.encode())
             except Exception:
                 self.dashboard = None  # aiohttp missing / port exhaustion
+
+    def _supervise_store(self):
+        """Watch the store daemon process; on unexpected exit, recover.
+
+        Recovery order matters: the node's object-directory entries are
+        dropped FIRST (single-copy objects tombstone as LOST, so blocked
+        getters reconstruct via lineage instead of waiting on a store
+        that restarted empty), then the daemon is respawned on the same
+        socket/shm name with a bumped incarnation, the node re-registers
+        its new transfer-plane address, and the incident is recorded in
+        the GCS KV.  Clients ride through the gap via their
+        reconnect-with-backoff (RTPU_STORE_RETRY_S).
+        """
+        while not self._store_sup_stop.wait(0.2):
+            rc = self.store_server.poll()
+            if rc is None:
+                continue
+            if self._store_sup_stop.is_set():
+                return
+            try:
+                self.gcs.drop_node_objects(self.node_id)
+            except Exception:
+                pass  # head gone / restarting; tombstoning is best-effort
+            try:
+                if not self.store_server.restart():
+                    continue
+            except Exception:
+                # respawn failed (fd exhaustion, shm pressure): next tick
+                # retries rather than abandoning the plane
+                time.sleep(1.0)
+                continue
+            xfer_addr = ""
+            if self.store_server.xfer_port:
+                xfer_addr = (f"{self.store_server.xfer_host}:"
+                             f"{self.store_server.xfer_port}")
+            try:
+                # upsert: peers learn the NEW transfer-plane port
+                self.gcs.register_node(NodeInfo(
+                    self.node_id, resources=dict(self.resources),
+                    is_head=self.is_head, sched_socket=self.sched_address,
+                    store_socket=self.store_server.socket_path,
+                    xfer_addr=xfer_addr, labels=self.labels))
+            except Exception:
+                pass
+            try:
+                from ray_tpu._private import wire
+
+                self.gcs.kv_put(
+                    "incidents",
+                    b"store_restart:" + self.node_id.hex().encode(),
+                    wire.encode({
+                        "node_id": self.node_id,
+                        "exit_code": rc,
+                        "incarnation": self.store_server.incarnation,
+                        "ts": time.time(),
+                    }))
+            except Exception:
+                pass
 
     def _sync_cluster_flags(self):
         """Flag propagation (reference: ray.init _system_config serialized
@@ -321,6 +396,12 @@ class Node:
         )
 
     def shutdown(self):
+        # stop supervision FIRST: an intentional store shutdown must not
+        # race a supervised restart
+        sup_stop = getattr(self, "_store_sup_stop", None)
+        if sup_stop is not None:
+            sup_stop.set()
+            self._store_sup.join(timeout=2)
         exporter = getattr(self, "_event_exporter", None)
         if exporter is not None:
             exporter.shutdown()

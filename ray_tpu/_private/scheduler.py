@@ -557,17 +557,22 @@ class Scheduler:
 
     def _flush_gcs_task_events(self):
         """Heartbeat-rate batch push of staged terminal events."""
-        if not self._tev_outbox:
-            return
-        batch, self._tev_outbox = self._tev_outbox, []
-        if self._tev_dropped:
+        # swap + drop-counter harvest under the lock: _queue_gcs_task_event
+        # appends from locked callers, and an unlocked swap could strand a
+        # concurrent append in the already-flushed list (losing the event)
+        # or double-report _tev_dropped.  Only the RPC stays outside.
+        with self._lock:
+            if not self._tev_outbox:
+                return
+            batch, self._tev_outbox = self._tev_outbox, []
+            dropped, self._tev_dropped = self._tev_dropped, 0
+        if dropped:
             batch.append({
                 "task_id": b"", "name": "<dropped>", "kind": "marker",
                 "state": "DROPPED", "node_id": self.node_id,
                 "submitted_ts": 0.0, "start_ts": 0.0,
                 "end_ts": time.time(), "ok": None,
-                "dropped": self._tev_dropped})
-            self._tev_dropped = 0
+                "dropped": dropped})
         try:
             self.gcs.add_task_events(batch)
         except Exception:

@@ -19,6 +19,7 @@ import subprocess
 import threading
 import time
 
+from ray_tpu.exceptions import StoreDiedError
 from ray_tpu.native.build import binary_path
 
 ID_LEN = 20
@@ -48,6 +49,11 @@ _OP_PUT, _OP_GET_INLINE, _OP_PULL, _OP_PUSH = 9, 10, 11, 12
 INLINE_GET_MAX = int(os.environ.get("RTPU_INLINE_GET_MAX", 64 * 1024))
 # per-client daemon connection pool cap
 _POOL_MAX = int(os.environ.get("RTPU_STORE_POOL_MAX", 8))
+# reconnect budget after a dropped daemon connection: the client redials
+# with backoff through a supervised daemon restart (sub-second) and only
+# surfaces StoreDiedError past this, so in-flight puts/gets during a
+# store crash resolve as retryable task failures, not worker crashes
+_RETRY_BUDGET_S = float(os.environ.get("RTPU_STORE_RETRY_S", 15.0))
 
 
 def _native_core():
@@ -75,7 +81,16 @@ class ObjectEvictedError(Exception):
 
 
 class StoreServer:
-    """Owns the store daemon process for a node."""
+    """Owns the store daemon process for a node.
+
+    The daemon is restartable in place: after a crash ``restart()``
+    respawns it on the SAME socket path and shm name with a bumped
+    ``incarnation`` (the daemon itself shm_unlinks + recreates the
+    segment and rebinds the socket at startup, so the identity is
+    stable while the contents start empty — the node supervisor pairs
+    this with dropping the node's object-directory entries so lineage
+    rebuilds what was lost).
+    """
 
     def __init__(self, socket_path: str, shm_name: str, capacity: int,
                  spill_dir: str = "", xfer_host: str = "",
@@ -85,17 +100,23 @@ class StoreServer:
         self.capacity = capacity
         self.spill_dir = spill_dir
         self.xfer_host = xfer_host
+        self._cluster_token = cluster_token
+        # bumped by restart(); lets observers tell apart daemon lifetimes
+        self.incarnation = 0
         # daemon-to-daemon transfer listener port (0 = disabled)
         self.xfer_port = 0
-        args = [binary_path("shm_store"), socket_path, shm_name,
-                str(capacity)]
-        if spill_dir or xfer_host:
-            args.append(spill_dir)
-        if xfer_host:
-            args.append(xfer_host)
+        self._spawn()
+
+    def _spawn(self):
+        args = [binary_path("shm_store"), self.socket_path, self.shm_name,
+                str(self.capacity)]
+        if self.spill_dir or self.xfer_host:
+            args.append(self.spill_dir)
+        if self.xfer_host:
+            args.append(self.xfer_host)
         env = dict(os.environ)
-        if cluster_token:
-            env["RTPU_STORE_TOKEN"] = cluster_token  # env, never argv
+        if self._cluster_token:
+            env["RTPU_STORE_TOKEN"] = self._cluster_token  # env, never argv
         self._proc = subprocess.Popen(
             args,
             stdout=subprocess.PIPE,
@@ -105,11 +126,39 @@ class StoreServer:
         if b"READY" not in line:
             raise RuntimeError(f"shm_store failed to start: {line!r}")
         parts = line.split()
+        self.xfer_port = 0
         if len(parts) > 1:
             try:
                 self.xfer_port = int(parts[1])
             except ValueError:
                 pass
+
+    def poll(self):
+        """Exit code of the daemon process, or None while it is alive."""
+        return self._proc.poll()
+
+    def restart(self) -> bool:
+        """Respawn a dead daemon on the same socket/shm name.
+
+        Returns True when a new incarnation was started (False when the
+        current process is still alive).  Spill files belong to the dead
+        incarnation's in-memory index and are unreadable by the new one,
+        so they are swept first.
+        """
+        if self._proc.poll() is None:
+            return False
+        if self.spill_dir:
+            try:
+                for name in os.listdir(self.spill_dir):
+                    try:
+                        os.unlink(os.path.join(self.spill_dir, name))
+                    except OSError:
+                        pass
+            except OSError:
+                pass
+        self.incarnation += 1
+        self._spawn()
+        return True
 
     def shutdown(self):
         if self._proc.poll() is None:
@@ -140,7 +189,12 @@ class StoreClient:
 
     def __init__(self, socket_path: str, shm_name: str, capacity: int):
         self._socket_path = socket_path
+        self._shm_name = shm_name
+        self._capacity = capacity
         self._client_id = os.urandom(ID_LEN)  # server-side ref bookkeeping key
+        self._closed = False
+        self._mm = None
+        self._mm_key = None  # (st_dev, st_ino) of the mapped segment
         self._pool_lock = threading.Lock()
         # pool entries: (socket, native StoreConn | None).  The native conn
         # runs the per-op pack/send/recv in C with the GIL released
@@ -150,7 +204,9 @@ class StoreClient:
         shm_file = f"/dev/shm/{shm_name.lstrip('/')}"
         fd = os.open(shm_file, os.O_RDWR)
         try:
+            st = os.fstat(fd)
             self._mm = mmap.mmap(fd, capacity)
+            self._mm_key = (st.st_dev, st.st_ino)
         finally:
             os.close(fd)
 
@@ -163,14 +219,90 @@ class StoreClient:
                 sock.sendall(self._client_id)  # handshake
                 break
             except OSError:
+                sock.close()
                 if time.monotonic() > deadline:
                     raise
                 time.sleep(0.05)
+                sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        # a successful connect proves the live daemon's segment exists:
+        # refresh the mapping if a restart replaced it underneath us
+        self._maybe_remap()
         nc = None
         core = _native_core()
         if core is not None:
             nc = core.StoreConn(sock.fileno())
         return sock, nc
+
+    def _flush_pool(self):
+        """Drop every pooled connection (they all point at a daemon that
+        just went away; fresh ops redial)."""
+        with self._pool_lock:
+            entries, self._pool = self._pool, []
+        for sock, _ in entries:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _maybe_remap(self):
+        """After a daemon restart the shm segment is a NEW inode: remap so
+        new views land in the live segment.  Views handed out earlier keep
+        the old mapping alive through their buffer references, so replacing
+        ``self._mm`` never invalidates them."""
+        if self._mm is None:
+            return  # still constructing; __init__ maps explicitly
+        shm_file = f"/dev/shm/{self._shm_name.lstrip('/')}"
+        try:
+            st = os.stat(shm_file)
+        except OSError:
+            return  # segment not recreated yet; the retry loop returns here
+        if (st.st_dev, st.st_ino) == self._mm_key:
+            return
+        try:
+            fd = os.open(shm_file, os.O_RDWR)
+            try:
+                mm = mmap.mmap(fd, self._capacity)
+            finally:
+                os.close(fd)
+        except (OSError, ValueError):
+            return  # racing the daemon's ftruncate; retried next attempt
+        self._mm, self._mm_key = mm, (st.st_dev, st.st_ino)
+
+    def _with_retry(self, attempt, what: str):
+        """Run one store op, transparently redialing through daemon
+        restarts.
+
+        ``attempt(first)`` performs the op on a pooled/fresh connection and
+        raises ConnectionError/OSError on transport failure (both the
+        Python socket path and the native StoreConn do).  On failure every
+        pooled connection is flushed and the op retried with backoff until
+        the RTPU_STORE_RETRY_S budget, after which StoreDiedError
+        surfaces — tasks treat that like a worker crash (retry + lineage)
+        rather than a poisoned worker.
+        """
+        deadline = None
+        delay = 0.05
+        first = True
+        while True:
+            try:
+                return attempt(first)
+            except StoreDiedError:
+                raise
+            except (ConnectionError, OSError) as e:
+                self._flush_pool()
+                if self._closed:
+                    raise
+                now = time.monotonic()
+                if deadline is None:
+                    deadline = now + _RETRY_BUDGET_S
+                elif now >= deadline:
+                    raise StoreDiedError(
+                        f"object store daemon unreachable for {what} "
+                        f"after {_RETRY_BUDGET_S:.1f}s retry budget"
+                    ) from e
+                time.sleep(delay)
+                delay = min(delay * 2, 0.5)
+                first = False
 
     @staticmethod
     def _oid20(oid: bytes) -> bytes:
@@ -201,7 +333,7 @@ class StoreClient:
             buf += chunk
         return buf
 
-    def _call(self, op: int, oid: bytes, arg0: int = 0, arg1: int = 0):
+    def _call_once(self, op: int, oid: bytes, arg0: int = 0, arg1: int = 0):
         entry = self._checkout()
         sock, nc = entry
         try:
@@ -216,9 +348,25 @@ class StoreClient:
         self._checkin(entry)
         return out
 
+    def _call(self, op: int, oid: bytes, arg0: int = 0, arg1: int = 0):
+        return self._with_retry(
+            lambda first: self._call_once(op, oid, arg0, arg1),
+            f"op{op}")
+
     def create(self, oid: bytes, size: int) -> memoryview:
         """Allocate space; returns a writable view. Must seal() after writing."""
-        status, offset, _ = self._call(_OP_CREATE, oid, size)
+        def attempt(first):
+            status, offset, _ = self._call_once(_OP_CREATE, oid, size)
+            if status == ST_EXISTS and not first:
+                # A dropped connection after the daemon applied CREATE
+                # leaves our own unsealed extent behind; reclaim and
+                # re-create.  Abort refuses (ST_ERR) on a genuinely sealed
+                # object, so the re-create still reports EXISTS for those.
+                self._call_once(_OP_ABORT, oid)
+                status, offset, _ = self._call_once(_OP_CREATE, oid, size)
+            return status, offset
+
+        status, offset = self._with_retry(attempt, "create")
         if status == ST_OOM:
             raise StoreFullError(f"object store full allocating {size} bytes")
         if status == ST_EXISTS:
@@ -240,24 +388,32 @@ class StoreClient:
         on a 1-core host."""
         data = bytes(data) if not isinstance(data, (bytes, bytearray,
                                                     memoryview)) else data
-        entry = self._checkout()
-        sock, nc = entry
-        try:
-            if nc is not None:
-                status = nc.put(self._oid20(oid), data)
-            else:
-                req = _REQ.pack(_OP_PUT, oid, len(data), 0)
-                if len(data) <= 65536:
-                    sock.sendall(req + bytes(data))  # one syscall
+
+        def attempt(first):
+            entry = self._checkout()
+            sock, nc = entry
+            try:
+                if nc is not None:
+                    status = nc.put(self._oid20(oid), data)
                 else:
-                    sock.sendall(req)
-                    sock.sendall(data)
-                status, _, _ = _RESP.unpack(
-                    self._recv_exact(sock, _RESP.size))
-        except BaseException:
-            sock.close()
-            raise
-        self._checkin(entry)
+                    req = _REQ.pack(_OP_PUT, oid, len(data), 0)
+                    if len(data) <= 65536:
+                        sock.sendall(req + bytes(data))  # one syscall
+                    else:
+                        sock.sendall(req)
+                        sock.sendall(data)
+                    status, _, _ = _RESP.unpack(
+                        self._recv_exact(sock, _RESP.size))
+            except BaseException:
+                sock.close()
+                raise
+            self._checkin(entry)
+            if status == ST_EXISTS and not first:
+                # the lost reply's PUT committed before the conn dropped
+                status = ST_OK
+            return status
+
+        status = self._with_retry(attempt, "put")
         if status == ST_OOM:
             raise StoreFullError(
                 f"object store full allocating {len(data)} bytes")
@@ -274,19 +430,28 @@ class StoreClient:
         copy-in in parallel, against the daemon's always-warm mapping
         (a fresh client mapping pays a soft page fault per 4KB, which
         dominates large-put cost)."""
-        entry = self._checkout()
-        sock, nc = entry
-        try:
-            # bypass the native conn's single-buffer put: sendall on the
-            # same fd keeps framing; the conn is checked out exclusively
-            sock.sendall(_REQ.pack(_OP_PUT, oid, total, 0))
-            for part in parts:
-                sock.sendall(part)
-            status, _, _ = _RESP.unpack(self._recv_exact(sock, _RESP.size))
-        except BaseException:
-            sock.close()
-            raise
-        self._checkin(entry)
+        parts = list(parts)  # replayable across reconnect retries
+
+        def attempt(first):
+            entry = self._checkout()
+            sock, nc = entry
+            try:
+                # bypass the native conn's single-buffer put: sendall on the
+                # same fd keeps framing; the conn is checked out exclusively
+                sock.sendall(_REQ.pack(_OP_PUT, oid, total, 0))
+                for part in parts:
+                    sock.sendall(part)
+                status, _, _ = _RESP.unpack(
+                    self._recv_exact(sock, _RESP.size))
+            except BaseException:
+                sock.close()
+                raise
+            self._checkin(entry)
+            if status == ST_EXISTS and not first:
+                status = ST_OK  # committed before the conn dropped
+            return status
+
+        status = self._with_retry(attempt, "put")
         if status == ST_OOM:
             raise StoreFullError(
                 f"object store full allocating {total} bytes")
@@ -301,17 +466,21 @@ class StoreClient:
         plane never touches this process (see shm_store.cc transfer
         plane).  Returns (status, size)."""
         payload = addr.encode("utf-8")
-        entry = self._checkout()
-        sock, nc = entry
-        try:
-            sock.sendall(_REQ.pack(op, oid, len(payload), 0) + payload)
-            status, _, size = _RESP.unpack(
-                self._recv_exact(sock, _RESP.size))
-        except BaseException:
-            sock.close()
-            raise
-        self._checkin(entry)
-        return status, size
+
+        def attempt(first):
+            entry = self._checkout()
+            sock, nc = entry
+            try:
+                sock.sendall(_REQ.pack(op, oid, len(payload), 0) + payload)
+                status, _, size = _RESP.unpack(
+                    self._recv_exact(sock, _RESP.size))
+            except BaseException:
+                sock.close()
+                raise
+            self._checkin(entry)
+            return status, size
+
+        return self._with_retry(attempt, "transfer")
 
     def pull_remote(self, oid: bytes, addr: str) -> bool:
         """Pull oid from the peer store daemon at addr into the local
@@ -336,24 +505,28 @@ class StoreClient:
         Returns bytes | memoryview | None.  Callers must only release()
         when the result is a memoryview.
         """
-        entry = self._checkout()
-        sock, nc = entry
-        try:
-            if nc is not None:
-                status, inline, size, data = nc.get_inline(
-                    self._oid20(oid), timeout_ms, INLINE_GET_MAX)
-            else:
-                sock.sendall(
-                    _REQ.pack(_OP_GET_INLINE, oid, timeout_ms,
-                              INLINE_GET_MAX))
-                status, inline, size = _RESP.unpack(
-                    self._recv_exact(sock, _RESP.size))
-                data = (self._recv_exact(sock, size)
-                        if status == ST_OK and inline == 1 else None)
-        except BaseException:
-            sock.close()
-            raise
-        self._checkin(entry)
+        def attempt(first):
+            entry = self._checkout()
+            sock, nc = entry
+            try:
+                if nc is not None:
+                    status, inline, size, data = nc.get_inline(
+                        self._oid20(oid), timeout_ms, INLINE_GET_MAX)
+                else:
+                    sock.sendall(
+                        _REQ.pack(_OP_GET_INLINE, oid, timeout_ms,
+                                  INLINE_GET_MAX))
+                    status, inline, size = _RESP.unpack(
+                        self._recv_exact(sock, _RESP.size))
+                    data = (self._recv_exact(sock, size)
+                            if status == ST_OK and inline == 1 else None)
+            except BaseException:
+                sock.close()
+                raise
+            self._checkin(entry)
+            return status, inline, size, data
+
+        status, inline, size, data = self._with_retry(attempt, "get")
         if status in (ST_NOT_FOUND, ST_NOT_SEALED, ST_TIMEOUT):
             return None
         if status == ST_EVICTED:
@@ -387,9 +560,11 @@ class StoreClient:
     def release(self, oid: bytes):
         # Advisory unpin: zero-copy array views release via GC finalizers,
         # which can outlive the store daemon at interpreter exit — a dead
-        # socket just means there is nothing left to unpin.
+        # socket just means there is nothing left to unpin.  Single
+        # attempt, no reconnect loop: a finalizer must never stall for the
+        # retry budget, and a restarted daemon has no pin to drop anyway.
         try:
-            self._call(_OP_RELEASE, oid)
+            self._call_once(_OP_RELEASE, oid)
         except (OSError, ValueError):
             pass
 
@@ -408,10 +583,5 @@ class StoreClient:
         return {"used_bytes": used, "num_objects": num_objects}
 
     def close(self):
-        with self._pool_lock:
-            entries, self._pool = self._pool, []
-        for sock, _ in entries:
-            try:
-                sock.close()
-            except OSError:
-                pass
+        self._closed = True  # in-flight retries surface instead of spinning
+        self._flush_pool()

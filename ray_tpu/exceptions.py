@@ -43,6 +43,18 @@ class GetTimeoutError(RayTpuError, TimeoutError):
     """``get`` exceeded its timeout."""
 
 
+class StoreDiedError(RayTpuError):
+    """The local shm store daemon stayed unreachable past the reconnect
+    budget (``RTPU_STORE_RETRY_S``).
+
+    ``StoreClient`` transparently redials through daemon restarts (the
+    node supervisor respawns a crashed daemon on the same socket within
+    a second), so this only surfaces when supervision itself is gone —
+    an in-flight task failing with it is retried like any worker crash,
+    and lost objects recover via lineage.
+    """
+
+
 class ObjectLostError(RayTpuError):
     """Object is no longer available (lost with its node, or evicted).
 

@@ -499,22 +499,20 @@ static Value actor_event(const Value& info) {
   return ev;
 }
 
-// Marks a node dead; returns true on alive->dead transition.  Mirrors
-// gcs.py mark_node_dead including the object-location cleanup + LOST
-// tombstones that let owners trigger lineage re-execution.
-static bool do_mark_node_dead(Gcs& g, const std::string& node_id) {
-  auto it = g.nodes.find(node_id);
-  if (it == g.nodes.end()) return false;
-  Value& info = it->second;
-  const Value* alive = info.get("alive");
-  if (!alive || !alive->truthy()) return false;
-  info.set("alive", Value::Bool(false));
+// Drops a node from every object's location set; objects losing their last
+// copy are tombstoned LOST (+ published) so owners re-execute lineage.
+// Shared by mark_node_dead (node died) and drop_node_objects (the node is
+// alive but its store daemon restarted empty under supervision).  Returns
+// how many objects lost their last copy.
+static int64_t do_drop_node_objects(Gcs& g, const std::string& node_id) {
+  int64_t lost = 0;
   for (auto oit = g.obj_locs.begin(); oit != g.obj_locs.end();) {
     oit->second.erase(node_id);
     if (oit->second.empty()) {
       if (g.lost_objects.size() >= 1000000)
         g.lost_objects.erase(g.lost_objects.begin());
       g.lost_objects.insert(oit->first);
+      lost++;
       Value ev = Value::Dict();
       ev.set("ch", Value::Str("objects"));
       ev.set("oid", Value::Bytes(oit->first));
@@ -525,6 +523,20 @@ static bool do_mark_node_dead(Gcs& g, const std::string& node_id) {
       ++oit;
     }
   }
+  return lost;
+}
+
+// Marks a node dead; returns true on alive->dead transition.  Mirrors
+// gcs.py mark_node_dead including the object-location cleanup + LOST
+// tombstones that let owners trigger lineage re-execution.
+static bool do_mark_node_dead(Gcs& g, const std::string& node_id) {
+  auto it = g.nodes.find(node_id);
+  if (it == g.nodes.end()) return false;
+  Value& info = it->second;
+  const Value* alive = info.get("alive");
+  if (!alive || !alive->truthy()) return false;
+  info.set("alive", Value::Bool(false));
+  do_drop_node_objects(g, node_id);
   Value ev = Value::Dict();
   ev.set("ch", Value::Str("nodes"));
   ev.set("node_id", Value::Bytes(node_id));
@@ -697,6 +709,8 @@ static std::string dispatch(Gcs& g, const wire::Request& req,
       }
     } else if (m == "mark_node_dead") {
       r = Value::Bool(do_mark_node_dead(g, arg_bytes(req, 0, "node_id")));
+    } else if (m == "drop_node_objects") {
+      r = Value::Int(do_drop_node_objects(g, arg_bytes(req, 0, "node_id")));
     } else if (m == "check_node_health") {
       double now = now_s();
       std::vector<std::string> stale;

@@ -48,6 +48,7 @@
 #include <list>
 #include <mutex>
 #include <condition_variable>
+#include <random>
 #include <thread>
 #include <vector>
 #include <array>
@@ -84,6 +85,12 @@ constexpr uint64_t kAlign = 64;  // cache-line align allocations
 // are still pinned): never a valid segment offset, and FreeListAllocator
 // ignores offsets it does not own.
 constexpr uint64_t kInvalidOffset = ~0ull;
+// OP_PULL/OP_PUSH addr payload ("host:port") sanity cap: anything longer
+// is a corrupt/hostile frame, answered ST_ERR instead of allocated
+// (an unbounded client-supplied length here was a one-frame daemon kill:
+// std::string(arg0, '\0') -> bad_alloc -> std::terminate in a detached
+// thread).
+constexpr uint64_t kMaxAddrLen = 512;
 
 using ObjectId = std::array<uint8_t, kIdLen>;
 
@@ -190,6 +197,8 @@ class Store {
   // protocol change: a Get on a spilled object transparently restores it.
   Store(uint64_t capacity, uint8_t* base, std::string spill_dir)
       : alloc_(capacity), base_(base), spill_dir_(std::move(spill_dir)) {}
+
+  uint64_t Capacity() const { return alloc_.capacity(); }
 
   uint8_t Create(const ObjectId& id, uint64_t size, uint64_t* offset) {
     std::unique_lock<std::mutex> lk(mu_);
@@ -733,8 +742,56 @@ void TransferListener(Store* store, uint8_t* base, int srv_fd) {
       if (errno != EINTR) usleep(10'000);
       continue;
     }
-    std::thread(ServeTransferPeer, store, base, fd).detach();
+    // an escaped exception in a detached thread is std::terminate for the
+    // whole daemon — contain per-connection failures to their connection
+    std::thread([store, base, fd] {
+      try {
+        ServeTransferPeer(store, base, fd);
+      } catch (...) {
+        close(fd);
+      }
+    }).detach();
   }
+}
+
+// ---- store chaos (testing) -------------------------------------------------
+// RTPU_TESTING_STORE_FAILURE="<drop%>:<kill%>": before serving each client
+// request the daemon rolls once; drop% closes the offending connection (the
+// client sees a reset mid-op and must reconnect-retry), kill% _exit(1)s the
+// whole daemon (the node supervisor must restart it and lineage must rebuild
+// the lost contents).  Mirrors the RPC chaos flag in _private/protocol.py.
+int g_chaos_drop_pct = 0;
+int g_chaos_kill_pct = 0;
+std::mutex g_chaos_mu;
+std::mt19937 g_chaos_rng;
+
+void InitChaos() {
+  const char* spec = getenv("RTPU_TESTING_STORE_FAILURE");
+  if (!spec || !*spec) return;
+  int drop = 0, kill_pct = 0;
+  if (sscanf(spec, "%d:%d", &drop, &kill_pct) < 1) return;
+  g_chaos_drop_pct = drop < 0 ? 0 : drop;
+  g_chaos_kill_pct = kill_pct < 0 ? 0 : kill_pct;
+  unsigned seed = static_cast<unsigned>(getpid());
+  if (const char* s = getenv("RTPU_TESTING_STORE_SEED"))
+    seed = static_cast<unsigned>(strtoul(s, nullptr, 10));
+  g_chaos_rng.seed(seed);
+}
+
+// 0 = proceed, 1 = drop this connection (may not return at all: kill).
+int ChaosGate() {
+  if (g_chaos_drop_pct == 0 && g_chaos_kill_pct == 0) return 0;
+  int roll;
+  {
+    std::lock_guard<std::mutex> lk(g_chaos_mu);
+    roll = static_cast<int>(g_chaos_rng() % 100);
+  }
+  if (roll < g_chaos_kill_pct) {
+    fprintf(stderr, "[shm_store] chaos: killing daemon\n");
+    _exit(1);
+  }
+  if (roll < g_chaos_kill_pct + g_chaos_drop_pct) return 1;
+  return 0;
 }
 
 // Per-client (not per-connection) ref bookkeeping: a client process may pool
@@ -763,6 +820,7 @@ void ServeClient(Store* store, uint8_t* base, int fd) {
     g_clients[client_id].conns++;
   }
   while (ReadFull(fd, req, kReqLen)) {
+    if (ChaosGate()) break;
     uint8_t op = req[0];
     ObjectId id;
     memcpy(id.data(), req + 1, kIdLen);
@@ -774,6 +832,10 @@ void ServeClient(Store* store, uint8_t* base, int fd) {
     uint64_t r0 = 0, r1 = 0;
     switch (op) {
       case OP_CREATE:
+        if (arg0 > store->Capacity()) {
+          status = ST_OOM;  // can never fit: reject without eviction churn
+          break;
+        }
         status = store->Create(id, arg0, &r0);
         if (status == ST_OK) {
           std::lock_guard<std::mutex> lk(g_clients_mu);
@@ -819,6 +881,15 @@ void ServeClient(Store* store, uint8_t* base, int fd) {
         break;
       case OP_PUT: {
         // create + payload copy + seal in one round trip (arg0 = size)
+        if (arg0 > store->Capacity()) {
+          // can never fit — and draining a hostile multi-GB claimed size
+          // would stall this thread; reply and drop the connection (the
+          // unread payload poisons the framing)
+          uint8_t resp[kRespLen] = {ST_OOM};
+          WriteFull(fd, resp, kRespLen);
+          conn_broken = true;
+          break;
+        }
         status = store->Create(id, arg0, &r0);
         if (status == ST_OK) {
           if (!ReadFull(fd, base + r0, arg0)) {
@@ -840,6 +911,14 @@ void ServeClient(Store* store, uint8_t* base, int fd) {
         // transfer runs in THIS connection's thread — the client checked
         // the conn out of its pool, so control traffic on other conns is
         // never head-of-line-blocked by a large transfer.
+        if (arg0 > kMaxAddrLen) {
+          // corrupt/hostile length: never allocate it (bad_alloc in a
+          // detached thread is std::terminate); answer and drop the conn
+          uint8_t resp[kRespLen] = {ST_ERR};
+          WriteFull(fd, resp, kRespLen);
+          conn_broken = true;
+          break;
+        }
         std::string addr(arg0, '\0');
         if (!ReadFull(fd, addr.data(), arg0)) {
           conn_broken = true;
@@ -930,6 +1009,7 @@ int main(int argc, char** argv) {
     return 2;
   }
   signal(SIGPIPE, SIG_IGN);
+  InitChaos();
   const char* sock_path = argv[1];
   const char* shm_name = argv[2];
   uint64_t capacity = strtoull(argv[3], nullptr, 10);
@@ -1011,8 +1091,15 @@ int main(int argc, char** argv) {
       if (errno != EINTR) usleep(10'000);  // EMFILE: no busy-spin
       continue;
     }
-    std::thread(ServeClient, &store, static_cast<uint8_t*>(base), fd)
-        .detach();
+    std::thread([&store, base, fd] {
+      try {
+        ServeClient(&store, static_cast<uint8_t*>(base), fd);
+      } catch (...) {
+        // never let a per-connection failure std::terminate the daemon;
+        // the client observes a reset and reconnect-retries
+        close(fd);
+      }
+    }).detach();
   }
   return 0;
 }

@@ -1,0 +1,228 @@
+"""Store-daemon crash recovery: supervision, reconnect, chaos, fuzz.
+
+The per-node shm store daemon (ray_tpu/native/shm_store.cc) is now a
+supervised, restartable component rather than a silent single point of
+failure.  Mirrors the reference's plasma-death handling: store death is
+node-object loss feeding lineage reconstruction
+(src/ray/core_worker/object_recovery_manager.h), plus the
+RAY_testing_* chaos-injection idiom on the store plane.
+"""
+
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from ray_tpu.core.store_client import (
+    ST_ERR,
+    ST_OOM,
+    StoreClient,
+    StoreServer,
+)
+from ray_tpu.exceptions import StoreDiedError
+
+_REQ = struct.Struct("<B20sQQ")
+
+
+@pytest.fixture
+def store_pair(tmp_path):
+    srv = StoreServer(
+        str(tmp_path / "store.sock"), f"rtpu_rec_{os.getpid()}", 1 << 22
+    )
+    client = StoreClient(srv.socket_path, srv.shm_name, srv.capacity)
+    yield srv, client
+    client.close()
+    srv.shutdown()
+
+
+def _kill_daemon(srv):
+    os.kill(srv._proc.pid, signal.SIGKILL)
+    deadline = time.monotonic() + 5
+    while srv.poll() is None:
+        assert time.monotonic() < deadline, "daemon ignored SIGKILL"
+        time.sleep(0.02)
+
+
+def test_client_reconnects_across_daemon_restart(store_pair):
+    """A SIGKILLed daemon restarted on the same socket/shm name is
+    transparent to an existing client: ops redial, the new shm segment is
+    remapped, and only the (wiped) contents are lost."""
+    srv, client = store_pair
+    before = os.urandom(20)
+    client.put(before, b"pre-crash")
+    assert bytes(client.get(before, 1000)) == b"pre-crash"
+    client.release(before)
+
+    _kill_daemon(srv)
+    assert srv.restart()
+    assert srv.incarnation == 1
+
+    # contents did not survive (restart wipes the segment): clean miss,
+    # not a hang or a stale read through the old mapping
+    assert client.get(before, 0) is None
+    # ...but the same client keeps working against the new incarnation
+    after = os.urandom(20)
+    client.put(after, b"post-crash")
+    assert bytes(client.get(after, 1000)) == b"post-crash"
+    client.release(after)
+
+
+def test_store_died_error_after_retry_budget(tmp_path, monkeypatch):
+    """With the daemon dead and nobody restarting it, ops surface a typed
+    StoreDiedError once the retry budget runs out — not a bare OSError
+    and not an infinite stall."""
+    from ray_tpu.core import store_client as sc
+
+    srv = StoreServer(
+        str(tmp_path / "store.sock"), f"rtpu_dead_{os.getpid()}", 1 << 22
+    )
+    client = StoreClient(srv.socket_path, srv.shm_name, srv.capacity)
+    try:
+        monkeypatch.setattr(sc, "_RETRY_BUDGET_S", 0.5)
+        _kill_daemon(srv)
+        t0 = time.monotonic()
+        with pytest.raises(StoreDiedError):
+            client.put(os.urandom(20), b"doomed")
+        # budget respected: retried for ~0.5s, gave up well before 5s
+        assert 0.3 <= time.monotonic() - t0 < 5.0
+    finally:
+        client.close()
+        srv.shutdown()
+
+
+def test_store_chaos_flag_drop_and_kill(tmp_path, monkeypatch):
+    """RTPU_TESTING_STORE_FAILURE='<drop%>:<kill%>' makes the daemon drop
+    connections and die at random; with a supervisor restarting it (as
+    Node does), a client hammering puts+gets survives every failure."""
+    monkeypatch.setenv("RTPU_TESTING_STORE_FAILURE", "10:2")
+    monkeypatch.setenv("RTPU_TESTING_STORE_SEED", "42")
+    srv = StoreServer(
+        str(tmp_path / "store.sock"), f"rtpu_ch_{os.getpid()}", 1 << 22
+    )
+    stop = threading.Event()
+    kills = [0]
+
+    def supervise():
+        while not stop.is_set():
+            if srv.poll() is not None:
+                kills[0] += 1
+                srv.restart()
+            time.sleep(0.05)
+
+    sup = threading.Thread(target=supervise, daemon=True)
+    sup.start()
+    client = StoreClient(srv.socket_path, srv.shm_name, srv.capacity)
+    try:
+        for i in range(300):
+            oid = os.urandom(20)
+            client.put(oid, bytes([i % 256]) * 64)
+            got = client.get_bytes(oid, 2000)
+            # a chaos kill between put and get legitimately loses the
+            # object (None); present objects must read back correct
+            assert got is None or got == bytes([i % 256]) * 64, i
+    finally:
+        stop.set()
+        sup.join(timeout=2)
+        client.close()
+        srv.shutdown()
+    # seed 42 at 2% kill over 300 ops reliably kills at least once
+    assert kills[0] >= 1
+    assert srv.incarnation == kills[0]
+
+
+def test_malformed_frames_dont_kill_daemon(tmp_path):
+    """Oversized / garbage / truncated frames get ST_ERR or a dropped
+    connection — never a daemon death (the old unbounded
+    std::string(arg0) alloc was a one-frame remote kill)."""
+    srv = StoreServer(
+        str(tmp_path / "store.sock"), f"rtpu_fz_{os.getpid()}", 1 << 22
+    )
+
+    def raw_conn():
+        s = socket.socket(socket.AF_UNIX)
+        s.connect(srv.socket_path)
+        s.sendall(os.urandom(20))  # client-id handshake
+        return s
+
+    try:
+        # oversized PULL addr length: the historical std::terminate kill
+        s = raw_conn()
+        s.sendall(_REQ.pack(11, b"x" * 20, 1 << 60, 0))
+        assert s.recv(17)[0] == ST_ERR
+        s.close()
+        # oversized PUT claimed size: refused upfront, conn dropped
+        s = raw_conn()
+        s.sendall(_REQ.pack(9, b"y" * 20, 1 << 61, 0))
+        assert s.recv(17)[0] == ST_OOM
+        s.close()
+        # garbage ops and truncated frames
+        for _ in range(50):
+            s = raw_conn()
+            s.sendall(os.urandom(37))
+            s.close()
+        s = raw_conn()
+        s.sendall(b"\x03short")
+        s.close()
+        time.sleep(0.3)
+        assert srv.poll() is None, "daemon died under fuzz"
+        # and it still serves real clients
+        client = StoreClient(srv.socket_path, srv.shm_name, srv.capacity)
+        oid = os.urandom(20)
+        client.put(oid, b"alive")
+        assert bytes(client.get(oid, 1000)) == b"alive"
+        client.release(oid)
+        client.close()
+    finally:
+        srv.shutdown()
+
+
+def test_cluster_recovers_from_store_daemon_sigkill():
+    """kill -9 the node's store daemon mid-workload: the node supervisor
+    restarts it with a bumped incarnation, lost objects are tombstoned
+    via the GCS, and lineage reconstruction makes every get return the
+    correct value."""
+    script = textwrap.dedent("""
+        import os, signal, time
+        import numpy as np
+        import ray_tpu
+
+        ray_tpu.init(resources={"CPU": 4.0})
+        import ray_tpu.api as api
+        node = api._global_node
+
+        @ray_tpu.remote
+        def produce(tag):
+            return np.full((100_000,), tag, dtype=np.int64)
+
+        refs = [produce.remote(i) for i in range(6)]
+        time.sleep(0.8)
+        os.kill(node.store_server._proc.pid, signal.SIGKILL)
+        refs += [produce.remote(100 + i) for i in range(4)]
+        for i, r in enumerate(refs):
+            tag = i if i < 6 else 100 + (i - 6)
+            arr = ray_tpu.get(r, timeout=90)
+            assert int(arr[0]) == tag and arr.shape == (100_000,), \\
+                (i, arr[0])
+        assert node.store_server.incarnation >= 1
+        print("STORE RECOVERED; incarnation =",
+              node.store_server.incarnation)
+        ray_tpu.shutdown()
+    """)
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "PATH": "/usr/bin:/bin:/usr/local/bin",
+        "PYTHONPATH": ".",
+        "HOME": "/root",
+    }
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=300,
+                          env=env, cwd="/root/repo")
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "STORE RECOVERED" in proc.stdout
