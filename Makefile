@@ -30,4 +30,10 @@ sanitize:
 test:
 	python -m pytest tests/ -q
 
-.PHONY: sanitize test
+# Observability end-to-end: boot a cluster, run a traced nested
+# workload, assert the trace assembles cluster-wide and the dashboard
+# serves valid /metrics + /api/traces payloads.
+obs-smoke:
+	JAX_PLATFORMS=cpu python -m ray_tpu.scripts.obs_smoke
+
+.PHONY: sanitize test obs-smoke

@@ -99,7 +99,10 @@ def pack_call_frame(spec) -> bytes:
     the compact form can't carry (multi-return, device tensors, ...)."""
     simple = (len(spec.return_ids) == 1 and spec.tensor_transport is None
               and spec.method_name is not None
-              and len(spec.method_name) < 65536)
+              and len(spec.method_name) < 65536
+              # the compact frame has no slot for a trace context; traced
+              # calls ride the pickled form so propagation survives
+              and getattr(spec, "trace_id", None) is None)
     if not simple:
         body = pickle.dumps(spec, protocol=5)
         return (bytes([FRAME_CALL_PICKLED, len(spec.task_id)])

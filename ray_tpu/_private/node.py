@@ -24,6 +24,23 @@ from ray_tpu.core.store_client import StoreClient, StoreServer
 
 DEFAULT_STORE_CAPACITY = 1 << 31  # default; see RTPU_STORE_CAPACITY
 
+# Recovery-plane self-instrumentation: restarts performed by
+# _supervise_store (process-wide singleton, created on first restart so
+# idle nodes register nothing).
+_STORE_RESTARTS = None
+
+
+def _store_restart_counter():
+    global _STORE_RESTARTS
+    if _STORE_RESTARTS is None:
+        from ray_tpu.util.metrics import Counter
+
+        _STORE_RESTARTS = Counter(
+            "store_daemon_restarts_total",
+            description="Store daemon crashes recovered in place by the "
+                        "node supervisor")
+    return _STORE_RESTARTS
+
 
 def _cluster_token_or_empty() -> str:
     """This cluster's shared-secret token ("" for tokenless local
@@ -299,6 +316,10 @@ class Node:
                 # retries rather than abandoning the plane
                 time.sleep(1.0)
                 continue
+            try:
+                _store_restart_counter().inc()
+            except Exception:
+                pass  # observability must never block recovery
             xfer_addr = ""
             if self.store_server.xfer_port:
                 xfer_addr = (f"{self.store_server.xfer_host}:"

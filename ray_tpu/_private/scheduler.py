@@ -28,7 +28,7 @@ import struct
 import threading
 import time
 import traceback
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -76,6 +76,34 @@ def _dbg(msg):
             f.write(f"{time.time():.3f} {msg}\n")
     except OSError:
         pass
+
+
+# Runtime self-instrumentation (util/metrics): process-wide singletons so
+# sequential in-process clusters (tests) don't re-register duplicates.
+_SELF_METRICS = None
+
+
+def _self_metrics():
+    global _SELF_METRICS
+    if _SELF_METRICS is None:
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        _SELF_METRICS = {
+            "queue_wait": Histogram(
+                "scheduler_task_queue_wait_s",
+                description="Seconds a task waited in the node scheduler "
+                            "queue between submission and dispatch",
+                boundaries=(0.0005, 0.002, 0.01, 0.05, 0.2, 1, 5, 30)),
+            "queue_depth": Gauge(
+                "scheduler_queue_depth",
+                description="Tasks queued on this node scheduler "
+                            "awaiting dispatch"),
+            "dispatched": Counter(
+                "scheduler_tasks_dispatched_total",
+                description="Tasks dispatched to workers by this node "
+                            "scheduler"),
+        }
+    return _SELF_METRICS
 
 
 class _ConnCtx:
@@ -205,6 +233,11 @@ class Scheduler:
         # worker}.  Bounded: oldest finished events are evicted.
         self._task_events: dict[bytes, dict] = {}
         self._task_events_cap = flags.get("RTPU_TASK_EVENTS_CAP")
+        # Distributed-tracing span store (util/tracing flushes here over
+        # the control socket, "spans_push" — same pattern as metrics_push):
+        # trace_id hex -> list of span dicts, oldest trace evicted.
+        self._trace_spans: "OrderedDict[str, list]" = OrderedDict()
+        self._trace_cap = max(1, int(flags.get("RTPU_TRACE_CAP")))
         # Event-driven pull retries (armed by trigger_pull; drained by the
         # "objects" pubsub watcher thread, started on first use).
         self._wanted_oids: set[bytes] = set()
@@ -639,6 +672,42 @@ class Scheduler:
             self._merge_native_events_locked()
             return [dict(e) for e in self._task_events.values()]
 
+    def _store_spans(self, spans: list[dict]):
+        """Bank trace spans flushed by this node's workers/driver
+        ("spans_push").  Bounded both ways: oldest trace evicted past
+        RTPU_TRACE_CAP, spans-per-trace capped so one runaway trace can't
+        eat the node."""
+        with self._lock:
+            for s in spans:
+                tid = s.get("trace_id")
+                if not isinstance(tid, str) or not tid:
+                    continue
+                s.setdefault("node", self.node_id.hex())
+                buf = self._trace_spans.get(tid)
+                if buf is None:
+                    while len(self._trace_spans) >= self._trace_cap:
+                        self._trace_spans.popitem(last=False)
+                    buf = self._trace_spans[tid] = []
+                if len(buf) < 10_000:
+                    buf.append(s)
+                self._trace_spans.move_to_end(tid)
+
+    def _list_traces(self) -> list[dict]:
+        with self._lock:
+            rows = []
+            for tid, buf in self._trace_spans.items():
+                roots = [s for s in buf if not s.get("parent_id")]
+                rows.append({
+                    "trace_id": tid,
+                    "num_spans": len(buf),
+                    "first_ts": min((s.get("start_ts") or 0.0)
+                                    for s in buf) if buf else 0.0,
+                    "last_ts": max((s.get("end_ts") or 0.0)
+                                   for s in buf) if buf else 0.0,
+                    "root": (roots or buf)[0].get("name") if buf else None,
+                })
+            return rows
+
     def _merge_native_events_locked(self):
         """Fold the native raylet's task-event ring into the Python table
         (lazy: drained on state-API queries, never on the hot path)."""
@@ -671,6 +740,14 @@ class Scheduler:
             ev["state"] = state
             if state == "RUNNING" and ev["start_ts"] is None:
                 ev["start_ts"] = ts
+                # native-lane dispatch happened in C++; the queue-wait
+                # histogram is fed here at ring-merge time instead
+                try:
+                    _self_metrics()["queue_wait"].observe(
+                        max(0.0, ts - ev["submitted_ts"]))
+                    _self_metrics()["dispatched"].inc()
+                except Exception:
+                    pass
             elif state in ("FINISHED", "FAILED"):
                 if ev["end_ts"] is None:
                     self._tev_terminal_order.append(tid)
@@ -1561,6 +1638,15 @@ class Scheduler:
                 self._app_metrics = {}
             self._app_metrics[bytes(params["source"])] = params["metrics"]
             return True
+        if method == "spans_push":
+            # Distributed-tracing spans from workers/driver (util/tracing).
+            self._store_spans(params.get("spans") or [])
+            return True
+        if method == "get_trace_spans":
+            with self._lock:
+                return list(self._trace_spans.get(params["trace_id"], ()))
+        if method == "list_traces":
+            return self._list_traces()
         if method == "node_physical_stats":
             return self.reporter.latest()
         if method == "metrics_snapshot":
@@ -1579,8 +1665,21 @@ class Scheduler:
                 "available": self._res_snapshot(),
                 "resources": dict(self.total_resources),
             }
-            return {"runtime": runtime,
-                    "app": list(sources.values())}
+            app = list(sources.values())
+            # A standalone node process (no driver/worker context in this
+            # process) has nobody flushing ITS registry — the scheduler's
+            # own queue-wait/depth instruments would be invisible.  Include
+            # a local snapshot at scrape time; in-process heads skip this
+            # (the driver's flusher already pushes the shared registry).
+            from ray_tpu._private import worker as worker_mod
+
+            if worker_mod.global_worker_or_none() is None:
+                from ray_tpu.util import metrics as app_metrics
+
+                local = app_metrics.snapshot()
+                if local:
+                    app.append(local)
+            return {"runtime": runtime, "app": app}
         if method == "shutdown_node":
             # `rtpu stop`: only standalone `rtpu start` processes opt in
             # (reference parity: `ray stop` kills only `ray start` nodes,
@@ -1896,6 +1995,10 @@ class Scheduler:
                     except Exception:
                         pass
                 self.gcs.heartbeat(self.node_id, available, queued)
+                try:
+                    _self_metrics()["queue_depth"].set(queued)
+                except Exception:
+                    pass
                 if self.is_head:
                     self.gcs.check_node_health()
                 nodes = {n.node_id: n for n in self.gcs.list_nodes()}
@@ -2673,6 +2776,15 @@ class Scheduler:
 
     def _dispatch(self, w: WorkerState, spec: TaskSpec):
         self._record_task_event(spec, "RUNNING", worker_id=w.worker_id)
+        ev = self._task_events.get(spec.task_id)
+        if ev is not None and ev["start_ts"] and ev["submitted_ts"]:
+            try:
+                m = _self_metrics()
+                m["queue_wait"].observe(
+                    max(0.0, ev["start_ts"] - ev["submitted_ts"]))
+                m["dispatched"].inc()
+            except Exception:
+                pass
         tpus = spec.resources.get("TPU", 0) if spec.resources else 0
         env: dict[str, str] = {}
         n_chips = int(tpus)

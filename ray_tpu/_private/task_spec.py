@@ -67,6 +67,13 @@ class TaskSpec:
     # target ahead of execution (reference: push_manager.cc; the deps the
     # reference carries in its TaskSpec protobuf)
     dependencies: Optional[list] = None
+    # Distributed-tracing context stamped at submission (util.tracing):
+    # rides the pickled spec through every lane — scheduler conn, native
+    # raylet frames, nested submits, direct actor calls — so the worker
+    # can parent its execution span and nested calls under the caller.
+    trace_id: Optional[str] = None
+    parent_span_id: Optional[str] = None
+    trace_submit_ts: float = 0.0
 
 
 def is_plain_task(spec: TaskSpec) -> bool:

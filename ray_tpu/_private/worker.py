@@ -257,6 +257,7 @@ class WorkerContext:
         from ray_tpu._private.direct import _fast_method_spec
         from ray_tpu.core.actor import dumps_args
         from ray_tpu.core.object_ref import ObjectRef as _Ref
+        from ray_tpu.util.tracing import attach_trace
 
         channels = direct._channels
         pending = self._fallback_pending
@@ -274,6 +275,7 @@ class WorkerContext:
             rid = tid + suffix
             spec = _fast_method_spec(tid, rid, actor_id, method_name, blob)
             spec.name = label
+            attach_trace(spec)
             if not chan.call(spec):
                 return None
             return _Ref(rid)

@@ -16,6 +16,7 @@ import argparse
 import os
 import sys
 import threading
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 
@@ -33,6 +34,7 @@ from ray_tpu._private.serialization import store_error_best_effort
 from ray_tpu._private.worker import WorkerContext, set_global_worker
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.store_client import StoreClient
+from ray_tpu.util import tracing
 
 
 class WorkerRuntime:
@@ -243,14 +245,22 @@ class WorkerRuntime:
         return fn
 
     def _resolve_args(self, blob: bytes):
-        args, kwargs = cloudpickle.loads(blob)
-        # Ray semantics: top-level ObjectRef args are resolved to their
-        # values; refs nested inside structures are passed through as refs.
-        args = [self.ctx.get_object(a) if isinstance(a, ObjectRef) else a
-                for a in args]
-        kwargs = {k: self.ctx.get_object(v) if isinstance(v, ObjectRef) else v
-                  for k, v in kwargs.items()}
-        return args, kwargs
+        t0 = time.perf_counter()
+        try:
+            args, kwargs = cloudpickle.loads(blob)
+            # Ray semantics: top-level ObjectRef args are resolved to their
+            # values; refs nested inside structures are passed through as
+            # refs.
+            args = [self.ctx.get_object(a) if isinstance(a, ObjectRef) else a
+                    for a in args]
+            kwargs = {k: self.ctx.get_object(v)
+                      if isinstance(v, ObjectRef) else v
+                      for k, v in kwargs.items()}
+            return args, kwargs
+        finally:
+            # charge deserialization + dependency fetch to the active
+            # task span's arg-fetch bucket (critical-path breakdown)
+            tracing.note_arg_fetch(time.perf_counter() - t0)
 
     def _invoke_method(self, spec: TaskSpec):
         """Resolve args and run one actor method; returns the raw result."""
@@ -274,9 +284,15 @@ class WorkerRuntime:
         packing and actor-lock acquisition."""
         self.ctx.current_task_id = spec.task_id
         self.ctx.current_actor_id = spec.actor_id
+        token = tracing.begin_task_span(spec)
+        ok = True
         try:
             return self._invoke_method(spec)
+        except BaseException:
+            ok = False
+            raise
         finally:
+            tracing.end_task_span(token, ok=ok)
             self.ctx.current_task_id = None
             self.ctx.current_actor_id = None
 
@@ -321,6 +337,9 @@ class WorkerRuntime:
     def execute(self, spec: TaskSpec):
         self.ctx.current_task_id = spec.task_id
         self.ctx.current_actor_id = spec.actor_id
+        # Built-in execution span for traced specs: establishes the trace
+        # context so nested .remote()s parent here; no-op (None) otherwise.
+        token = tracing.begin_task_span(spec)
         ok, error = True, None
         # Runtime env: normal tasks apply/undo around execution; an actor's
         # env (applied at creation) persists for its lifetime — the worker
@@ -338,6 +357,7 @@ class WorkerRuntime:
                                                raised_by_task=True):
                         self._notify_sealed(oid)
                 self._notify_done(spec, ok, error)
+                tracing.end_task_span(token, ok=False)
                 self.ctx.current_task_id = None
                 self.ctx.current_actor_id = None
                 return
@@ -385,6 +405,7 @@ class WorkerRuntime:
                 spec.kind != ACTOR_CREATION or not ok
             ):
                 applied_env.undo()
+            tracing.end_task_span(token, ok=ok)
             self.ctx.current_task_id = None
             self.ctx.current_actor_id = None
         self._notify_done(spec, ok, error)
@@ -431,6 +452,13 @@ def main():
         runtime.run()
     except KeyboardInterrupt:
         pass
+    finally:
+        # stop the background flushers cleanly (final best-effort push)
+        # instead of leaving their loops spinning through interpreter exit
+        from ray_tpu.util import metrics as metrics_mod
+
+        metrics_mod.shutdown_flusher(flush=True)
+        tracing.shutdown_flusher(flush=True)
     sys.exit(0)
 
 

@@ -20,6 +20,7 @@ from ray_tpu._private.worker import global_worker
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu._private.runtime_env import package as package_runtime_env
 from ray_tpu.core.remote_function import resolve_resources, strategy_fields
+from ray_tpu.util import tracing
 
 
 def dumps_args(payload) -> bytes:
@@ -132,6 +133,7 @@ class ActorHandle:
             name=f"{self._class_name}.{method_name}",
             tensor_transport=tensor_transport,
         )
+        tracing.attach_trace(spec)
         # Direct push when available (driver/worker contexts); the client
         # proxy context only has the plain submit path.
         submit_method = getattr(worker, "submit_actor_method", None)
@@ -182,6 +184,7 @@ class ActorClass:
                 opts.get("runtime_env"), worker),
             **strategy_fields(opts),
         )
+        tracing.attach_trace(spec)
         worker.submit(spec)
         return ActorHandle(actor_id, self.__name__)
 

@@ -17,6 +17,7 @@ from ray_tpu._private.runtime_env import package as package_runtime_env
 from ray_tpu._private.task_spec import TASK, TaskSpec
 from ray_tpu._private.worker import global_worker
 from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.util import tracing
 
 def resolve_resources(options: dict, default_num_cpus: float = 1) -> dict:
     res = dict(options.get("resources") or {})
@@ -105,6 +106,7 @@ class RemoteFunction:
             dependencies=dependencies,
             **strategy_fields(options),
         )
+        tracing.attach_trace(spec)
         worker.submit(spec)
         # Owner-side lineage: lost outputs re-execute this spec (client
         # proxy contexts have no lineage store — getattr guard).

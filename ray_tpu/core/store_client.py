@@ -68,6 +68,60 @@ def _native_core():
         return None
 
 
+# Data-plane self-instrumentation (util/metrics): put/get/transfer latency
+# + bytes, and the reconnect counter pairing PR 1's store-recovery plane.
+# Created lazily on first client so importing this module stays side-effect
+# free; process-wide singletons so repeated clients don't re-register.
+_METRICS = None
+_METRICS_LOCK = threading.Lock()
+
+
+def _metrics():
+    global _METRICS
+    if _METRICS is None:
+        with _METRICS_LOCK:
+            if _METRICS is None:
+                from ray_tpu.util.metrics import Counter, Histogram
+
+                lat = (0.00005, 0.0002, 0.001, 0.005, 0.02, 0.1, 0.5, 2.0)
+                _METRICS = {
+                    "put_lat": Histogram(
+                        "store_put_latency_s",
+                        description="Object-store put latency (client-"
+                                    "observed, includes reconnect retries)",
+                        boundaries=lat),
+                    "get_lat": Histogram(
+                        "store_get_latency_s",
+                        description="Object-store get latency (client-"
+                                    "observed, includes seal waits)",
+                        boundaries=lat),
+                    "xfer_lat": Histogram(
+                        "store_transfer_latency_s",
+                        description="Daemon-to-daemon object transfer "
+                                    "latency (OP_PULL/OP_PUSH)",
+                        boundaries=(0.001, 0.005, 0.02, 0.1, 0.5, 2, 10)),
+                    "put_bytes": Counter(
+                        "store_put_bytes_total",
+                        description="Bytes written to the object store by "
+                                    "this process"),
+                    "get_bytes": Counter(
+                        "store_get_bytes_total",
+                        description="Bytes read from the object store by "
+                                    "this process"),
+                    "xfer_bytes": Counter(
+                        "store_transfer_bytes_total",
+                        description="Bytes moved between store daemons on "
+                                    "behalf of this process",
+                        tag_keys=("op",)),
+                    "reconnects": Counter(
+                        "store_client_reconnects_total",
+                        description="Store-client redials after a dropped "
+                                    "daemon connection (daemon crash/"
+                                    "restart recovery)"),
+                }
+    return _METRICS
+
+
 class StoreFullError(Exception):
     pass
 
@@ -290,6 +344,10 @@ class StoreClient:
                 raise
             except (ConnectionError, OSError) as e:
                 self._flush_pool()
+                try:
+                    _metrics()["reconnects"].inc()
+                except Exception:
+                    pass  # metrics must never break recovery (teardown)
                 if self._closed:
                     raise
                 now = time.monotonic()
@@ -413,6 +471,7 @@ class StoreClient:
                 status = ST_OK
             return status
 
+        t0 = time.perf_counter()
         status = self._with_retry(attempt, "put")
         if status == ST_OOM:
             raise StoreFullError(
@@ -421,6 +480,9 @@ class StoreClient:
             raise FileExistsError(f"object {oid.hex()} already exists")
         if status != ST_OK:
             raise RuntimeError(f"put failed: status={status}")
+        m = _metrics()
+        m["put_lat"].observe(time.perf_counter() - t0)
+        m["put_bytes"].inc(len(data))
 
     def put_parts(self, oid: bytes, parts, total: int) -> None:
         """OP_PUT with a vectored payload: the parts stream straight onto
@@ -451,6 +513,7 @@ class StoreClient:
                 status = ST_OK  # committed before the conn dropped
             return status
 
+        t0 = time.perf_counter()
         status = self._with_retry(attempt, "put")
         if status == ST_OOM:
             raise StoreFullError(
@@ -459,6 +522,9 @@ class StoreClient:
             raise FileExistsError(f"object {oid.hex()} already exists")
         if status != ST_OK:
             raise RuntimeError(f"put failed: status={status}")
+        m = _metrics()
+        m["put_lat"].observe(time.perf_counter() - t0)
+        m["put_bytes"].inc(total)
 
     def _transfer_op(self, op: int, oid: bytes, addr: str):
         """OP_PULL / OP_PUSH: ask the local daemon to move oid between its
@@ -480,7 +546,17 @@ class StoreClient:
             self._checkin(entry)
             return status, size
 
-        return self._with_retry(attempt, "transfer")
+        t0 = time.perf_counter()
+        status, size = self._with_retry(attempt, "transfer")
+        try:
+            m = _metrics()
+            m["xfer_lat"].observe(time.perf_counter() - t0)
+            if status == ST_OK:
+                m["xfer_bytes"].inc(size, tags={
+                    "op": "pull" if op == _OP_PULL else "push"})
+        except Exception:
+            pass
+        return status, size
 
     def pull_remote(self, oid: bytes, addr: str) -> bool:
         """Pull oid from the peer store daemon at addr into the local
@@ -526,6 +602,7 @@ class StoreClient:
             self._checkin(entry)
             return status, inline, size, data
 
+        t0 = time.perf_counter()
         status, inline, size, data = self._with_retry(attempt, "get")
         if status in (ST_NOT_FOUND, ST_NOT_SEALED, ST_TIMEOUT):
             return None
@@ -533,10 +610,16 @@ class StoreClient:
             raise ObjectEvictedError(
                 f"object {oid.hex()[:12]} was evicted from the store")
         if status == ST_VIEW:  # pinned view handed back in-round-trip
+            m = _metrics()
+            m["get_lat"].observe(time.perf_counter() - t0)
+            m["get_bytes"].inc(size)
             return memoryview(self._mm)[inline : inline + size]
         if status != ST_OK:
             raise RuntimeError(f"get failed: status={status}")
         if inline:
+            m = _metrics()
+            m["get_lat"].observe(time.perf_counter() - t0)
+            m["get_bytes"].inc(len(data))
             return data
         return self.get(oid, timeout_ms)
 
@@ -547,6 +630,7 @@ class StoreClient:
         the store until the object is sealed or the timeout elapses.  The view
         pins the object (refcount) until ``release``.
         """
+        t0 = time.perf_counter()
         status, offset, size = self._call(_OP_GET, oid, timeout_ms)
         if status in (ST_NOT_FOUND, ST_NOT_SEALED, ST_TIMEOUT):
             return None
@@ -555,6 +639,9 @@ class StoreClient:
                 f"object {oid.hex()[:12]} was evicted from the store")
         if status != ST_OK:
             raise RuntimeError(f"get failed: status={status}")
+        m = _metrics()
+        m["get_lat"].observe(time.perf_counter() - t0)
+        m["get_bytes"].inc(size)
         return memoryview(self._mm)[offset : offset + size]
 
     def release(self, oid: bytes):

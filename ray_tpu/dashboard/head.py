@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import re
 import threading
 from typing import Optional
 
@@ -36,6 +37,7 @@ _INDEX_HTML = """<!doctype html><title>ray_tpu dashboard API</title>
 <li><a href="/api/tasks/summary">/api/tasks/summary</a></li>
 <li><a href="/api/cluster_status">/api/cluster_status</a></li>
 <li><a href="/api/serve">/api/serve</a></li>
+<li><a href="/api/traces">/api/traces (distributed traces; ?trace_id=&lt;hex&gt; for one tree)</a></li>
 <li><a href="/metrics">/metrics (Prometheus)</a></li>
 </ul>"""
 
@@ -82,61 +84,127 @@ def _prom_escape(s: str) -> str:
     return s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
+def _help_escape(s: str) -> str:
+    # HELP text escapes only backslash and line feed (exposition format)
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+_LABEL_BAD = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name: str) -> str:
+    """Sanitize to the exposition-format name charset
+    ([a-zA-Z_:][a-zA-Z0-9_:]*): dots/dashes become underscores."""
+    name = _NAME_BAD.sub("_", str(name))
+    if not name or name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _label_name(name: str) -> str:
+    name = _LABEL_BAD.sub("_", str(name))
+    if not name or name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _label_str(pairs) -> str:
+    if not pairs:
+        return ""
+    return ("{" + ",".join(f'{k}="{_prom_escape(str(v))}"'
+                           for k, v in pairs) + "}")
+
+
 def _render_prometheus(per_node: list[dict]) -> str:
-    lines: list[str] = []
-    # Node runtime gauges.
+    """Valid Prometheus exposition text: one # HELP/# TYPE header per
+    metric family, sanitized names, and same-name series from different
+    processes/nodes MERGED (counters/histograms sum, matching what a
+    single registry would report) — duplicate series are a parse error."""
+    fams: dict[str, dict] = {}
+
+    def fam(name: str, kind: str, help_: str) -> dict:
+        f = fams.get(name)
+        if f is None:
+            f = fams[name] = {"kind": kind, "help": help_,
+                              "series": {}, "hist": {}, "boundaries": None}
+        return f
+
+    def add_series(f: dict, labels: tuple, value):
+        f["series"][labels] = f["series"].get(labels, 0) + value
+
+    _NODE_GAUGES = {
+        "tasks_pending": "Tasks queued on the node scheduler",
+        "workers": "Alive worker processes on the node",
+        "store_used_bytes": "Object store bytes in use on the node",
+        "store_num_objects": "Objects resident in the node's store",
+    }
     for snap in per_node:
         rt = snap["runtime"]
         node = rt["node_id"].hex()[:12]
-        for key in ("tasks_pending", "workers", "store_used_bytes",
-                    "store_num_objects"):
-            lines.append(
-                f'ray_tpu_node_{key}{{node_id="{node}"}} {rt[key]}')
+        for key, help_ in _NODE_GAUGES.items():
+            f = fam(f"ray_tpu_node_{key}", "gauge", help_)
+            # node_id makes these unique per node: set, don't sum
+            f["series"][(("node_id", node),)] = rt[key]
         for res, total in rt["resources"].items():
-            avail = rt["available"].get(res, 0)
-            rname = _prom_escape(str(res))
-            lines.append(
-                f'ray_tpu_resource_total{{node_id="{node}",'
-                f'resource="{rname}"}} {total}')
-            lines.append(
-                f'ray_tpu_resource_available{{node_id="{node}",'
-                f'resource="{rname}"}} {avail}')
+            ft = fam("ray_tpu_resource_total", "gauge",
+                     "Total resource capacity per node")
+            fa = fam("ray_tpu_resource_available", "gauge",
+                     "Currently available resource per node")
+            lbl = (("node_id", node), ("resource", str(res)))
+            ft["series"][lbl] = total
+            fa["series"][lbl] = rt["available"].get(res, 0)
         # App metrics pushed by this node's processes.
         for source in snap["app"]:
             for m in source:
-                name = "ray_tpu_" + m["name"]
-                if m["kind"] == "histogram":
+                name = "ray_tpu_" + _prom_name(m["name"])
+                kind = m.get("kind")
+                if kind not in ("counter", "gauge", "histogram"):
+                    kind = "untyped"
+                f = fam(name, kind, m.get("description") or "")
+                keys = tuple(_label_name(k)
+                             for k in (m.get("tag_keys") or ()))
+                if kind == "histogram":
+                    b = tuple(m.get("boundaries") or ())
+                    if f["boundaries"] is None:
+                        f["boundaries"] = b
+                    elif f["boundaries"] != b:
+                        continue  # conflicting redeclaration: first wins
                     for tagvals, h in m.get("hist", {}).items():
-                        labels = _labels(m["tag_keys"], tagvals)
-                        cum = 0
-                        for b, c in zip(m["boundaries"], h):
-                            cum += c
-                            lines.append(
-                                f'{name}_bucket{{{labels}le="{b}"}} {cum}')
-                        cum += h[len(m["boundaries"])]
-                        lines.append(
-                            f'{name}_bucket{{{labels}le="+Inf"}} {cum}')
-                        lines.append(f"{name}_count{{{labels[:-1]}}} {cum}"
-                                     if labels else f"{name}_count {cum}")
-                        lines.append(
-                            f"{name}_sum{{{labels[:-1]}}} {h[-1]}"
-                            if labels else f"{name}_sum {h[-1]}")
+                        lbl = tuple(zip(keys, tuple(tagvals)))
+                        cur = f["hist"].get(lbl)
+                        if cur is None:
+                            f["hist"][lbl] = list(h)
+                        elif len(cur) == len(h):
+                            for i, c in enumerate(h):
+                                cur[i] += c
                 else:
                     for tagvals, v in m.get("values", {}).items():
-                        labels = _labels(m["tag_keys"], tagvals)
-                        if labels:
-                            lines.append(f"{name}{{{labels[:-1]}}} {v}")
-                        else:
-                            lines.append(f"{name} {v}")
+                        add_series(f, tuple(zip(keys, tuple(tagvals))), v)
+
+    lines: list[str] = []
+    for name, f in fams.items():
+        lines.append(f"# HELP {name} {_help_escape(f['help'])}")
+        lines.append(f"# TYPE {name} {f['kind']}")
+        if f["kind"] == "histogram":
+            bounds = f["boundaries"] or ()
+            for lbl, h in f["hist"].items():
+                cum = 0
+                for b, c in zip(bounds, h):
+                    cum += c
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_label_str(lbl + (('le', b),))} {cum}")
+                cum += h[len(bounds)]
+                lines.append(
+                    f"{name}_bucket{_label_str(lbl + (('le', '+Inf'),))}"
+                    f" {cum}")
+                lines.append(f"{name}_count{_label_str(lbl)} {cum}")
+                lines.append(f"{name}_sum{_label_str(lbl)} {h[-1]}")
+        else:
+            for lbl, v in f["series"].items():
+                lines.append(f"{name}{_label_str(lbl)} {v}")
     return "\n".join(lines) + "\n"
-
-
-def _labels(tag_keys, tagvals) -> str:
-    if not tag_keys:
-        return ""
-    pairs = ",".join(f'{k}="{_prom_escape(v)}"'
-                     for k, v in zip(tag_keys, tagvals))
-    return pairs + ","
 
 
 class DashboardHead:
@@ -313,6 +381,40 @@ class DashboardHead:
                 continue
         return _render_prometheus(snaps)
 
+    def _traces(self, trace_id: Optional[str] = None):
+        """No trace_id: merged per-trace summary rows from every node.
+        With trace_id: the assembled cluster-wide tree + critical path
+        (same shape as ray_tpu.util.state.get_trace)."""
+        from ray_tpu.util import tracing
+
+        if trace_id:
+            spans = []
+            for sock in self._sched_socks():
+                try:
+                    spans.extend(_node_rpc(sock, "get_trace_spans",
+                                           {"trace_id": trace_id}))
+                except Exception:
+                    continue
+            return tracing.assemble_trace(trace_id, spans)
+        rows: dict = {}
+        for sock in self._sched_socks():
+            try:
+                node_rows = _node_rpc(sock, "list_traces")
+            except Exception:
+                continue
+            for r in node_rows:
+                agg = rows.get(r["trace_id"])
+                if agg is None:
+                    rows[r["trace_id"]] = dict(r)
+                else:
+                    agg["num_spans"] += r["num_spans"]
+                    agg["first_ts"] = min(agg["first_ts"], r["first_ts"])
+                    agg["last_ts"] = max(agg["last_ts"], r["last_ts"])
+                    if not agg.get("root"):
+                        agg["root"] = r.get("root")
+        return sorted(rows.values(), key=lambda r: r["last_ts"],
+                      reverse=True)
+
     # -- server ------------------------------------------------------------
     def _run(self):
         from aiohttp import web
@@ -379,6 +481,14 @@ class DashboardHead:
             return web.Response(text=json.dumps(data, default=str),
                                 content_type="application/json")
 
+        async def traces(request):
+            # /api/traces                  -> per-trace summary rows
+            # /api/traces?trace_id=<hex>   -> one assembled span tree
+            tid = request.query.get("trace_id") or None
+            data = await loop.run_in_executor(None, self._traces, tid)
+            return web.Response(text=json.dumps(data, default=str),
+                                content_type="application/json")
+
         app = web.Application()
         app.router.add_get("/api/logs", logs)
         app.router.add_get("/", spa)
@@ -396,6 +506,7 @@ class DashboardHead:
                            json_handler(self._task_summary))
         app.router.add_get("/api/cluster_status",
                            json_handler(self._cluster_status))
+        app.router.add_get("/api/traces", traces)
         app.router.add_get("/metrics", metrics)
 
         async def start():

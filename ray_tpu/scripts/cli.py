@@ -1,4 +1,4 @@
-"""ray_tpu CLI: status / memory / stack / timeline / summary / microbench.
+"""ray_tpu CLI: status / memory / stack / timeline / trace / summary / ....
 
 Counterpart of the reference CLI command registry
 (/root/reference/python/ray/scripts/scripts.py:2665-2691 — status, memory,
@@ -153,6 +153,82 @@ def cmd_timeline(args):
         json.dump(events, f)
     print(f"wrote {len(events)} events to {out} "
           f"(open in chrome://tracing or Perfetto)")
+
+
+def cmd_trace(args):
+    """List distributed traces, or print one trace's cluster-wide span
+    tree + critical-path summary (reference: OpenTelemetry-style tracing;
+    our spans live on each node's scheduler, assembled here)."""
+    from ray_tpu.util import tracing
+
+    sock = find_address(args.address)
+
+    def _fanout(method, params=None):
+        out = []
+        for n in _rpc(sock, "list_nodes"):
+            if not n["alive"]:
+                continue
+            try:
+                out.extend(_rpc(n["sched_socket"], method, params))
+            except Exception:
+                continue
+        return out
+
+    if not args.trace_id:
+        rows: dict = {}
+        for r in _fanout("list_traces"):
+            agg = rows.get(r["trace_id"])
+            if agg is None:
+                rows[r["trace_id"]] = dict(r)
+            else:
+                agg["num_spans"] += r["num_spans"]
+                agg["first_ts"] = min(agg["first_ts"], r["first_ts"])
+                agg["last_ts"] = max(agg["last_ts"], r["last_ts"])
+                if not agg.get("root"):
+                    agg["root"] = r.get("root")
+        print("======== Traces ========")
+        for r in sorted(rows.values(), key=lambda r: r["last_ts"],
+                        reverse=True):
+            age = time.time() - r["last_ts"]
+            print(f"  {r['trace_id']}  spans={r['num_spans']:<5d} "
+                  f"root={r.get('root') or '?':30s} {age:7.1f}s ago")
+        if not rows:
+            print("  (none — submit work under "
+                  "ray_tpu.util.tracing.enable_tracing())")
+        return
+
+    spans = _fanout("get_trace_spans", {"trace_id": args.trace_id})
+    trace = tracing.assemble_trace(args.trace_id, spans)
+    if not trace["spans"]:
+        sys.exit(f"no spans found for trace {args.trace_id}")
+    if args.output:
+        tracing.export_trace_chrome_trace(trace, args.output)
+        print(f"wrote {len(trace['spans'])} spans to {args.output} "
+              f"(open in Perfetto; cross-process flow arrows included)")
+        return
+    print(f"======== Trace {args.trace_id} ========")
+
+    def walk(node, depth):
+        dur = ((node["end_ts"] or 0) - (node["start_ts"] or 0)) * 1e3
+        where = f"{node.get('node', '?')[:8]}/pid{node.get('pid', '?')}"
+        flag = "" if node.get("ok", True) else "  [FAILED]"
+        print(f"  {'  ' * depth}{node['name']:<{max(1, 40 - 2 * depth)}s} "
+              f"{dur:9.2f}ms  {where}{flag}")
+        for c in node.get("children", ()):
+            walk(c, depth + 1)
+
+    for root in trace["tree"]:
+        walk(root, 0)
+    s = trace["summary"]
+    print(f"spans={s['num_spans']} processes={s['num_processes']} "
+          f"wall={s['wall_s'] * 1e3:.2f}ms")
+    print(f"critical path: queue-wait={s['queue_wait_s'] * 1e3:.2f}ms "
+          f"arg-fetch={s['arg_fetch_s'] * 1e3:.2f}ms "
+          f"run={s['run_s'] * 1e3:.2f}ms")
+    for hop in s["critical_path"]:
+        print(f"  -> {hop['name']:<38s} "
+              f"queue={hop['queue_wait_s'] * 1e3:8.2f}ms "
+              f"run={hop['run_s'] * 1e3:8.2f}ms")
 
 
 def cmd_summary(args):
@@ -319,6 +395,14 @@ def main(argv=None):
     sp.add_argument("--address", default=None)
     sp.add_argument("--output", "-o", default=None)
     sp.set_defaults(fn=cmd_timeline)
+    sp = sub.add_parser("trace")
+    sp.add_argument("trace_id", nargs="?", default=None,
+                    help="hex trace id (omit to list known traces)")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--output", "-o", default=None,
+                    help="write the trace as a chrome-trace JSON instead "
+                         "of printing the tree")
+    sp.set_defaults(fn=cmd_trace)
     sp = sub.add_parser("microbenchmark")
     sp.set_defaults(fn=cmd_microbenchmark)
     sp = sub.add_parser("start")

@@ -13,46 +13,85 @@ default tags, per-call overrides.
 from __future__ import annotations
 
 import threading
-import time
 from typing import Dict, List, Optional, Sequence, Tuple
-
-_FLUSH_INTERVAL_S = 2.0
 
 _registry_lock = threading.Lock()
 _registry: List["Metric"] = []
 _flusher_started = False
+_flush_stop = threading.Event()
+_flush_gen = 0
+
+
+def _flush_interval() -> float:
+    # registered flag (RTPU_METRICS_FLUSH_S), not a hardcoded constant
+    from ray_tpu._private import flags
+
+    return max(0.25, float(flags.get("RTPU_METRICS_FLUSH_S")))
 
 
 def _ensure_flusher():
-    global _flusher_started
-    if _flusher_started:
-        return
-    _flusher_started = True
-
-    def flush_loop():
-        from ray_tpu._private import worker as worker_mod
-
-        while True:
-            time.sleep(_FLUSH_INTERVAL_S)
-            try:
-                ctx = worker_mod.global_worker()
-            except Exception:
-                continue  # not initialized (yet/anymore): keep waiting
-            if ctx is None:
-                continue
-            snap = snapshot()
-            if not snap:
-                continue
-            try:
-                ctx.rpc("metrics_push", {
-                    "source": ctx.worker_id or b"driver",
-                    "metrics": snap,
-                })
-            except Exception:
-                pass  # node shutting down; metrics are best-effort
-
-    threading.Thread(target=flush_loop, name="metrics-flush",
+    global _flusher_started, _flush_gen
+    with _registry_lock:
+        if _flusher_started:
+            return
+        _flusher_started = True
+        _flush_gen += 1
+        gen = _flush_gen
+        _flush_stop.clear()
+    threading.Thread(target=_flush_loop, args=(gen,), name="metrics-flush",
                      daemon=True).start()
+
+
+def _flush_loop(gen: int):
+    global _flusher_started
+    while True:
+        stopped = _flush_stop.wait(_flush_interval())
+        with _registry_lock:
+            if gen != _flush_gen:
+                return  # superseded by a newer flusher
+            if stopped:
+                _flusher_started = False
+                return  # clean exit on shutdown_flusher()
+        _flush_once()
+
+
+def _flush_once():
+    from ray_tpu._private import worker as worker_mod
+
+    ctx = worker_mod.global_worker_or_none()
+    if ctx is None:
+        return  # not initialized (yet/anymore)
+    snap = snapshot()
+    if not snap:
+        return
+    try:
+        ctx.rpc("metrics_push", {
+            "source": ctx.worker_id or b"driver",
+            "metrics": snap,
+        })
+    except Exception:
+        pass  # node shutting down; metrics are best-effort
+
+
+def shutdown_flusher(flush: bool = False):
+    """Stop the background flusher so worker/driver shutdown is clean
+    instead of leaving the loop spinning forever; optionally pushing one
+    final snapshot first."""
+    if flush:
+        try:
+            _flush_once()
+        except Exception:
+            pass
+    _flush_stop.set()
+
+
+def resume_flusher():
+    """Restart the flusher after a shutdown when metrics already exist
+    (a fresh ray_tpu.init() in the same process re-uses the registry)."""
+    with _registry_lock:
+        empty = not _registry
+    if not empty:
+        _ensure_flusher()
 
 
 def snapshot() -> List[dict]:

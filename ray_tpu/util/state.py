@@ -181,6 +181,64 @@ def timeline(filename: Optional[str] = None) -> List[dict]:
     return events
 
 
+def _all_trace_spans(trace_id: str) -> List[dict]:
+    """Fan one trace's spans in from every alive node's scheduler (each
+    node only holds spans its own workers/driver flushed)."""
+    spans: List[dict] = []
+    for n in _rpc("list_nodes"):
+        if not n["alive"]:
+            continue
+        try:
+            spans.extend(_node_rpc(n["sched_socket"], "get_trace_spans",
+                                   {"trace_id": trace_id}))
+        except (OSError, RuntimeError):
+            continue
+    return spans
+
+
+def get_trace(trace_id) -> Dict[str, Any]:
+    """Assemble one distributed trace cluster-wide: the span tree across
+    every process it touched plus a critical-path summary (queue-wait vs.
+    arg-fetch vs. run seconds per span).  ``trace_id`` is the hex string
+    from ``Span.trace_id`` (bytes accepted).  Pass the result to
+    ``tracing.export_trace_chrome_trace`` for a Perfetto view with
+    cross-process flow arrows."""
+    from ray_tpu.util import tracing
+
+    if isinstance(trace_id, bytes):
+        trace_id = trace_id.hex()
+    # driver-side spans may still sit in the local buffer: flush first so
+    # the root of a just-finished workload is part of the answer
+    tracing.flush_spans()
+    return tracing.assemble_trace(trace_id, _all_trace_spans(trace_id))
+
+
+def list_traces() -> List[Dict[str, Any]]:
+    """Known traces cluster-wide, most recent last_ts first."""
+    from ray_tpu.util import tracing
+
+    tracing.flush_spans()
+    rows: Dict[str, dict] = {}
+    for n in _rpc("list_nodes"):
+        if not n["alive"]:
+            continue
+        try:
+            node_rows = _node_rpc(n["sched_socket"], "list_traces")
+        except (OSError, RuntimeError):
+            continue
+        for r in node_rows:
+            agg = rows.get(r["trace_id"])
+            if agg is None:
+                rows[r["trace_id"]] = dict(r)
+            else:
+                agg["num_spans"] += r["num_spans"]
+                agg["first_ts"] = min(agg["first_ts"], r["first_ts"])
+                agg["last_ts"] = max(agg["last_ts"], r["last_ts"])
+                if not agg.get("root"):
+                    agg["root"] = r.get("root")
+    return sorted(rows.values(), key=lambda r: r["last_ts"], reverse=True)
+
+
 def list_logs(node_id: Optional[str] = None) -> List[Dict[str, Any]]:
     """Worker log files on one node (reference: ray.util.state.list_logs
     served by the node's dashboard agent; here the node's scheduler plays

@@ -5,6 +5,7 @@ emit app metrics from tasks/actors, scrape the head, assert presence.
 """
 
 import json
+import re
 import time
 import urllib.request
 
@@ -117,3 +118,83 @@ def test_serve_status_endpoint(cluster):
     url = cluster.dashboard_url
     st = json.loads(_get(url + "/api/serve"))
     assert isinstance(st, dict)  # {} / {"error": ...} / app statuses
+
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def test_prometheus_exposition_is_strictly_parseable(cluster):
+    """/metrics must be a valid exposition document — # HELP/# TYPE per
+    family, legal metric/label names, parseable values, and no duplicate
+    series (a real Prometheus scraper hard-fails on any of these)."""
+    import ray_tpu
+
+    # touch the self-instrumentation planes so the runtime histograms
+    # (scheduler queue-wait, store put/get latency) have samples
+    ref = ray_tpu.put(b"x" * 4096)
+    assert ray_tpu.get(ref) == b"x" * 4096
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    ray_tpu.get([noop.remote() for _ in range(3)])
+
+    url = cluster.dashboard_url
+    want = ("ray_tpu_scheduler_task_queue_wait_s_count",
+            "ray_tpu_store_put_latency_s_count",
+            "ray_tpu_store_get_latency_s_count")
+    deadline = time.monotonic() + 20
+    text = ""
+    while time.monotonic() < deadline:
+        text = _get(url + "/metrics")
+        if all(w in text for w in want):
+            break
+        time.sleep(0.5)
+    for w in want:
+        assert w in text, f"{w} missing:\n{text[-2000:]}"
+
+    types: dict = {}
+    seen_series = set()
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            assert parts[1] in ("HELP", "TYPE"), line
+            assert _NAME_RE.match(parts[2]), line
+            if parts[1] == "TYPE":
+                assert parts[2] not in types, f"duplicate TYPE: {line}"
+                assert parts[3].strip() in (
+                    "counter", "gauge", "histogram", "summary",
+                    "untyped"), line
+                types[parts[2]] = parts[3].strip()
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        name, labels, value = m.groups()
+        if value not in ("+Inf", "-Inf", "NaN"):
+            float(value)  # raises on a malformed value
+        if labels:
+            body = labels[1:-1].rstrip(",")
+            matched = _LABEL_RE.findall(body)
+            rebuilt = ",".join(f'{k}="{v}"' for k, v in matched)
+            assert rebuilt == body, f"malformed labels: {line!r}"
+        family = name
+        for suffix in ("_bucket", "_count", "_sum"):
+            if name.endswith(suffix) and name[: -len(suffix)] in types:
+                family = name[: -len(suffix)]
+                break
+        assert family in types, f"sample without # TYPE header: {line!r}"
+        key = (name, labels or "")
+        assert key not in seen_series, f"duplicate series: {line!r}"
+        seen_series.add(key)
+
+    # the acceptance histograms are declared with the right type
+    assert types.get("ray_tpu_scheduler_task_queue_wait_s") == "histogram"
+    assert types.get("ray_tpu_store_put_latency_s") == "histogram"
+    assert types.get("ray_tpu_store_get_latency_s") == "histogram"
+    assert types.get("ray_tpu_node_workers") == "gauge"
