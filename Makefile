@@ -21,7 +21,8 @@ sanitize:
 	RTPU_SANITIZE=1 LD_PRELOAD="$(LIBASAN) $(LIBUBSAN)" \
 	ASAN_OPTIONS=detect_leaks=0:log_path=$(SANDIR)/asan \
 	UBSAN_OPTIONS=print_stacktrace=1:log_path=$(SANDIR)/ubsan \
-	python -m pytest tests/test_store.py tests/test_native_gcs.py \
+	python -m pytest tests/test_store.py tests/test_store_dataplane.py \
+	    tests/test_native_gcs.py \
 	    tests/test_native_raylet.py tests/test_direct_calls.py \
 	    tests/test_dag.py tests/test_spilling.py -q 2>&1 | tee $(SANDIR)/pytest.log
 	@! grep -rq "runtime error\|AddressSanitizer" $(SANDIR) \
@@ -36,4 +37,10 @@ test:
 obs-smoke:
 	JAX_PLATFORMS=cpu python -m ray_tpu.scripts.obs_smoke
 
-.PHONY: sanitize test obs-smoke
+# Object-store data plane in isolation: StoreClient put/get at 1KB/10MB,
+# single and multi client, one JSON line on stdout (BENCH_core.json's
+# full-stack equivalents are the comparison baseline).
+bench-store:
+	JAX_PLATFORMS=cpu python -m ray_tpu._private.store_bench
+
+.PHONY: sanitize test obs-smoke bench-store

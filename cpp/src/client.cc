@@ -828,6 +828,11 @@ int Client::store_conn() {
   return store_fd_;
 }
 
+// Always streams over OP_PUT, whatever the size: the zero-copy
+// create/write/seal tier (store_client.py, >= RTPU_ZCOPY_PUT_MIN) needs
+// the client to map the daemon's shm segment, which this convenience
+// client deliberately skips — interop puts are control-plane traffic,
+// not the bulk data path.
 std::string Client::Put(const wire::Value& value) {
   std::string payload;
   payload.push_back(char(kTagPickle));

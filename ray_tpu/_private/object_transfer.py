@@ -20,7 +20,43 @@ from typing import Callable, Optional
 from ray_tpu._private import protocol
 from ray_tpu._private import flags as flags_mod
 
+# Transfer-plane self-instrumentation (util/metrics): one observation per
+# framed range request, so /metrics shows how striping spreads a pull.
+# Lazy + process-wide for the same reason as store_client._metrics().
+_METRICS = None
+_METRICS_LOCK = threading.Lock()
 
+
+def _metrics():
+    global _METRICS
+    if _METRICS is None:
+        with _METRICS_LOCK:
+            if _METRICS is None:
+                from ray_tpu.util.metrics import Counter, Histogram
+
+                _METRICS = {
+                    "range_bytes": Counter(
+                        "transfer_range_bytes_total",
+                        description="Bytes moved by framed range requests "
+                                    "(striped fallback data plane)",
+                        tag_keys=("dir",)),
+                    "range_lat": Histogram(
+                        "transfer_range_latency_s",
+                        description="Latency of one framed range request "
+                                    "(request sent to chunk received)",
+                        boundaries=(0.0005, 0.002, 0.01, 0.05, 0.2,
+                                    1.0, 5.0)),
+                }
+    return _METRICS
+
+
+def _observe_range(nbytes: int, seconds: float, direction: str):
+    try:
+        m = _metrics()
+        m["range_bytes"].inc(nbytes, tags={"dir": direction})
+        m["range_lat"].observe(seconds)
+    except Exception:
+        pass  # metrics must never break the data plane
 
 
 class _Partial:
@@ -65,6 +101,8 @@ class ObjectTransfer:
         # values reach every node (registry contract, flags.py).
         self._ban_s = flags_mod.get("RTPU_PULL_BAN_S")
         self._fetch_chunk = flags_mod.get("RTPU_FETCH_CHUNK")
+        self._stripes = max(1, min(16,
+                                   flags_mod.get("RTPU_TRANSFER_STRIPES")))
         self._flush_window_s = flags_mod.get("RTPU_SEAL_FLUSH_WINDOW_S")
         self._partial_ttl_s = flags_mod.get("RTPU_PARTIAL_TTL_S")
         # push side (reference: push_manager.cc)
@@ -215,37 +253,135 @@ class ObjectTransfer:
             with self._pull_lock:
                 self._pulls.discard(oid)
 
+    def _fetch_range(self, sched_addr: str, oid: bytes, buf,
+                     offset: int, length: int, failed: threading.Event,
+                     conn=None) -> None:
+        """One stripe: fetch [offset, offset+length) straight into the
+        store extent, self._fetch_chunk per round trip.  Any trouble sets
+        ``failed`` (sibling stripes bail at their next chunk boundary)."""
+        own_conn = conn is None
+        if own_conn:
+            try:
+                conn = protocol.connect_addr(sched_addr)
+            except OSError:
+                failed.set()
+                return
+        try:
+            pos, end = offset, offset + length
+            while pos < end:
+                if failed.is_set():
+                    return  # a sibling stripe already doomed this pull
+                t0 = time.perf_counter()
+                conn.send({"t": "rpc", "method": "fetch_object",
+                           "params": {"oid": oid, "offset": pos,
+                                      "chunk": min(self._fetch_chunk,
+                                                   end - pos)}})
+                resp = conn.recv()
+                if (resp is None or not resp.get("ok")
+                        or not resp["result"]["found"]
+                        or not resp["result"]["data"]):
+                    # vanished / evicted / truncated mid-range: the pull
+                    # must not seal a husk
+                    failed.set()
+                    return
+                data = resp["result"]["data"]
+                buf[pos:pos + len(data)] = data
+                pos += len(data)
+                _observe_range(len(data), time.perf_counter() - t0,
+                               "pull")
+        except OSError:
+            failed.set()
+        finally:
+            if own_conn:
+                conn.close()
+
     def _fetch_from(self, sched_addr: str, oid: bytes) -> bool:
-        """Chunked fetch over a dedicated connection (big transfers must not
-        head-of-line-block control messages)."""
+        """Striped fetch over dedicated connections (big transfers must not
+        head-of-line-block control messages).
+
+        The first response doubles as the size probe; small objects
+        complete on that connection.  Larger ones pre-create the store
+        extent and fan the remainder out over RTPU_TRANSFER_STRIPES range
+        workers, each on its own connection, writing directly into the
+        extent — no whole-object heap staging.  The object seals exactly
+        once, after every range lands; any range failure aborts the
+        create so no half-written husk is ever visible to getters."""
         try:
             conn = protocol.connect_addr(sched_addr)
         except OSError:
             return False
+        buf = None
         try:
-            data = bytearray()
-            size = None
-            while size is None or len(data) < size:
-                conn.send({"t": "rpc", "method": "fetch_object",
-                           "params": {"oid": oid, "offset": len(data),
-                                      "chunk": self._fetch_chunk}})
-                resp = conn.recv()
-                if (resp is None or not resp.get("ok")
-                        or not resp["result"]["found"]):
-                    return False
-                r = resp["result"]
-                size = r["size"]
-                data += r["data"]
-                if size == 0:
-                    break
+            t0 = time.perf_counter()
+            conn.send({"t": "rpc", "method": "fetch_object",
+                       "params": {"oid": oid, "offset": 0,
+                                  "chunk": self._fetch_chunk}})
+            resp = conn.recv()
+            if (resp is None or not resp.get("ok")
+                    or not resp["result"]["found"]):
+                return False
+            r = resp["result"]
+            size, head = r["size"], r["data"]
+            _observe_range(len(head), time.perf_counter() - t0, "pull")
+            if len(head) < size and not head:
+                return False  # non-empty object, empty first chunk: husk
             try:
-                buf = self._store.create(oid, len(data))
-                buf[:len(data)] = bytes(data)
-                self._store.seal(oid)
+                buf = self._store.create(oid, size)
             except FileExistsError:
-                pass  # concurrent pull/local compute won the race
+                # concurrent pull/local compute won the race — but only
+                # claim success once that copy is SEALED (a half-written
+                # transfer that later aborts must not let us advertise a
+                # location we do not hold; mirrors the daemon's
+                # ST_NOT_SEALED answer on the native plane)
+                return self._store.contains(oid)
+            buf[:len(head)] = head
+            if len(head) < size:
+                rest = size - len(head)
+                nstripes = min(self._stripes,
+                               (rest + self._fetch_chunk - 1)
+                               // self._fetch_chunk)
+                per = (rest + nstripes - 1) // nstripes
+                failed = threading.Event()
+                workers = []
+                for i in range(1, nstripes):
+                    off = len(head) + i * per
+                    if off >= size:
+                        break  # per rounded up past the end
+                    th = threading.Thread(
+                        target=self._fetch_range,
+                        args=(sched_addr, oid, buf, off,
+                              min(per, size - off), failed),
+                        name="obj-fetch-range", daemon=True)
+                    th.start()
+                    workers.append(th)
+                # stripe 0 reuses the probe connection on this thread
+                self._fetch_range(sched_addr, oid, buf, len(head), per,
+                                  failed, conn=conn)
+                for th in workers:
+                    th.join()
+                if failed.is_set():
+                    buf.release()
+                    buf = None
+                    try:
+                        self._store.abort(oid)
+                    except Exception:
+                        pass
+                    return False
+            buf.release()
+            buf = None
+            self._store.seal(oid)
             return True
-        except OSError:
+        except Exception:
+            # OSError (peer conn), RuntimeError (seal refused after a
+            # store restart), StoreFullError/StoreDiedError (create):
+            # all end the same way — abort, never seal a husk
+            if buf is not None:
+                buf.release()
+                buf = None
+                try:
+                    self._store.abort(oid)
+                except Exception:
+                    pass
             return False
         finally:
             conn.close()

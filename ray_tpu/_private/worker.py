@@ -33,12 +33,6 @@ _EAGER_DELETE_MIN = int(os.environ.get("RTPU_EAGER_DELETE_MIN", 64 * 1024))
 # store_client.py put); the extra copy is trivial next to the saved
 # daemon round trip.
 _INLINE_PUT_MAX = int(os.environ.get("RTPU_INLINE_PUT_MAX", 64 * 1024))
-# Ceiling for the vectored-socket OP_PUT path: between _INLINE_PUT_MAX and
-# this, the payload streams onto the socket and the daemon copies it in
-# (saves the client-side page faults of a cold mapping); ABOVE it the
-# daemon-side copy-in loses to zero-copy create/write/seal — BENCH_core
-# measured 10MB puts at 770/s vectored vs 1784/s zero-copy.
-_PUT_PARTS_MAX = int(os.environ.get("RTPU_PUT_PARTS_MAX", 1 << 20))
 # how often a blocked get re-requests the cross-node pull
 _PULL_RETRY_S = float(os.environ.get("RTPU_PULL_RETRY_S", 2.0))
 # grace before a blocking wait notifies the scheduler (sub-ms
@@ -406,11 +400,12 @@ class WorkerContext:
             scratch = bytearray(size)
             write_payload(memoryview(scratch), token)
             self.store.put(oid, scratch)
-        elif put_parts is not None and size <= _PUT_PARTS_MAX:
-            # mid-size object: vectored OP_PUT — the raw array view streams
-            # onto the socket with no scratch copy, and the daemon copies
-            # into shm against its warm mapping, in parallel across
-            # clients (client-side mmap writes pay a soft fault per page)
+        elif put_parts is not None:
+            # everything else: hand the raw buffer views to the store
+            # client, which picks the wire — vectored OP_PUT below
+            # RTPU_ZCOPY_PUT_MIN (daemon-side copy-in against its warm
+            # mapping), direct create/write/seal into the pre-faulted
+            # client mapping above it (no payload bytes on the socket)
             put_parts(oid, payload_parts(token), size)
         else:
             buf = self.store.create(oid, size)

@@ -54,6 +54,19 @@ _POOL_MAX = int(os.environ.get("RTPU_STORE_POOL_MAX", 8))
 # surfaces StoreDiedError past this, so in-flight puts/gets during a
 # store crash resolve as retryable task failures, not worker crashes
 _RETRY_BUDGET_S = float(os.environ.get("RTPU_STORE_RETRY_S", 15.0))
+# Puts at or above this bypass OP_PUT's socket stream entirely: create →
+# write straight into the client's shm mapping → seal (plasma's data
+# plane — zero payload bytes on the control socket, no daemon memcpy, so
+# N clients put at memory bandwidth instead of serializing on the
+# daemon's read loop).  Below it the one-round-trip streamed OP_PUT
+# still wins: two round trips dominate a small put's cost.
+ZCOPY_PUT_MIN = int(os.environ.get("RTPU_ZCOPY_PUT_MIN", 256 * 1024))
+# How much of the segment to pre-fault at map/remap time.  Faulting the
+# whole capacity would materialize every page of a mostly-empty segment,
+# so this bounds the cost while covering the allocator's hot prefix —
+# the soft-page-fault bill that used to recur per put is paid once here.
+_PREFAULT_BYTES = int(os.environ.get("RTPU_PREFAULT_BYTES",
+                                     128 * 1024 * 1024))
 
 
 def _native_core():
@@ -118,6 +131,19 @@ def _metrics():
                         description="Store-client redials after a dropped "
                                     "daemon connection (daemon crash/"
                                     "restart recovery)"),
+                    "puts": Counter(
+                        "store_puts_total",
+                        description="Object-store puts by data path "
+                                    "(zcopy = written directly into the "
+                                    "client's shm mapping; streamed = "
+                                    "payload over the daemon socket)",
+                        tag_keys=("path",)),
+                    "prefault_s": Histogram(
+                        "store_prefault_latency_s",
+                        description="Time to pre-fault the client's shm "
+                                    "mapping at connect/remap",
+                        boundaries=(0.0002, 0.001, 0.005, 0.02, 0.1,
+                                    0.5)),
                 }
     return _METRICS
 
@@ -263,6 +289,68 @@ class StoreClient:
             self._mm_key = (st.st_dev, st.st_ino)
         finally:
             os.close(fd)
+        self._prefault(self._mm)
+
+    @staticmethod
+    def _prefault(mm) -> None:
+        """Install PTEs for the mapping's hot prefix once, at map time.
+
+        Without this every zero-copy put/get pays a soft page fault per
+        4KB touched (~2560 for a 10MB object) because a fresh mapping
+        shares pages with the daemon but not page-table entries.
+        MADV_POPULATE_WRITE populates them writable in one syscall
+        without altering page contents (safe against concurrent
+        writers, unlike touching bytes by hand); kernels without it
+        (<5.14) fall back to a read-touch per page, which on tmpfs also
+        leaves the PTE usable for the later write."""
+        n = min(len(mm), _PREFAULT_BYTES)
+        if n <= 0:
+            return
+        t0 = time.perf_counter()
+        populated = False
+        adv = getattr(mmap, "MADV_POPULATE_WRITE", None)
+        if adv is not None:
+            try:
+                mm.madvise(adv, 0, n)
+                populated = True
+            except (OSError, ValueError):
+                pass
+        if not populated:
+            # This interpreter predates the MADV_POPULATE_* constants;
+            # issue the same madvise through libc (value 23 is fixed in
+            # the uapi headers).  Kernels < 5.14 answer EINVAL and we
+            # fall through to the read-touch loop.
+            try:
+                import ctypes
+
+                buf = (ctypes.c_char * len(mm)).from_buffer(mm)
+                try:
+                    libc = ctypes.CDLL(None, use_errno=True)
+                    ret = libc.madvise(
+                        ctypes.c_void_p(ctypes.addressof(buf)),
+                        ctypes.c_size_t(n), 23)  # MADV_POPULATE_WRITE
+                    populated = ret == 0
+                finally:
+                    del buf  # exported pointer would block mm.close()
+            except Exception:
+                pass
+        if not populated:
+            # Read-touch installs the page mappings (cheap) but the
+            # first write per page still pays a dirtying fault.
+            try:
+                import numpy as np
+
+                np.frombuffer(mm, dtype=np.uint8,
+                              count=n)[:: mmap.PAGESIZE].max()
+            except Exception:
+                mv = memoryview(mm)
+                for off in range(0, n, mmap.PAGESIZE):
+                    mv[off]
+                mv.release()
+        try:
+            _metrics()["prefault_s"].observe(time.perf_counter() - t0)
+        except Exception:
+            pass  # metrics must never break connect
 
     def _dial(self, timeout: float = 2.0):
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -320,6 +408,7 @@ class StoreClient:
                 os.close(fd)
         except (OSError, ValueError):
             return  # racing the daemon's ftruncate; retried next attempt
+        self._prefault(mm)  # re-fault: the new segment's pages are cold
         self._mm, self._mm_key = mm, (st.st_dev, st.st_ino)
 
     def _with_retry(self, attempt, what: str):
@@ -438,14 +527,101 @@ class StoreClient:
         if status != ST_OK:
             raise RuntimeError(f"seal failed: status={status}")
 
+    @staticmethod
+    def _byte_parts(parts) -> list:
+        """Normalize payload parts to flat byte views without copying:
+        buffer-protocol objects become ``memoryview(...).cast('B')``
+        (non-contiguous ones pay the unavoidable flattening copy)."""
+        out = []
+        for p in parts:
+            if not isinstance(p, (bytes, bytearray)):
+                try:
+                    p = memoryview(p).cast("B")
+                except TypeError:
+                    p = bytes(p)
+            out.append(p)
+        return out
+
+    def _put_zcopy(self, oid: bytes, parts: list, total: int) -> int:
+        """create → write into the client's own mapping → seal.  No
+        payload bytes on the socket; the two control round trips are
+        noise at these sizes.  Parts must already be flat byte views
+        (``_byte_parts``) so the write loop is pure slice assignment.
+
+        Composes with the restart path: each retry redials (which
+        remaps onto a restarted daemon's fresh segment), so the write
+        always lands in the mapping the CREATE's offset belongs to.  A
+        seal that comes back non-OK after our own successful create
+        means the daemon restarted between the two round trips — the
+        offset belongs to a dead incarnation — so it is re-raised as a
+        transport failure for the retry loop to redo cleanly."""
+        def attempt(first):
+            status, offset, _ = self._call_once(_OP_CREATE, oid, total)
+            if status == ST_EXISTS and not first:
+                # A dropped connection after the daemon applied CREATE
+                # leaves our own unsealed extent behind; reclaim and
+                # re-create.  Abort refuses (ST_ERR) on a genuinely
+                # sealed object, so a second EXISTS means the lost
+                # reply's put actually committed.
+                self._call_once(_OP_ABORT, oid)
+                status, offset, _ = self._call_once(_OP_CREATE, oid,
+                                                    total)
+                if status == ST_EXISTS:
+                    return ST_OK
+            if status != ST_OK:
+                return status
+            try:
+                dst = memoryview(self._mm)
+                pos = offset
+                for p in parts:
+                    n = len(p)
+                    dst[pos : pos + n] = p
+                    pos += n
+                dst.release()
+            except BaseException:
+                try:
+                    self._call_once(_OP_ABORT, oid)
+                except (OSError, ValueError):
+                    pass  # never leave a husk behind a failed write
+                raise
+            status, _, _ = self._call_once(_OP_SEAL, oid)
+            if status != ST_OK:
+                raise ConnectionError(
+                    f"store restarted mid-put (seal status={status})")
+            return ST_OK
+
+        return self._with_retry(attempt, "put")
+
     def put(self, oid: bytes, data) -> None:
-        """Create + write + seal in ONE daemon round trip (OP_PUT): the
-        payload rides the request stream and the daemon writes it into
-        the fresh extent itself.  Two round trips (create, seal) were 83%
-        of a small put's cost — each is a client<->daemon context switch
-        on a 1-core host."""
-        data = bytes(data) if not isinstance(data, (bytes, bytearray,
-                                                    memoryview)) else data
+        """Store ``data`` under ``oid``.
+
+        Large payloads (>= RTPU_ZCOPY_PUT_MIN) take the zero-copy path:
+        create + seal control round trips with the bytes written
+        directly into the shared mapping.  Small ones use OP_PUT — one
+        daemon round trip with the payload on the request stream; two
+        round trips (create, seal) were 83% of a small put's cost, each
+        being a client<->daemon context switch on a 1-core host.
+
+        Buffer-protocol inputs (arrays, views) are never copied up
+        front: they are wrapped as views and sized via nbytes, so the
+        old eager ``bytes(data)`` double-buffer is gone."""
+        if not isinstance(data, (bytes, bytearray, memoryview)):
+            try:
+                data = memoryview(data)
+            except TypeError:
+                data = bytes(data)  # no buffer protocol: must materialize
+        if isinstance(data, memoryview) and (data.itemsize != 1
+                                             or data.ndim != 1):
+            try:
+                data = data.cast("B")
+            except TypeError:
+                data = bytes(data)  # non-contiguous: flattening copy
+        size = len(data)
+        if size >= ZCOPY_PUT_MIN:
+            t0 = time.perf_counter()
+            self._finish_put(self._put_zcopy(oid, [data], size), size,
+                             "zcopy", t0, oid)
+            return
 
         def attempt(first):
             entry = self._checkout()
@@ -473,26 +649,42 @@ class StoreClient:
 
         t0 = time.perf_counter()
         status = self._with_retry(attempt, "put")
+        self._finish_put(status, size, "streamed", t0, oid)
+
+    def _finish_put(self, status: int, total: int, path: str,
+                    t0: float, oid: bytes = b"") -> None:
+        """Shared put epilogue: raise on failure statuses, record the
+        latency/bytes metrics and the per-data-path put counter."""
         if status == ST_OOM:
             raise StoreFullError(
-                f"object store full allocating {len(data)} bytes")
+                f"object store full allocating {total} bytes")
         if status == ST_EXISTS:
             raise FileExistsError(f"object {oid.hex()} already exists")
         if status != ST_OK:
             raise RuntimeError(f"put failed: status={status}")
-        m = _metrics()
-        m["put_lat"].observe(time.perf_counter() - t0)
-        m["put_bytes"].inc(len(data))
+        try:
+            m = _metrics()
+            m["put_lat"].observe(time.perf_counter() - t0)
+            m["put_bytes"].inc(total)
+            m["puts"].inc(tags={"path": path})
+        except Exception:
+            pass  # metrics must never fail a committed put
 
     def put_parts(self, oid: bytes, parts, total: int) -> None:
-        """OP_PUT with a vectored payload: the parts stream straight onto
-        the socket (no client-side scratch assembly), and the daemon's
-        per-connection thread copies them into the fresh extent OUTSIDE
-        the store lock — so concurrent large puts from many clients
-        copy-in in parallel, against the daemon's always-warm mapping
-        (a fresh client mapping pays a soft page fault per 4KB, which
-        dominates large-put cost)."""
-        parts = list(parts)  # replayable across reconnect retries
+        """Vectored put: parts are stored without client-side scratch
+        assembly.
+
+        At or above RTPU_ZCOPY_PUT_MIN the parts are written directly
+        into the client's shm mapping between create and seal (zero
+        payload bytes on the socket).  Below it they stream onto the
+        OP_PUT request and the daemon's per-connection thread copies
+        them into the fresh extent outside the store lock."""
+        parts = self._byte_parts(parts)  # replayable across retries
+        if total >= ZCOPY_PUT_MIN:
+            t0 = time.perf_counter()
+            self._finish_put(self._put_zcopy(oid, parts, total), total,
+                             "zcopy", t0, oid)
+            return
 
         def attempt(first):
             entry = self._checkout()
@@ -515,16 +707,7 @@ class StoreClient:
 
         t0 = time.perf_counter()
         status = self._with_retry(attempt, "put")
-        if status == ST_OOM:
-            raise StoreFullError(
-                f"object store full allocating {total} bytes")
-        if status == ST_EXISTS:
-            raise FileExistsError(f"object {oid.hex()} already exists")
-        if status != ST_OK:
-            raise RuntimeError(f"put failed: status={status}")
-        m = _metrics()
-        m["put_lat"].observe(time.perf_counter() - t0)
-        m["put_bytes"].inc(total)
+        self._finish_put(status, total, "streamed", t0, oid)
 
     def _transfer_op(self, op: int, oid: bytes, addr: str):
         """OP_PULL / OP_PUSH: ask the local daemon to move oid between its

@@ -45,7 +45,9 @@
 #include <map>
 #include <unordered_map>
 #include <unordered_set>
+#include <atomic>
 #include <list>
+#include <memory>
 #include <mutex>
 #include <condition_variable>
 #include <random>
@@ -71,8 +73,12 @@ namespace {
 constexpr uint8_t OP_CREATE = 1, OP_SEAL = 2, OP_GET = 3, OP_RELEASE = 4,
                   OP_DELETE = 5, OP_CONTAINS = 6, OP_STATS = 7, OP_ABORT = 8,
                   OP_PUT = 9, OP_GET_INLINE = 10, OP_PULL = 11, OP_PUSH = 12;
-// Daemon-to-daemon transfer ops (TCP peer listener)
-constexpr uint8_t XFER_PULL = 1, XFER_PUSH = 2;
+// Daemon-to-daemon transfer ops (TCP peer listener).  XFER_PULL_RANGE is
+// the striped plane: <u64 offset | u64 length> follows the id and the
+// response carries only that byte range (length 0 = size probe, no
+// payload) — K such connections in parallel saturate the link where one
+// stream is window/cpu-bound (cf. tf.data service's parallel streams).
+constexpr uint8_t XFER_PULL = 1, XFER_PUSH = 2, XFER_PULL_RANGE = 3;
 constexpr uint8_t ST_OK = 0, ST_NOT_FOUND = 1, ST_EXISTS = 2, ST_OOM = 3,
                   ST_TIMEOUT = 4, ST_NOT_SEALED = 5, ST_ERR = 6,
                   ST_EVICTED = 7, ST_VIEW = 8;
@@ -567,6 +573,20 @@ int g_xfer_timeout_s = [] {
   // opposite of intent; fall back to the default instead
   return (end && *end == '\0' && n > 0) ? int(n) : 30;
 }();
+// flag-registry tunable (RTPU_TRANSFER_STRIPES): parallel range streams
+// per large pull.  Clamped — each stripe is a thread + connection on the
+// responder too, so an unbounded value is a self-DoS knob.
+int g_xfer_stripes = [] {
+  const char* v = getenv("RTPU_TRANSFER_STRIPES");
+  if (!v || !*v) return 4;
+  char* end = nullptr;
+  long n = strtol(v, &end, 10);
+  if (!end || *end != '\0' || n < 1) return 4;
+  return n > 16 ? 16 : int(n);
+}();
+// Objects below this pull over the single probe connection; striping's
+// extra dials + thread spawns only pay off once per-stream cost matters.
+constexpr uint64_t kStripeMin = 1 << 20;
 
 void SetSockTimeouts(int fd) {
   timeval tv{g_xfer_timeout_s, 0};
@@ -603,6 +623,31 @@ void ServeTransferPeer(Store* store, uint8_t* base, int fd) {
     // pin held across the stream: the extent cannot be evicted under us
     bool ok = WriteFull(fd, resp, sizeof resp) &&
               WriteFull(fd, base + off, size);
+    (void)ok;
+    store->Release(id);
+  } else if (hdr[0] == XFER_PULL_RANGE) {
+    // <u64 offset | u64 length> follows; response echoes the TOTAL size
+    // so the puller can cross-check every stripe against the incarnation
+    // it probed (a recreate between ranges would otherwise interleave
+    // two objects' bytes).  length 0 = probe: header only, no payload.
+    uint64_t range[2];
+    if (!ReadFull(fd, range, sizeof range)) { close(fd); return; }
+    uint64_t off = 0, size = 0;
+    uint8_t status = store->Get(id, 0, &off, &size);
+    uint8_t resp[1 + 8];
+    resp[0] = status;
+    memcpy(resp + 1, &size, 8);
+    if (status != ST_OK) {
+      WriteFull(fd, resp, sizeof resp);
+      close(fd);
+      return;
+    }
+    uint64_t roff = range[0];
+    uint64_t rlen = roff > size ? 0 : range[1];
+    if (rlen > size - roff) rlen = size - roff;
+    // pin held across the range stream, like full XFER_PULL
+    bool ok = WriteFull(fd, resp, sizeof resp) &&
+              (rlen == 0 || WriteFull(fd, base + off + roff, rlen));
     (void)ok;
     store->Release(id);
   } else if (hdr[0] == XFER_PUSH) {
@@ -664,8 +709,42 @@ bool SendAuthAndHeader(int fd, uint8_t op, const ObjectId& id) {
   return WriteFull(fd, pre.data(), pre.size());
 }
 
+// One stripe of a striped pull: dial its own connection, request
+// [roff, roff+rlen) of id, and land the bytes directly at dst.  The
+// responder echoes the object's TOTAL size in every range response; a
+// mismatch against the size the probe saw means the object was deleted
+// and recreated between stripes, so the stripe must fail rather than
+// splice two incarnations' bytes together.
+bool PullRange(const std::string& host, uint16_t port, const ObjectId& id,
+               uint64_t expect_size, uint64_t roff, uint64_t rlen,
+               uint8_t* dst) {
+  int fd = DialPeer(host, port);
+  if (fd < 0) return false;
+  bool ok = false;
+  uint64_t range[2] = {roff, rlen};
+  uint8_t resp[1 + 8];
+  if (SendAuthAndHeader(fd, XFER_PULL_RANGE, id) &&
+      WriteFull(fd, range, sizeof range) &&
+      ReadFull(fd, resp, sizeof resp)) {
+    uint64_t total = 0;
+    memcpy(&total, resp + 1, 8);
+    if (resp[0] == ST_OK && total == expect_size)
+      ok = ReadFull(fd, dst, rlen);
+  }
+  close(fd);
+  return ok;
+}
+
 // Local client asked us to PULL id from a peer daemon straight into our
 // segment.  Returns (status, size).
+//
+// The first connection doubles as the size probe: it requests range
+// [0, kStripeMin) and the response header carries the total size.  Small
+// objects therefore complete on that single connection with the same
+// round-trip count as the old whole-object pull; larger ones fan the
+// remainder out over g_xfer_stripes parallel range connections, all
+// writing into the one pre-created extent, sealed only once every
+// stripe lands (any failure aborts — never a half-written husk).
 std::pair<uint8_t, uint64_t> PullFromPeer(Store* store, uint8_t* base,
                                           const ObjectId& id,
                                           const std::string& host,
@@ -677,7 +756,12 @@ std::pair<uint8_t, uint64_t> PullFromPeer(Store* store, uint8_t* base,
   }
   int fd = DialPeer(host, port);
   if (fd < 0) return {ST_ERR, 0};
-  if (!SendAuthAndHeader(fd, XFER_PULL, id)) { close(fd); return {ST_ERR, 0}; }
+  uint64_t first_range[2] = {0, kStripeMin};
+  if (!SendAuthAndHeader(fd, XFER_PULL_RANGE, id) ||
+      !WriteFull(fd, first_range, sizeof first_range)) {
+    close(fd);
+    return {ST_ERR, 0};
+  }
   uint8_t resp[1 + 8];
   if (!ReadFull(fd, resp, sizeof resp)) { close(fd); return {ST_ERR, 0}; }
   uint64_t size = 0;
@@ -696,12 +780,32 @@ std::pair<uint8_t, uint64_t> PullFromPeer(Store* store, uint8_t* base,
     return {ST_NOT_SEALED, 0};
   }
   if (status != ST_OK) { close(fd); return {status, 0}; }
-  if (!ReadFull(fd, base + off, size)) {
+  uint64_t first_len = size < kStripeMin ? size : kStripeMin;
+  if (!ReadFull(fd, base + off, first_len)) {
     store->Abort(id);
     close(fd);
     return {ST_ERR, 0};
   }
   close(fd);
+  uint64_t rest = size - first_len;
+  if (rest > 0) {
+    int nstripes = g_xfer_stripes;
+    uint64_t per = (rest + nstripes - 1) / uint64_t(nstripes);
+    std::atomic<bool> failed{false};
+    std::vector<std::thread> threads;
+    for (uint64_t o = first_len; o < size; o += per) {
+      uint64_t len = size - o < per ? size - o : per;
+      threads.emplace_back([&, o, len] {
+        if (!PullRange(host, port, id, size, o, len, base + off + o))
+          failed.store(true, std::memory_order_relaxed);
+      });
+    }
+    for (auto& t : threads) t.join();
+    if (failed.load(std::memory_order_relaxed)) {
+      store->Abort(id);
+      return {ST_ERR, 0};
+    }
+  }
   store->Seal(id);
   return {ST_OK, size};
 }
@@ -797,14 +901,21 @@ int ChaosGate() {
 // Per-client (not per-connection) ref bookkeeping: a client process may pool
 // several sockets, so a GET on one connection can be RELEASEd on another.
 // Pins are reclaimed when the client's last connection closes.
+//
+// Sharded locking: each ClientState carries its own mutex for the hot
+// per-op bookkeeping (GET/RELEASE/CREATE/SEAL), so N clients' traffic
+// never cross-serializes on one global lock.  g_clients_mu guards only
+// map membership and the conns count — taken once per connection at
+// handshake/teardown, never per op.
 struct ClientState {
-  int conns = 0;
+  std::mutex mu;  // guards held + creating
+  int conns = 0;  // guarded by g_clients_mu
   std::unordered_map<ObjectId, int, IdHash> held;
   std::unordered_map<ObjectId, bool, IdHash> creating;  // unsealed creates
 };
 
 std::mutex g_clients_mu;
-std::unordered_map<ObjectId, ClientState, IdHash> g_clients;
+std::unordered_map<ObjectId, std::shared_ptr<ClientState>, IdHash> g_clients;
 
 void ServeClient(Store* store, uint8_t* base, int fd) {
   uint8_t req[kReqLen];
@@ -815,9 +926,13 @@ void ServeClient(Store* store, uint8_t* base, int fd) {
     close(fd);
     return;
   }
+  std::shared_ptr<ClientState> cs;
   {
     std::lock_guard<std::mutex> lk(g_clients_mu);
-    g_clients[client_id].conns++;
+    auto& slot = g_clients[client_id];
+    if (!slot) slot = std::make_shared<ClientState>();
+    slot->conns++;
+    cs = slot;
   }
   while (ReadFull(fd, req, kReqLen)) {
     if (ChaosGate()) break;
@@ -838,32 +953,31 @@ void ServeClient(Store* store, uint8_t* base, int fd) {
         }
         status = store->Create(id, arg0, &r0);
         if (status == ST_OK) {
-          std::lock_guard<std::mutex> lk(g_clients_mu);
-          g_clients[client_id].creating[id] = true;
+          std::lock_guard<std::mutex> lk(cs->mu);
+          cs->creating[id] = true;
         }
         r1 = arg0;
         break;
       case OP_SEAL:
         status = store->Seal(id);
         if (status == ST_OK) {
-          std::lock_guard<std::mutex> lk(g_clients_mu);
-          g_clients[client_id].creating.erase(id);
+          std::lock_guard<std::mutex> lk(cs->mu);
+          cs->creating.erase(id);
         }
         break;
       case OP_GET:
         status = store->Get(id, arg0, &r0, &r1);
         if (status == ST_OK) {
-          std::lock_guard<std::mutex> lk(g_clients_mu);
-          g_clients[client_id].held[id]++;
+          std::lock_guard<std::mutex> lk(cs->mu);
+          cs->held[id]++;
         }
         break;
       case OP_RELEASE:
         status = store->Release(id);
         if (status == ST_OK) {
-          std::lock_guard<std::mutex> lk(g_clients_mu);
-          auto& held = g_clients[client_id].held;
-          auto it = held.find(id);
-          if (it != held.end() && --it->second <= 0) held.erase(it);
+          std::lock_guard<std::mutex> lk(cs->mu);
+          auto it = cs->held.find(id);
+          if (it != cs->held.end() && --it->second <= 0) cs->held.erase(it);
         }
         break;
       case OP_DELETE:
@@ -965,8 +1079,8 @@ void ServeClient(Store* store, uint8_t* base, int fd) {
           // no second GET round trip; it owes a RELEASE like plain GET
           status = ST_VIEW;
           {
-            std::lock_guard<std::mutex> lk(g_clients_mu);
-            g_clients[client_id].held[id]++;
+            std::lock_guard<std::mutex> lk(cs->mu);
+            cs->held[id]++;
           }
         }
         break;
@@ -983,17 +1097,29 @@ void ServeClient(Store* store, uint8_t* base, int fd) {
   }
   // Connection closed: if this was the client's last connection, release its
   // leaked pins and abort half-written creates.
+  bool last_conn = false;
   {
-    std::unique_lock<std::mutex> lk(g_clients_mu);
+    std::lock_guard<std::mutex> lk(g_clients_mu);
     auto it = g_clients.find(client_id);
-    if (it != g_clients.end() && --it->second.conns == 0) {
-      ClientState state = std::move(it->second);
+    if (it != g_clients.end() && it->second == cs && --cs->conns == 0) {
       g_clients.erase(it);
-      lk.unlock();
-      for (auto& kv : state.held)
-        for (int i = 0; i < kv.second; i++) store->Release(kv.first);
-      for (auto& kv : state.creating) store->Abort(kv.first);
+      last_conn = true;
     }
+  }
+  if (last_conn) {
+    // cs is now unreachable from the map, but a racing op on another
+    // (already-drained) connection could still hold cs->mu — swap the
+    // books out under it rather than reading them unlocked.
+    std::unordered_map<ObjectId, int, IdHash> held;
+    std::unordered_map<ObjectId, bool, IdHash> creating;
+    {
+      std::lock_guard<std::mutex> lk(cs->mu);
+      held.swap(cs->held);
+      creating.swap(cs->creating);
+    }
+    for (auto& kv : held)
+      for (int i = 0; i < kv.second; i++) store->Release(kv.first);
+    for (auto& kv : creating) store->Abort(kv.first);
   }
   close(fd);
 }

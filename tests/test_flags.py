@@ -42,6 +42,19 @@ def test_typed_reads(monkeypatch):
     assert flags.get("RTPU_LOG_TO_DRIVER") is False
     monkeypatch.setenv("RTPU_LOG_TO_DRIVER", "1")
     assert flags.get("RTPU_LOG_TO_DRIVER") is True
+    # data-plane knobs (zero-copy put + striped transfer)
+    monkeypatch.delenv("RTPU_ZCOPY_PUT_MIN", raising=False)
+    assert flags.get("RTPU_ZCOPY_PUT_MIN") == 256 * 1024
+    monkeypatch.setenv("RTPU_ZCOPY_PUT_MIN", "1048576")
+    assert flags.get("RTPU_ZCOPY_PUT_MIN") == 1 << 20
+    monkeypatch.delenv("RTPU_TRANSFER_STRIPES", raising=False)
+    assert flags.get("RTPU_TRANSFER_STRIPES") == 4
+    monkeypatch.setenv("RTPU_TRANSFER_STRIPES", "8")
+    assert flags.get("RTPU_TRANSFER_STRIPES") == 8
+    monkeypatch.setenv("RTPU_TRANSFER_STRIPES", "garbage")
+    assert flags.get("RTPU_TRANSFER_STRIPES") == 4  # default on garbage
+    monkeypatch.delenv("RTPU_FETCH_CHUNK", raising=False)
+    assert flags.get("RTPU_FETCH_CHUNK") == 1 << 20
 
 
 def test_explicit_excludes_process_local(monkeypatch):
