@@ -238,6 +238,19 @@ class Scheduler:
         # trace_id hex -> list of span dicts, oldest trace evicted.
         self._trace_spans: "OrderedDict[str, list]" = OrderedDict()
         self._trace_cap = max(1, int(flags.get("RTPU_TRACE_CAP")))
+        # Profiling plane (_private/profiling.py flushes here over the
+        # control socket, "profiles_push" — the spans_push of CPU samples):
+        # profile_id -> merged folded-stack store, oldest evicted past
+        # RTPU_PROFILE_CAP.  Workers also register a SECOND persistent
+        # connection ("profiler_register") so profile_start/stop/dump reach
+        # them even while their main loop is busy executing a task.
+        self._profiles: "OrderedDict[str, dict]" = OrderedDict()
+        self._profile_cap = max(1, int(flags.get("RTPU_PROFILE_CAP")))
+        self._profiler_conns: dict[bytes, object] = {}
+        self._profile_cv = threading.Condition(self._lock)
+        self._profile_pending: dict[str, int] = {}  # stop replies awaited
+        self._stack_req: dict[str, list] = {}       # req_id -> dump replies
+        self._stack_pending: dict[str, int] = {}
         # Event-driven pull retries (armed by trigger_pull; drained by the
         # "objects" pubsub watcher thread, started on first use).
         self._wanted_oids: set[bytes] = set()
@@ -299,7 +312,8 @@ class Scheduler:
                         if w.alive and w.proc is not None]
             return rows
 
-        self.reporter = NodeStatsReporter(self.node_id, _live_workers)
+        self.reporter = NodeStatsReporter(self.node_id, _live_workers,
+                                          mm_threshold=self._mm_threshold)
         self.reporter.start()
         # Worker log streaming (reference: _private/log_monitor.py tailing
         # to the driver): this node's monitor forwards new worker-output
@@ -707,6 +721,177 @@ class Scheduler:
                     "root": (roots or buf)[0].get("name") if buf else None,
                 })
             return rows
+
+    # -- profiling plane (see _private/profiling.py) ----------------------
+
+    def _bank_profile(self, rec: dict):
+        """Merge one pushed profile record (folded stacks from one process)
+        into the bounded per-node store.  Bounded both ways: oldest profile
+        evicted past RTPU_PROFILE_CAP, distinct folded stacks per profile
+        capped so one runaway capture can't eat the node."""
+        from ray_tpu._private.profiling import FOLDED_ENTRY_CAP
+
+        pid_ = rec.get("profile_id")
+        if not isinstance(pid_, str) or not pid_:
+            return
+        with self._lock:
+            prof = self._profiles.get(pid_)
+            if prof is None:
+                while len(self._profiles) >= self._profile_cap:
+                    self._profiles.popitem(last=False)
+                prof = self._profiles[pid_] = {
+                    "node": self.node_id.hex(), "hz": rec.get("hz"),
+                    "t0": rec.get("t0"), "t1": rec.get("t1"),
+                    "samples": 0, "entries": 0, "groups": {},
+                }
+            prof["t0"] = min(prof["t0"] or rec.get("t0") or 0.0,
+                             rec.get("t0") or prof["t0"] or 0.0)
+            prof["t1"] = max(prof["t1"] or 0.0, rec.get("t1") or 0.0)
+            prof["samples"] += int(rec.get("samples") or 0)
+            for grp in rec.get("stacks") or ():
+                key = (grp.get("task"), grp.get("trace_id"))
+                g = prof["groups"].setdefault(key, {})
+                for stack, n in (grp.get("folded") or {}).items():
+                    if stack in g:
+                        g[stack] += n
+                    elif prof["entries"] < FOLDED_ENTRY_CAP:
+                        g[stack] = n
+                        prof["entries"] += 1
+            self._profiles.move_to_end(pid_)
+
+    def _get_profile(self, profile_id: str) -> Optional[dict]:
+        with self._lock:
+            prof = self._profiles.get(profile_id)
+            if prof is None:
+                return None
+            return {
+                "profile_id": profile_id, "node": prof["node"],
+                "hz": prof["hz"], "t0": prof["t0"], "t1": prof["t1"],
+                "samples": prof["samples"],
+                "stacks": [{"task": k[0], "trace_id": k[1],
+                            "folded": dict(g)}
+                           for k, g in prof["groups"].items()],
+            }
+
+    def _list_profiles(self) -> list[dict]:
+        with self._lock:
+            return [{
+                "profile_id": pid_, "node": prof["node"],
+                "hz": prof["hz"], "t0": prof["t0"], "t1": prof["t1"],
+                "samples": prof["samples"],
+                "tasks": sorted({k[0] for k in prof["groups"]
+                                 if k[0] and not str(k[0])
+                                 .startswith("thread:")}),
+            } for pid_, prof in self._profiles.items()]
+
+    def _profiler_conns_snapshot(self) -> list:
+        with self._lock:
+            return list(self._profiler_conns.items())
+
+    def _profiler_send(self, wid: bytes, conn, msg: dict) -> bool:
+        try:
+            conn.send(msg)
+            return True
+        except Exception:
+            with self._lock:
+                if self._profiler_conns.get(wid) is conn:
+                    self._profiler_conns.pop(wid, None)
+            return False
+
+    def _profile_start(self, profile_id: str, hz: float) -> dict:
+        """Begin a high-rate capture in this node's local process + every
+        registered worker.  Cluster-wide recording is the caller's fan-out
+        (util.state.record_profile / `rtpu profile --record`)."""
+        from ray_tpu._private import profiling
+
+        profiling.get_sampler().start_capture(profile_id, hz)
+        workers = 0
+        for wid, conn in self._profiler_conns_snapshot():
+            if self._profiler_send(wid, conn, {
+                    "t": "profile_ctl", "op": "start",
+                    "profile_id": profile_id, "hz": hz}):
+                workers += 1
+        return {"profile_id": profile_id, "workers": workers}
+
+    def _profile_stop(self, profile_id: str, timeout: float = 3.0) -> dict:
+        """End the capture: bank the local records, signal every worker,
+        and wait for their pushes so the profile is queryable on return."""
+        from ray_tpu._private import profiling
+
+        for rec in profiling.get_sampler().stop_capture(profile_id):
+            self._bank_profile(rec)
+        conns = self._profiler_conns_snapshot()
+        with self._lock:
+            self._profile_pending[profile_id] = 0
+        for wid, conn in conns:
+            if self._profiler_send(wid, conn, {
+                    "t": "profile_ctl", "op": "stop",
+                    "profile_id": profile_id}):
+                with self._lock:
+                    self._profile_pending[profile_id] += 1
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            # Condition.wait releases self._lock while blocked, so the
+            # scheduler keeps running; replies arrive on the profiler
+            # conns' serving threads and notify.
+            while self._profile_pending.get(profile_id, 0) > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._profile_cv.wait(remaining)
+            missing = self._profile_pending.pop(profile_id, 0)
+            prof = self._profiles.get(profile_id)
+            return {"profile_id": profile_id,
+                    "samples": prof["samples"] if prof else 0,
+                    "missing_workers": missing}
+
+    def _profile_dump(self, timeout: float = 3.0) -> list[dict]:
+        """Live thread stacks of every process on this node (the `rtpu
+        stack` payload): the scheduler/driver process directly, workers
+        over their profiler control conns."""
+        from ray_tpu._private import profiling
+
+        out = [{"pid": os.getpid(), "worker_id": None,
+                "text": profiling.dump_stacks()}]
+        rid = os.urandom(8).hex()
+        conns = self._profiler_conns_snapshot()
+        with self._lock:
+            self._stack_req[rid] = out
+            self._stack_pending[rid] = 0
+        for wid, conn in conns:
+            if self._profiler_send(wid, conn, {
+                    "t": "profile_ctl", "op": "dump", "req_id": rid}):
+                with self._lock:
+                    self._stack_pending[rid] += 1
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while self._stack_pending.get(rid, 0) > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._profile_cv.wait(remaining)
+            self._stack_pending.pop(rid, None)
+            return self._stack_req.pop(rid, out)
+
+    def _on_profile_reply(self, msg: dict):
+        op = msg.get("op")
+        if op == "stop":
+            for rec in msg.get("records") or ():
+                self._bank_profile(rec)
+            with self._lock:
+                pid_ = msg.get("profile_id")
+                if pid_ in self._profile_pending:
+                    self._profile_pending[pid_] -= 1
+                    self._profile_cv.notify_all()
+        elif op == "dump":
+            with self._lock:
+                buf = self._stack_req.get(msg.get("req_id"))
+                if buf is not None:
+                    buf.append({"pid": msg.get("pid"),
+                                "worker_id": msg.get("worker_id"),
+                                "text": msg.get("text", "")})
+                    self._stack_pending[msg.get("req_id")] -= 1
+                    self._profile_cv.notify_all()
 
     def _merge_native_events_locked(self):
         """Fold the native raylet's task-event ring into the Python table
@@ -1555,6 +1740,15 @@ class Scheduler:
                 name="kill-actor", daemon=True).start()
         elif t == "cancel":
             self.cancel(msg["task_id"], msg.get("force", False))
+        elif t == "profiler_register":
+            # a worker's dedicated profiler control channel (see
+            # _private/profiling.py): kept out of the worker's task conn so
+            # ctl ops land even while the main loop executes a task
+            with self._lock:
+                self._profiler_conns[
+                    bytes.fromhex(msg["worker_id"])] = ctx.conn
+        elif t == "profile_reply":
+            self._on_profile_reply(msg)
         elif t == "blocked":
             if ctx.worker is not None:
                 self._on_worker_blocked(ctx.worker, msg.get("task_id"))
@@ -1642,6 +1836,24 @@ class Scheduler:
             # Distributed-tracing spans from workers/driver (util/tracing).
             self._store_spans(params.get("spans") or [])
             return True
+        if method == "profiles_push":
+            # Folded CPU samples from this node's processes (_private/
+            # profiling.py sampler flushes + capture stops).
+            for rec in params.get("records") or ():
+                self._bank_profile(rec)
+            return True
+        if method == "get_profile":
+            return self._get_profile(params["profile_id"])
+        if method == "list_profiles":
+            return self._list_profiles()
+        if method == "profile_start":
+            return self._profile_start(params["profile_id"],
+                                       float(params.get("hz") or 99.0))
+        if method == "profile_stop":
+            return self._profile_stop(params["profile_id"],
+                                      float(params.get("timeout") or 3.0))
+        if method == "profile_dump":
+            return self._profile_dump(float(params.get("timeout") or 3.0))
         if method == "get_trace_spans":
             with self._lock:
                 return list(self._trace_spans.get(params["trace_id"], ()))
@@ -2322,6 +2534,7 @@ class Scheduler:
                 return
             worker.alive = False
             worker.idle = False
+            self._profiler_conns.pop(worker.worker_id, None)
             # Drop the process's last app-metrics snapshot: a dead source
             # must not be scraped as live data (and the dict must not grow
             # under worker churn).

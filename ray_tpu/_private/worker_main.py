@@ -22,6 +22,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 import cloudpickle
 
+from ray_tpu._private import profiling
 from ray_tpu._private import protocol
 from ray_tpu._private import runtime_env as runtime_env_mod
 from ray_tpu._private.task_spec import (
@@ -91,6 +92,11 @@ class WorkerRuntime:
         self.direct_server = make_direct_server(self, bind)
         # Caller-side direct path for actor calls made FROM this worker.
         self.ctx.init_direct(self._rpc)
+        # Sampling profiler + its dedicated control channel to the
+        # scheduler (profile_start/stop and live stack dumps must work
+        # while the main loop is busy executing a task).
+        profiling.start_worker_profiler(args.scheduler_socket,
+                                        self.worker_id)
 
     def _submit(self, spec: TaskSpec) -> None:
         """Nested-task submission: plain tasks ride the binary raylet
@@ -285,6 +291,7 @@ class WorkerRuntime:
         self.ctx.current_task_id = spec.task_id
         self.ctx.current_actor_id = spec.actor_id
         token = tracing.begin_task_span(spec)
+        ptok = profiling.note_task(spec)
         ok = True
         try:
             return self._invoke_method(spec)
@@ -292,6 +299,7 @@ class WorkerRuntime:
             ok = False
             raise
         finally:
+            profiling.clear_task(ptok)
             tracing.end_task_span(token, ok=ok)
             self.ctx.current_task_id = None
             self.ctx.current_actor_id = None
@@ -340,6 +348,9 @@ class WorkerRuntime:
         # Built-in execution span for traced specs: establishes the trace
         # context so nested .remote()s parent here; no-op (None) otherwise.
         token = tracing.begin_task_span(spec)
+        # Profiler attribution: samples of this thread now fold under the
+        # task's name (+ trace id), joining profiles up with traces.
+        ptok = profiling.note_task(spec)
         ok, error = True, None
         # Runtime env: normal tasks apply/undo around execution; an actor's
         # env (applied at creation) persists for its lifetime — the worker
@@ -357,6 +368,7 @@ class WorkerRuntime:
                                                raised_by_task=True):
                         self._notify_sealed(oid)
                 self._notify_done(spec, ok, error)
+                profiling.clear_task(ptok)
                 tracing.end_task_span(token, ok=False)
                 self.ctx.current_task_id = None
                 self.ctx.current_actor_id = None
@@ -405,6 +417,7 @@ class WorkerRuntime:
                 spec.kind != ACTOR_CREATION or not ok
             ):
                 applied_env.undo()
+            profiling.clear_task(ptok)
             tracing.end_task_span(token, ok=ok)
             self.ctx.current_task_id = None
             self.ctx.current_actor_id = None
@@ -459,6 +472,7 @@ def main():
 
         metrics_mod.shutdown_flusher(flush=True)
         tracing.shutdown_flusher(flush=True)
+        profiling.shutdown_sampler(flush=True)
     sys.exit(0)
 
 

@@ -143,6 +143,11 @@ def _attach_driver(node: Node):
 
         scheduler.log_sink = _print_worker_lines
     worker_mod.set_global_worker(ctx)
+    # Driver-side sampling profiler (workers start theirs in worker_main):
+    # the driver's own CPU time shows up in "continuous" profiles too.
+    from ray_tpu._private import profiling
+
+    profiling.ensure_sampler()
     return ctx
 
 
@@ -150,6 +155,11 @@ def shutdown():
     global _global_node
     if _global_node is not None:
         node, _global_node = _global_node, None
+        # Final profile flush needs the driver context: stop the sampler
+        # BEFORE detaching it (a later init() resumes via ensure_sampler).
+        from ray_tpu._private import profiling
+
+        profiling.shutdown_sampler(flush=True)
         worker_mod.set_global_worker(None)
         node.shutdown()
     else:

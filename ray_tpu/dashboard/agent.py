@@ -74,9 +74,33 @@ class NodeStatsReporter:
     """
 
     def __init__(self, node_id: bytes,
-                 workers_fn: Optional[Callable[[], Iterable]] = None):
+                 workers_fn: Optional[Callable[[], Iterable]] = None,
+                 mm_threshold: float = 0.0):
         self._node_id = node_id
         self._workers_fn = workers_fn or (lambda: ())
+        self._mm_threshold = mm_threshold
+        # Memory pressure as util.metrics gauges: the memory monitor's
+        # inputs are visible on /metrics BEFORE a kill fires (node_id /
+        # pid tags keep series from different nodes and processes
+        # distinct — the dashboard's renderer sums same-label series).
+        from ray_tpu.util import metrics as metrics_mod
+
+        nid = node_id.hex()[:12]
+        self._g_mem_used = metrics_mod.Gauge(
+            "node_mem_used_bytes", "Node memory in use (MemAvailable "
+            "subtracted from MemTotal, what the memory monitor sees)",
+            ("node_id",)).set_default_tags({"node_id": nid})
+        self._g_mem_total = metrics_mod.Gauge(
+            "node_mem_total_bytes", "Node memory capacity",
+            ("node_id",)).set_default_tags({"node_id": nid})
+        self._g_mm_threshold = metrics_mod.Gauge(
+            "node_memory_monitor_threshold",
+            "Memory-usage fraction above which the node kills a worker "
+            "(RTPU_MEMORY_MONITOR_THRESHOLD; 0 = monitor disabled)",
+            ("node_id",)).set_default_tags({"node_id": nid})
+        self._g_worker_rss = metrics_mod.Gauge(
+            "worker_rss_bytes", "Resident set size per live worker",
+            ("node_id", "pid")).set_default_tags({"node_id": nid})
         self._lock = threading.Lock()
         self._history: deque = deque(maxlen=_HISTORY)
         self._latest: dict = {}
@@ -135,6 +159,14 @@ class NodeStatsReporter:
                                 "task": desc})
         except Exception:
             pass
+
+        self._g_mem_used.set(float(mem_used))
+        self._g_mem_total.set(float(mem_total))
+        self._g_mm_threshold.set(float(self._mm_threshold))
+        # reset-then-set: exited workers' series must not linger
+        self._g_worker_rss.clear()
+        for w in workers:
+            self._g_worker_rss.set(float(w["rss"]), {"pid": str(w["pid"])})
 
         snap = {
             "node_id": self._node_id.hex(),

@@ -38,6 +38,7 @@ _INDEX_HTML = """<!doctype html><title>ray_tpu dashboard API</title>
 <li><a href="/api/cluster_status">/api/cluster_status</a></li>
 <li><a href="/api/serve">/api/serve</a></li>
 <li><a href="/api/traces">/api/traces (distributed traces; ?trace_id=&lt;hex&gt; for one tree)</a></li>
+<li><a href="/api/profile">/api/profile (CPU profiles; ?id=&lt;profile_id&gt;&amp;format=speedscope|folded|raw)</a></li>
 <li><a href="/metrics">/metrics (Prometheus)</a></li>
 </ul>"""
 
@@ -415,6 +416,33 @@ class DashboardHead:
         return sorted(rows.values(), key=lambda r: r["last_ts"],
                       reverse=True)
 
+    def _profile_rows(self):
+        """Merged per-profile summary rows from every node (the always-on
+        "continuous" profile plus on-demand captures)."""
+        from ray_tpu._private import profiling
+
+        rows = []
+        for sock in self._sched_socks():
+            try:
+                rows.extend(_node_rpc(sock, "list_profiles"))
+            except Exception:
+                continue
+        return profiling.merge_profile_rows(rows)
+
+    def _profile_get(self, profile_id: str):
+        """One profile assembled cluster-wide (same shape as
+        ray_tpu.util.state.get_profile)."""
+        from ray_tpu._private import profiling
+
+        parts = []
+        for sock in self._sched_socks():
+            try:
+                parts.append(_node_rpc(sock, "get_profile",
+                                       {"profile_id": profile_id}))
+            except Exception:
+                continue
+        return profiling.merge_profiles(parts)
+
     # -- server ------------------------------------------------------------
     def _run(self):
         from aiohttp import web
@@ -481,6 +509,36 @@ class DashboardHead:
             return web.Response(text=json.dumps(data, default=str),
                                 content_type="application/json")
 
+        async def profile(request):
+            # /api/profile                         -> profile summary rows
+            # /api/profile?id=<profile_id>         -> speedscope JSON
+            # /api/profile?id=<pid>&format=folded  -> folded-stack text
+            # /api/profile?id=<pid>&format=raw     -> merged profile JSON
+            from ray_tpu._private import profiling
+
+            pid_ = (request.query.get("id")
+                    or request.query.get("profile_id") or None)
+            if pid_ is None:
+                rows = await loop.run_in_executor(None, self._profile_rows)
+                return web.Response(text=json.dumps(rows, default=str),
+                                    content_type="application/json")
+            prof = await loop.run_in_executor(None, self._profile_get, pid_)
+            if prof is None:
+                return web.Response(
+                    text=json.dumps({"error": f"no profile {pid_}"}),
+                    content_type="application/json", status=404)
+            fmt = request.query.get("format") or "speedscope"
+            if fmt == "folded":
+                return web.Response(
+                    text=profiling.profile_to_folded(prof),
+                    content_type="text/plain")
+            if fmt == "raw":
+                return web.Response(text=json.dumps(prof, default=str),
+                                    content_type="application/json")
+            return web.Response(
+                text=json.dumps(profiling.profile_to_speedscope(prof)),
+                content_type="application/json")
+
         async def traces(request):
             # /api/traces                  -> per-trace summary rows
             # /api/traces?trace_id=<hex>   -> one assembled span tree
@@ -507,6 +565,7 @@ class DashboardHead:
         app.router.add_get("/api/cluster_status",
                            json_handler(self._cluster_status))
         app.router.add_get("/api/traces", traces)
+        app.router.add_get("/api/profile", profile)
         app.router.add_get("/metrics", metrics)
 
         async def start():

@@ -15,7 +15,6 @@ import argparse
 import glob
 import json
 import os
-import signal
 import sys
 import time
 from typing import Optional
@@ -108,23 +107,28 @@ def cmd_memory(args):
 
 
 def cmd_stack(args):
-    """SIGUSR1 every local worker_main process: each dumps all thread
-    stacks to its stderr (reference: `ray stack` py-spy dumps)."""
-    import subprocess
-
-    out = subprocess.run(
-        ["pgrep", "-f", "ray_tpu._private.worker_mai[n]"],
-        capture_output=True, text=True)
-    pids = [int(p) for p in out.stdout.split()]
-    if not pids:
-        print("no local ray_tpu workers found")
-        return
-    for pid in pids:
+    """Print live thread stacks of every runtime process on every node
+    (reference: `ray stack` shells out to py-spy; here the profiler
+    control plane returns the stacks to the caller — each worker services
+    dump requests on a dedicated connection, so even a worker busy inside
+    a task answers with where it is stuck)."""
+    sock = find_address(args.address)
+    for n in _rpc(sock, "list_nodes"):
+        if not n["alive"]:
+            continue
+        nid = n["node_id"].hex()[:12]
         try:
-            os.kill(pid, signal.SIGUSR1)
-            print(f"dumped stacks of worker pid {pid} (see its stderr)")
-        except OSError as e:
-            print(f"pid {pid}: {e}")
+            entries = _rpc(n["sched_socket"], "profile_dump")
+        except Exception as e:  # noqa: BLE001
+            print(f"node {nid}: unreachable: {e}")
+            continue
+        print(f"======== node {nid} ({len(entries)} processes) ========")
+        for ent in entries:
+            who = f"pid {ent.get('pid')}"
+            wid = ent.get("worker_id")
+            who += f" worker {wid[:12]}" if wid else " (scheduler/driver)"
+            print(f"---- {who} ----")
+            print(ent.get("text", ""))
 
 
 def _gather_events(sock: str) -> list:
@@ -229,6 +233,88 @@ def cmd_trace(args):
         print(f"  -> {hop['name']:<38s} "
               f"queue={hop['queue_wait_s'] * 1e3:8.2f}ms "
               f"run={hop['run_s'] * 1e3:8.2f}ms")
+
+
+def cmd_profile(args):
+    """Cluster-wide CPU profiling: list known profiles, record a new
+    high-rate capture (--record SECONDS), print a profile's top
+    functions, or export it as a speedscope/folded flamegraph (-o)."""
+    from ray_tpu._private import profiling
+
+    sock = find_address(args.address)
+    nodes = [n for n in _rpc(sock, "list_nodes") if n["alive"]]
+    profile_id = args.profile_id
+    if args.record:
+        profile_id = profile_id or f"prof-{os.urandom(4).hex()}"
+        procs = 0
+        for n in nodes:
+            try:
+                r = _rpc(n["sched_socket"], "profile_start",
+                         {"profile_id": profile_id, "hz": args.hz})
+                procs += 1 + r.get("workers", 0)
+            except Exception:
+                continue
+        print(f"recording {profile_id} at {args.hz:g} Hz across "
+              f"{len(nodes)} node(s) / {procs} process(es) "
+              f"for {args.record:g}s ...")
+        time.sleep(args.record)
+        for n in nodes:
+            try:
+                _rpc(n["sched_socket"], "profile_stop",
+                     {"profile_id": profile_id})
+            except Exception:
+                continue
+
+    def _fanout(method, params=None):
+        out = []
+        for n in nodes:
+            try:
+                r = _rpc(n["sched_socket"], method, params)
+            except Exception:
+                continue
+            out.extend(r if isinstance(r, list) else [r])
+        return out
+
+    if not profile_id:
+        rows = profiling.merge_profile_rows(_fanout("list_profiles"))
+        print("======== Profiles ========")
+        for r in rows:
+            dur = (r.get("t1") or 0) - (r.get("t0") or 0)
+            tasks = ", ".join(r.get("tasks") or ()) or "-"
+            print(f"  {r['profile_id']:24s} samples={r['samples']:<7d} "
+                  f"span={dur:7.1f}s tasks: {tasks[:60]}")
+        if not rows:
+            print("  (none yet — the continuous profiler flushes every "
+                  "few seconds; or record one with --record 5)")
+        return
+
+    prof = profiling.merge_profiles(
+        _fanout("get_profile", {"profile_id": profile_id}))
+    if prof is None:
+        sys.exit(f"no profile {profile_id!r} on any node")
+    if args.output:
+        if args.output.endswith((".folded", ".txt")):
+            with open(args.output, "w") as f:
+                f.write(profiling.profile_to_folded(prof))
+            print(f"wrote folded stacks to {args.output} "
+                  f"(flamegraph.pl or speedscope load it)")
+        else:
+            with open(args.output, "w") as f:
+                json.dump(profiling.profile_to_speedscope(prof), f)
+            print(f"wrote speedscope JSON to {args.output} "
+                  f"(open at https://www.speedscope.app)")
+        return
+    print(f"======== Profile {profile_id} ========")
+    tasks = sorted({g['task'] for g in prof['stacks']
+                    if g.get('task') and not g['task'].startswith('thread:')})
+    print(f"samples={prof['samples']} "
+          f"span={(prof['t1'] or 0) - (prof['t0'] or 0):.1f}s "
+          f"nodes={len(prof.get('nodes') or ())} "
+          f"tasks: {', '.join(tasks) or '-'}")
+    print(f"top {args.top} functions by leaf samples:")
+    for row in profiling.top_functions(prof, args.top):
+        print(f"  {row['fraction'] * 100:5.1f}%  {row['count']:>7d}  "
+              f"{row['frame']}")
 
 
 def cmd_summary(args):
@@ -403,6 +489,21 @@ def main(argv=None):
                     help="write the trace as a chrome-trace JSON instead "
                          "of printing the tree")
     sp.set_defaults(fn=cmd_trace)
+    sp = sub.add_parser("profile")
+    sp.add_argument("profile_id", nargs="?", default=None,
+                    help="profile id to inspect/export (omit to list; "
+                         "'continuous' is the always-on profile)")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--record", type=float, default=None, metavar="SECONDS",
+                    help="record a new cluster-wide capture for SECONDS")
+    sp.add_argument("--hz", type=float, default=99.0,
+                    help="sampling rate for --record (default 99)")
+    sp.add_argument("--top", type=int, default=15,
+                    help="functions to show in the leaf-sample ranking")
+    sp.add_argument("--output", "-o", default=None,
+                    help="write the profile instead of printing: .json = "
+                         "speedscope, .folded/.txt = folded stacks")
+    sp.set_defaults(fn=cmd_profile)
     sp = sub.add_parser("microbenchmark")
     sp.set_defaults(fn=cmd_microbenchmark)
     sp = sub.add_parser("start")

@@ -79,7 +79,9 @@ def main() -> int:
         assert url, "dashboard did not start"
         want = ("# TYPE ray_tpu_scheduler_task_queue_wait_s histogram",
                 "# TYPE ray_tpu_store_put_latency_s histogram",
-                "ray_tpu_node_workers")
+                "ray_tpu_node_workers",
+                "ray_tpu_node_mem_used_bytes",
+                "ray_tpu_worker_rss_bytes")
         deadline = time.monotonic() + 20
         text = ""
         while time.monotonic() < deadline:
@@ -98,6 +100,39 @@ def main() -> int:
             _get(url + f"/api/traces?trace_id={root.trace_id}"))
         assert one["summary"]["num_spans"] >= 5
         print(f"/api/traces ok ({len(rows)} trace(s) listed)")
+
+        # -- profiling ------------------------------------------------
+        # Record a cluster-wide capture while a CPU-bound task runs, then
+        # assert the folded stacks attribute samples to that task and the
+        # dashboard serves them as speedscope-loadable JSON.
+        @ray_tpu.remote
+        def spin(sec):
+            t_end = time.monotonic() + sec
+            x = 0
+            while time.monotonic() < t_end:
+                x += 1
+            return x
+
+        ref = spin.remote(2.0)
+        time.sleep(0.2)  # let the task start before recording
+        prof = state.record_profile(duration=1.2, hz=200.0)
+        ray_tpu.get(ref)
+        assert prof is not None and prof["samples"] > 0, prof
+        tasks = {g["task"] for g in prof["stacks"]}
+        assert "spin" in tasks, f"no task-attributed stacks: {tasks}"
+        pid = prof["profile_id"]
+        rows = json.loads(_get(url + "/api/profile"))
+        assert any(r["profile_id"] == pid for r in rows), rows
+        sp = json.loads(_get(url + f"/api/profile?id={pid}"))
+        assert sp["shared"]["frames"], sp
+        assert sp["profiles"][0]["samples"], sp
+        assert len(sp["profiles"][0]["samples"]) == \
+            len(sp["profiles"][0]["weights"])
+        folded = _get(url + f"/api/profile?id={pid}&format=folded")
+        assert any(line.startswith("spin;")
+                   for line in folded.splitlines()), folded[:2000]
+        print(f"profiling ok (profile {pid}: {prof['samples']} samples, "
+              f"tasks {sorted(t for t in tasks if not t.startswith('thread:'))})")
         print("obs-smoke: PASS")
         return 0
     finally:

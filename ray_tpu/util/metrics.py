@@ -143,6 +143,17 @@ class Metric:
                 "description": self._description,
                 "tag_keys": self._tag_keys, "values": values}
 
+    def clear(self) -> None:
+        """Drop every recorded series (tag values and histogram state).
+        For gauge families whose label sets churn — e.g. per-worker RSS —
+        the reporter clears before re-setting each sample so series for
+        dead workers don't linger on /metrics forever."""
+        with self._lock:
+            self._values.clear()
+            hist = getattr(self, "_hist", None)
+            if hist is not None:
+                hist.clear()
+
     @property
     def info(self) -> dict:
         return {"name": self._name, "description": self._description,

@@ -239,6 +239,92 @@ def list_traces() -> List[Dict[str, Any]]:
     return sorted(rows.values(), key=lambda r: r["last_ts"], reverse=True)
 
 
+def _alive_nodes() -> List[dict]:
+    return [n for n in _rpc("list_nodes") if n["alive"]]
+
+
+def list_profiles() -> List[Dict[str, Any]]:
+    """Known CPU profiles cluster-wide (always-on "continuous" plus any
+    on-demand captures), most recent first, with the task names each
+    profile attributed samples to."""
+    from ray_tpu._private import profiling
+
+    rows: List[dict] = []
+    for n in _alive_nodes():
+        try:
+            rows.extend(_node_rpc(n["sched_socket"], "list_profiles"))
+        except (OSError, RuntimeError):
+            continue
+    return profiling.merge_profile_rows(rows)
+
+
+def get_profile(profile_id: str) -> Optional[Dict[str, Any]]:
+    """Assemble one profile cluster-wide: folded stacks merged across
+    every node, grouped by (task name, trace id).  Pass the result to
+    ``profiling.profile_to_speedscope`` / ``profile_to_folded`` for
+    flamegraph export, or fetch it rendered from the dashboard's
+    ``/api/profile?id=...``."""
+    from ray_tpu._private import profiling
+
+    parts = []
+    for n in _alive_nodes():
+        try:
+            parts.append(_node_rpc(n["sched_socket"], "get_profile",
+                                   {"profile_id": profile_id}))
+        except (OSError, RuntimeError):
+            continue
+    return profiling.merge_profiles(parts)
+
+
+def record_profile(duration: float = 5.0, hz: float = 99.0,
+                   profile_id: Optional[str] = None,
+                   ) -> Optional[Dict[str, Any]]:
+    """Record a high-rate CPU profile of the whole cluster for
+    ``duration`` seconds and return it assembled (see
+    :func:`get_profile`).  Every node's scheduler fans the start/stop to
+    its workers over their profiler control channels, so busy workers are
+    captured mid-task — which is the point."""
+    import os as os_mod
+    import time as time_mod
+
+    if profile_id is None:
+        profile_id = f"prof-{os_mod.urandom(4).hex()}"
+    nodes = _alive_nodes()
+    for n in nodes:
+        try:
+            _node_rpc(n["sched_socket"], "profile_start",
+                      {"profile_id": profile_id, "hz": hz})
+        except (OSError, RuntimeError):
+            continue
+    time_mod.sleep(duration)
+    for n in nodes:
+        try:
+            _node_rpc(n["sched_socket"], "profile_stop",
+                      {"profile_id": profile_id})
+        except (OSError, RuntimeError):
+            continue
+    return get_profile(profile_id)
+
+
+def dump_stacks(node_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Live thread stacks of every runtime process (scheduler/driver +
+    workers), per node — what `rtpu stack` prints.  ``node_id`` (hex)
+    restricts to one node."""
+    out: List[dict] = []
+    for n in _alive_nodes():
+        nid = n["node_id"].hex()
+        if node_id is not None and nid != node_id:
+            continue
+        try:
+            entries = _node_rpc(n["sched_socket"], "profile_dump")
+        except (OSError, RuntimeError):
+            continue
+        for e in entries:
+            e["node_id"] = nid
+        out.extend(entries)
+    return out
+
+
 def list_logs(node_id: Optional[str] = None) -> List[Dict[str, Any]]:
     """Worker log files on one node (reference: ray.util.state.list_logs
     served by the node's dashboard agent; here the node's scheduler plays

@@ -55,6 +55,21 @@ def test_typed_reads(monkeypatch):
     assert flags.get("RTPU_TRANSFER_STRIPES") == 4  # default on garbage
     monkeypatch.delenv("RTPU_FETCH_CHUNK", raising=False)
     assert flags.get("RTPU_FETCH_CHUNK") == 1 << 20
+    # profiling-plane knobs (sampling profiler + bounded profile store)
+    monkeypatch.delenv("RTPU_PROFILE_HZ", raising=False)
+    assert flags.get("RTPU_PROFILE_HZ") == 10.0
+    monkeypatch.setenv("RTPU_PROFILE_HZ", "250")
+    assert flags.get("RTPU_PROFILE_HZ") == 250.0
+    monkeypatch.setenv("RTPU_PROFILE_HZ", "not-a-rate")
+    assert flags.get("RTPU_PROFILE_HZ") == 10.0  # default on garbage
+    monkeypatch.delenv("RTPU_PROFILE_CAP", raising=False)
+    assert flags.get("RTPU_PROFILE_CAP") == 64
+    monkeypatch.setenv("RTPU_PROFILE_CAP", "8")
+    assert flags.get("RTPU_PROFILE_CAP") == 8
+    monkeypatch.delenv("RTPU_PROFILE_FLUSH_S", raising=False)
+    assert flags.get("RTPU_PROFILE_FLUSH_S") == 5.0
+    monkeypatch.setenv("RTPU_PROFILE_FLUSH_S", "0.5")
+    assert flags.get("RTPU_PROFILE_FLUSH_S") == 0.5
 
 
 def test_explicit_excludes_process_local(monkeypatch):

@@ -1,0 +1,310 @@
+"""Cluster-wide sampling profiler (profiling plane + state/dashboard).
+
+Mirrors test_tracing.py but for the CPU-profile plane: the in-process
+sampler attributes folded stacks to the executing task, workers push
+profiles to the node scheduler ("profiles_push"), ``state.record_profile``
+drives a cluster-wide capture through the profiler control connections,
+and the dashboard serves speedscope-loadable JSON at /api/profile.
+"""
+
+import json
+import os
+import threading
+import time
+import types
+import urllib.request
+
+import pytest
+
+from ray_tpu._private import profiling
+
+
+@pytest.fixture(scope="module")
+def cluster(ray_cluster):
+    return ray_cluster
+
+
+def _get(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# sampler unit: folded stacks + task attribution
+
+
+def _spin_thread(stop_evt):
+    while not stop_evt.is_set():
+        sum(range(256))
+
+
+def test_sampler_folded_stacks_attribute_task(cluster):
+    """A thread bracketed by note_task shows up in a high-rate capture
+    under its task name and trace id, with its function in the stack."""
+    stop_evt = threading.Event()
+    started = threading.Event()
+
+    def body():
+        tok = profiling.note_task(
+            types.SimpleNamespace(name="unit-task", trace_id="trace-xyz"))
+        started.set()
+        try:
+            _spin_thread(stop_evt)
+        finally:
+            profiling.clear_task(tok)
+
+    t = threading.Thread(target=body, daemon=True)
+    t.start()
+    started.wait(5)
+    sampler = profiling.get_sampler()
+    assert sampler.alive()
+    assert sampler.start_capture("unit-prof", hz=250.0)
+    time.sleep(0.6)
+    records = sampler.stop_capture("unit-prof")
+    stop_evt.set()
+    t.join(5)
+    assert records and records[0]["profile_id"] == "unit-prof"
+    rec = records[0]
+    assert rec["samples"] > 0 and rec["pid"] == os.getpid()
+    by_task = {g["task"]: g for g in rec["stacks"]}
+    assert "unit-task" in by_task, sorted(by_task)
+    grp = by_task["unit-task"]
+    assert grp["trace_id"] == "trace-xyz"
+    assert any("_spin_thread" in stack for stack in grp["folded"]), \
+        sorted(grp["folded"])[:5]
+
+
+def test_note_task_restores_previous_owner():
+    tok1 = profiling.note_task(types.SimpleNamespace(name="outer"))
+    tok2 = profiling.note_task(types.SimpleNamespace(name="inner"))
+    assert profiling.current_task()[0] == "inner"
+    profiling.clear_task(tok2)
+    assert profiling.current_task()[0] == "outer"
+    profiling.clear_task(tok1)
+    assert profiling.current_task() is None
+
+
+def test_folded_store_caps_distinct_stacks(monkeypatch):
+    monkeypatch.setattr(profiling, "FOLDED_ENTRY_CAP", 10)
+    store = profiling._FoldedStore()
+    for i in range(50):
+        store.bump(("t", None), f"a;b;c{i}")
+    assert store.entries == 10
+    # known stacks keep counting past the cap
+    store.bump(("t", None), "a;b;c0")
+    assert store.groups[("t", None)]["a;b;c0"] == 2
+
+
+# ---------------------------------------------------------------------------
+# scheduler store: profiles_push banking + bounded retention
+
+
+def test_profiles_push_banked_and_capped(cluster):
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu._private import flags
+
+    ctx = worker_mod.global_worker()
+    cap = int(flags.get("RTPU_PROFILE_CAP"))
+
+    def rec(pid_, samples=3):
+        return {"profile_id": pid_, "pid": os.getpid(), "hz": 99.0,
+                "t0": time.time() - 1, "t1": time.time(),
+                "samples": samples,
+                "stacks": [{"task": "synthetic", "trace_id": None,
+                            "folded": {"f.py:g:1;f.py:h:2": samples}}]}
+
+    # same-id records merge: counts sum
+    ctx.rpc("profiles_push", {"records": [rec("push-merge", 2)]})
+    ctx.rpc("profiles_push", {"records": [rec("push-merge", 5)]})
+    got = ctx.rpc("get_profile", {"profile_id": "push-merge"})
+    assert got is not None and got["samples"] == 7
+    folded = got["stacks"][0]["folded"]
+    assert folded["f.py:g:1;f.py:h:2"] == 7
+
+    # overflow evicts oldest-touched ids, bounded at RTPU_PROFILE_CAP
+    n = cap + 6
+    for i in range(n):
+        ctx.rpc("profiles_push", {"records": [rec(f"push-evict-{i}")]})
+    rows = ctx.rpc("list_profiles", {})
+    assert len(rows) <= cap
+    ids = {r["profile_id"] for r in rows}
+    assert f"push-evict-{n - 1}" in ids
+    assert "push-evict-0" not in ids
+    row = next(r for r in rows if r["profile_id"] == f"push-evict-{n - 1}")
+    assert row["tasks"] == ["synthetic"]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end capture: live cluster, task attribution, dashboard
+
+
+@pytest.fixture(scope="module")
+def recorded_profile(cluster):
+    """Record a cluster-wide profile while a CPU-bound task runs."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def spin(sec):
+        t_end = time.monotonic() + sec
+        x = 0
+        while time.monotonic() < t_end:
+            x += 1
+        return x
+
+    ref = spin.remote(2.5)
+    time.sleep(0.3)  # let the task start before recording
+    prof = state.record_profile(duration=1.2, hz=200.0)
+    assert ray_tpu.get(ref) > 0
+    assert prof is not None
+    return prof
+
+
+def test_record_profile_attributes_user_task(recorded_profile):
+    prof = recorded_profile
+    assert prof["samples"] > 0
+    assert prof["profile_id"].startswith("prof-")
+    tasks = {g["task"] for g in prof["stacks"]}
+    assert "spin" in tasks, tasks
+    grp = next(g for g in prof["stacks"] if g["task"] == "spin")
+    # the worker sampled the user function's actual frames
+    assert any("test_profiling.py:spin" in stack for stack in grp["folded"]), \
+        sorted(grp["folded"])[:5]
+
+
+def test_profile_listed_in_state(recorded_profile):
+    from ray_tpu.util import state
+
+    rows = state.list_profiles()
+    row = next(r for r in rows
+               if r["profile_id"] == recorded_profile["profile_id"])
+    assert row["samples"] > 0
+    assert "spin" in row["tasks"]
+    assert row["t0"] <= row["t1"]
+
+
+def test_dashboard_profile_endpoint(recorded_profile, cluster):
+    pid = recorded_profile["profile_id"]
+    url = cluster.dashboard_url
+    rows = json.loads(_get(url + "/api/profile"))
+    assert any(r["profile_id"] == pid for r in rows), rows
+
+    # default rendering: speedscope sampled-profile JSON
+    sp = json.loads(_get(url + f"/api/profile?id={pid}"))
+    assert sp["$schema"].startswith("https://www.speedscope.app")
+    frames = sp["shared"]["frames"]
+    assert frames and all("name" in f for f in frames)
+    p0 = sp["profiles"][0]
+    assert p0["type"] == "sampled"
+    assert len(p0["samples"]) == len(p0["weights"]) > 0
+    nframes = len(frames)
+    assert all(0 <= i < nframes for s in p0["samples"] for i in s)
+    assert p0["endValue"] == sum(p0["weights"])
+
+    # folded text rendering, rooted at the task name
+    folded = _get(url + f"/api/profile?id={pid}&format=folded")
+    assert any(line.startswith("spin;") for line in folded.splitlines())
+
+    # unknown id -> 404
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(url + "/api/profile?id=no-such-profile")
+    assert ei.value.code == 404
+
+
+# ---------------------------------------------------------------------------
+# pure conversion helpers
+
+
+def _synthetic_profile():
+    return {
+        "profile_id": "synth", "hz": 99.0, "t0": 0.0, "t1": 1.0,
+        "samples": 7,
+        "stacks": [
+            {"task": "work", "trace_id": "tr1",
+             "folded": {"m.py:main:1;m.py:inner:9": 4,
+                        "m.py:main:1;m.py:other:20": 2}},
+            {"task": "thread:MainThread", "trace_id": None,
+             "folded": {"m.py:idle:3": 1}},
+        ],
+    }
+
+
+def test_profile_to_speedscope_valid():
+    sp = profiling.profile_to_speedscope(_synthetic_profile())
+    frames = sp["shared"]["frames"]
+    names = [f["name"] for f in frames]
+    assert "work" in names and "m.py:inner:9" in names
+    p0 = sp["profiles"][0]
+    assert p0["name"] == "synth"
+    assert len(p0["samples"]) == len(p0["weights"]) == 3
+    assert p0["endValue"] == 7
+    assert all(0 <= i < len(frames) for s in p0["samples"] for i in s)
+    json.dumps(sp)  # must be JSON-serializable as-is
+
+
+def test_profile_to_folded_and_top():
+    prof = _synthetic_profile()
+    folded = profiling.profile_to_folded(prof)
+    assert "work;m.py:main:1;m.py:inner:9 4" in folded.splitlines()
+    top = profiling.top_functions(prof, n=2)
+    assert top[0]["frame"] == "m.py:inner:9" and top[0]["count"] == 4
+    assert abs(sum(t["fraction"] for t in profiling.top_functions(prof, 99))
+               - 1.0) < 1e-9
+
+
+def test_merge_profiles_across_nodes():
+    a = _synthetic_profile()
+    b = _synthetic_profile()
+    b["samples"] = 3
+    merged = profiling.merge_profiles([a, None, b])
+    assert merged["samples"] == 10
+    grp = next(g for g in merged["stacks"] if g["task"] == "work")
+    assert grp["folded"]["m.py:main:1;m.py:inner:9"] == 8
+    assert profiling.merge_profiles([None, None]) is None
+
+
+# ---------------------------------------------------------------------------
+# device telemetry: CPU-only no-op
+
+
+def test_device_telemetry_noop_on_cpu(cluster):
+    """CPU devices report no memory_stats: the tick must neither raise
+    nor create device-memory gauges (the documented no-op-safe path)."""
+    import jax
+
+    jax.devices()  # backend is initialized (conftest forces cpu)
+    tele = profiling._DeviceTelemetry()
+    tele.tick()
+    tele.tick()  # idempotent
+    assert tele._mem_gauges is None
+
+
+# ---------------------------------------------------------------------------
+# live stack dumps (the plane behind `rtpu stack`)
+
+
+def test_dump_stacks_cluster_wide(cluster):
+    from ray_tpu.util import state
+
+    entries = state.dump_stacks()
+    assert entries
+    # the driver-side scheduler process reports itself...
+    local = [e for e in entries if e["pid"] == os.getpid()]
+    assert local and local[0]["worker_id"] is None
+    assert f"pid {os.getpid()}:" in local[0]["text"]
+    assert "-- thread" in local[0]["text"]
+    # ...and registered workers answer over the profiler control conn
+    workers = [e for e in entries if e["worker_id"]]
+    assert workers, entries
+    for e in entries:
+        assert e["node_id"]
+
+
+def test_dump_stacks_local_text_has_task_attribution():
+    tok = profiling.note_task(
+        types.SimpleNamespace(name="dumped-task", trace_id="tr-dump"))
+    try:
+        text = profiling.dump_stacks()
+    finally:
+        profiling.clear_task(tok)
+    assert "[task dumped-task trace tr-dump]" in text
