@@ -43,4 +43,10 @@ obs-smoke:
 bench-store:
 	JAX_PLATFORMS=cpu python -m ray_tpu._private.store_bench
 
-.PHONY: sanitize test obs-smoke bench-store
+# Data-service bench: ViT-style decode+augment pipeline, 4 consumers
+# sharing one named job (first-epoch cache) vs 4 independent pipelines.
+# One JSON line on stdout; the committed BENCH_data.json is its capture.
+bench-data:
+	JAX_PLATFORMS=cpu python -m ray_tpu._private.data_bench | tee BENCH_data.json
+
+.PHONY: sanitize test obs-smoke bench-store bench-data

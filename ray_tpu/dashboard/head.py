@@ -37,6 +37,7 @@ _INDEX_HTML = """<!doctype html><title>ray_tpu dashboard API</title>
 <li><a href="/api/tasks/summary">/api/tasks/summary</a></li>
 <li><a href="/api/cluster_status">/api/cluster_status</a></li>
 <li><a href="/api/serve">/api/serve</a></li>
+<li><a href="/api/data/jobs">/api/data/jobs (data-service jobs; ?job=&lt;name&gt; for one)</a></li>
 <li><a href="/api/traces">/api/traces (distributed traces; ?trace_id=&lt;hex&gt; for one tree)</a></li>
 <li><a href="/api/profile">/api/profile (CPU profiles; ?id=&lt;profile_id&gt;&amp;format=speedscope|folded|raw)</a></li>
 <li><a href="/metrics">/metrics (Prometheus)</a></li>
@@ -301,6 +302,26 @@ class DashboardHead:
         except Exception as e:
             return {"error": f"serve not running: {type(e).__name__}"}
 
+    def _data_jobs(self, job: Optional[str] = None):
+        """Data-service job snapshots straight from the coordinator's GCS
+        KV records (namespace data_jobs) — no driver context needed."""
+        import json as json_mod
+
+        out = []
+        keys = ([job.encode()] if job
+                else self._gcs.kv_keys("data_jobs"))
+        for key in keys:
+            blob = self._gcs.kv_get("data_jobs", bytes(key))
+            if blob is None:
+                continue
+            try:
+                out.append(json_mod.loads(bytes(blob).decode()))
+            except (ValueError, UnicodeDecodeError):
+                continue
+        if job:
+            return out[0] if out else {"error": f"unknown data job {job!r}"}
+        return sorted(out, key=lambda j: j.get("name", ""))
+
     def _job_logs(self, submission_id: str):
         try:
             return {"logs": _node_rpc(self._head_sock, "job_logs",
@@ -564,6 +585,15 @@ class DashboardHead:
                            json_handler(self._task_summary))
         app.router.add_get("/api/cluster_status",
                            json_handler(self._cluster_status))
+        async def data_jobs(request):
+            # /api/data/jobs              -> every job's status snapshot
+            # /api/data/jobs?job=<name>   -> one job
+            name = request.query.get("job") or None
+            data = await loop.run_in_executor(None, self._data_jobs, name)
+            return web.Response(text=json.dumps(data, default=str),
+                                content_type="application/json")
+
+        app.router.add_get("/api/data/jobs", data_jobs)
         app.router.add_get("/api/traces", traces)
         app.router.add_get("/api/profile", profile)
         app.router.add_get("/metrics", metrics)

@@ -27,6 +27,7 @@ from ray_tpu.data.preprocessor import (
     SimpleImputer,
     StandardScaler,
 )
+from ray_tpu.data import service  # noqa: E402 — cluster-level data service
 
 
 def _read(name: str, tasks) -> Dataset:
@@ -330,4 +331,5 @@ __all__ = [
     "read_tfrecords",
     "read_webdataset",
     "read_text",
+    "service",
 ]

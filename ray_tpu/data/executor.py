@@ -40,6 +40,37 @@ RefBundle = Tuple[ObjectRef, BlockMetadata]
 # unique-per-execution operator tokens (see _window_run)
 _op_token_counter = itertools.count()
 
+_exec_metrics_lock = threading.Lock()
+_exec_metrics_cache: Optional[dict] = None
+
+
+def _exec_metrics() -> dict:
+    """Per-op executor counters on the /metrics plane (util.metrics):
+    OpStats/ExecStats are per-execution and invisible to Prometheus, so
+    operators also feed these process-wide families, tagged by op name."""
+    global _exec_metrics_cache
+    with _exec_metrics_lock:
+        if _exec_metrics_cache is None:
+            from ray_tpu.util import metrics as M
+
+            _exec_metrics_cache = {
+                "rows": M.Counter(
+                    "data_op_rows_total",
+                    "Rows produced per physical data operator", ("op",)),
+                "bytes": M.Counter(
+                    "data_op_output_bytes_total",
+                    "Output bytes produced per physical data operator",
+                    ("op",)),
+                "tasks": M.Counter(
+                    "data_op_tasks_total",
+                    "Task launches per physical data operator", ("op",)),
+                "stalls": M.Counter(
+                    "data_op_backpressure_stalls_total",
+                    "Launch attempts denied by a backpressure policy",
+                    ("op",)),
+            }
+    return _exec_metrics_cache
+
 
 @dataclass
 class OpStats:
@@ -135,6 +166,8 @@ def _window_run(submit: Callable[[], Optional[ObjectRef]],
     # identity token: concurrent ops may share a display name, and
     # identity-keyed policies (ResourceManagerPolicy) must not alias them
     op_token = f"{op_name}#{next(_op_token_counter)}"
+    metrics = _exec_metrics()
+    op_tag = {"op": op_name or "op"}
     pending: deque = deque()
     exhausted = False
     bytes_per_task = 0.0  # rolling estimate from completed tasks
@@ -150,6 +183,7 @@ def _window_run(submit: Callable[[], Optional[ObjectRef]],
                     outstanding_bytes=bytes_per_task * len(pending),
                     op_token=op_token)
                 if not all(p.can_launch(snap) for p in policies):
+                    metrics["stalls"].inc(1, op_tag)
                     break
                 ref = submit()
                 if ref is None:
@@ -157,6 +191,7 @@ def _window_run(submit: Callable[[], Optional[ObjectRef]],
                     break
                 pending.append(ref)
                 stats.tasks += 1
+                metrics["tasks"].inc(1, op_tag)
                 launched += 1
                 for p in policies:
                     p.on_launch(snap)
@@ -175,9 +210,13 @@ def _window_run(submit: Callable[[], Optional[ObjectRef]],
             head = pending.popleft()
             result = ray_tpu.get(head)
             out_bytes = 0
+            out_rows = 0
             for _, meta in result:
                 stats.rows += meta.num_rows
+                out_rows += meta.num_rows
                 out_bytes += meta.size_bytes or 0
+            metrics["rows"].inc(out_rows, op_tag)
+            metrics["bytes"].inc(out_bytes, op_tag)
             completed += 1
             # exponential moving average keeps the estimate fresh across
             # size regimes without storing per-task history
@@ -306,6 +345,8 @@ class ActorMapOp(PhysicalOp):
         load: Dict[int, int] = {i: 0 for i in range(len(actors))}
         it = iter(inp)
         cap = ctx.max_tasks_in_flight_per_actor
+        metrics = _exec_metrics()
+        op_tag = {"op": self.name}
         t0 = time.perf_counter()
         try:
             done_in = False
@@ -324,6 +365,7 @@ class ActorMapOp(PhysicalOp):
                     in_flight.append((ref, i))
                     load[i] += 1
                     stats.tasks += 1
+                    metrics["tasks"].inc(1, op_tag)
                 if (not done_in and len(actors) < self._max_pool
                         and len(in_flight) >= len(actors) * cap):
                     # Scale only on a REAL utilization signal: the queue is
@@ -344,8 +386,13 @@ class ActorMapOp(PhysicalOp):
                 head, i = in_flight.popleft()
                 load[i] -= 1
                 result = ray_tpu.get(head)
+                out_rows = out_bytes = 0
                 for _, meta in result:
                     stats.rows += meta.num_rows
+                    out_rows += meta.num_rows
+                    out_bytes += meta.size_bytes or 0
+                metrics["rows"].inc(out_rows, op_tag)
+                metrics["bytes"].inc(out_bytes, op_tag)
                 yield result
         finally:
             stats.wall_s += time.perf_counter() - t0

@@ -121,8 +121,15 @@ class DataIterator:
         self._bundles = bundles
 
     def _blocks(self) -> Iterator[Block]:
-        for ref, _meta in self._bundles:
-            yield ray_tpu.get(ref)
+        # Fetch on a feed thread with a small window so the NEXT block's
+        # store get overlaps consumption of the current one — strictly
+        # serial get-then-consume left the consumer idle for every fetch
+        # round trip.
+        def fetch():
+            for ref, _meta in self._bundles:
+                yield ray_tpu.get(ref)
+
+        return _prefetched(fetch(), 3)
 
     def iter_batches(self, *, batch_size: Optional[int] = 256,
                      batch_format: str = "numpy",

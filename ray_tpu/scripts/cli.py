@@ -469,6 +469,63 @@ def cmd_job(args):
                   f"{info.entrypoint}")
 
 
+def cmd_data(args):
+    """Data-service jobs: list / describe / scale.  Reads the coordinator's
+    GCS KV status snapshots; scale writes a data_ctl command record the
+    coordinator's pump applies within ~a second (the CLI has no driver
+    context, so it cannot call the coordinator actor directly)."""
+    sock = find_address(args.address)
+
+    def _snapshots():
+        out = []
+        for key in _rpc(sock, "kv_keys", {"namespace": "data_jobs"}) or []:
+            blob = _rpc(sock, "kv_get", {"namespace": "data_jobs",
+                                         "key": bytes(key)})
+            if blob is None:
+                continue
+            try:
+                out.append(json.loads(bytes(blob).decode()))
+            except (ValueError, UnicodeDecodeError):
+                continue
+        return sorted(out, key=lambda j: j.get("name", ""))
+
+    if args.data_command == "list":
+        jobs = _snapshots()
+        if not jobs:
+            print("(no data jobs — register one with "
+                  "ray_tpu.data.service.register)")
+            return
+        print(f"{'NAME':20s} {'STATE':8s} {'SPLITS':>6s} {'WORKERS':>7s} "
+              f"{'EPOCH':>5s} {'ROWS/S':>9s} {'CACHE':>6s} {'FAILOVERS':>9s}")
+        for j in jobs:
+            cache = j.get("cache", {})
+            hit_rate = cache.get("hit_rate")
+            print(f"{j['name']:20s} {j['state']:8s} "
+                  f"{j['num_splits']:6d} {len(j.get('workers', [])):7d} "
+                  f"{j.get('epoch', 0):5d} {j.get('rows_per_s', 0):9.1f} "
+                  f"{('%.0f%%' % (hit_rate * 100)) if hit_rate is not None else '-':>6s} "
+                  f"{j.get('failovers', 0):9d}")
+    elif args.data_command == "describe":
+        jobs = [j for j in _snapshots() if j["name"] == args.job]
+        if not jobs:
+            sys.exit(f"unknown data job {args.job!r}")
+        print(json.dumps(jobs[0], indent=2, default=str))
+    elif args.data_command == "scale":
+        cmd = {"job": args.job, "ts": time.time()}
+        if args.min is not None:
+            cmd["min"] = args.min
+        if args.max is not None:
+            cmd["max"] = args.max
+        if len(cmd) == 2:
+            sys.exit("data scale: pass --min and/or --max")
+        _rpc(sock, "kv_put", {"namespace": "data_ctl",
+                              "key": args.job.encode(),
+                              "value": json.dumps(cmd).encode()})
+        print(f"scale request submitted for {args.job!r}: "
+              f"{ {k: v for k, v in cmd.items() if k in ('min', 'max')} } "
+              f"(coordinator applies it within ~1s)")
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="ray_tpu")
     sub = p.add_subparsers(dest="command", required=True)
@@ -542,6 +599,19 @@ def main(argv=None):
         jp.add_argument("submission_id")
     jsub.add_parser("list")
     sp.set_defaults(fn=cmd_job)
+    sp = sub.add_parser("data")
+    sp.add_argument("--address", default=None)
+    dsub = sp.add_subparsers(dest="data_command", required=True)
+    dsub.add_parser("list")
+    dp = dsub.add_parser("describe")
+    dp.add_argument("job")
+    dp = dsub.add_parser("scale")
+    dp.add_argument("job")
+    dp.add_argument("--min", type=int, default=None,
+                    help="worker-pool floor")
+    dp.add_argument("--max", type=int, default=None,
+                    help="worker-pool ceiling")
+    sp.set_defaults(fn=cmd_data)
     args = p.parse_args(argv)
     args.fn(args)
 

@@ -358,3 +358,23 @@ def get_log(filename: str, node_id: Optional[str] = None,
     if out.get("error"):
         raise FileNotFoundError(out["error"])
     return out["lines"]
+
+
+def list_data_jobs() -> List[Dict[str, Any]]:
+    """Status snapshots of every registered data-service job (reference
+    shape: tf.data service dispatcher state).  Reads the coordinator's
+    GCS KV snapshots, so it works from any driver — including ones that
+    never touched the data service."""
+    import json as _json
+
+    out: List[Dict[str, Any]] = []
+    for key in _rpc("kv_keys", {"namespace": "data_jobs"}) or []:
+        blob = _rpc("kv_get", {"namespace": "data_jobs",
+                               "key": bytes(key)})
+        if blob is None:
+            continue
+        try:
+            out.append(_json.loads(bytes(blob).decode()))
+        except (ValueError, UnicodeDecodeError):
+            continue
+    return sorted(out, key=lambda j: j.get("name", ""))

@@ -45,6 +45,12 @@ _hang_dump = open("/tmp/pytest_hang_dump.txt", "w")
 faulthandler.dump_traceback_later(600, repeat=True, file=_hang_dump)
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight tests excluded from the tier-1 run (-m 'not slow')")
+
+
 @pytest.fixture(scope="session")
 def ray_cluster():
     import ray_tpu

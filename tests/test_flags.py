@@ -70,6 +70,25 @@ def test_typed_reads(monkeypatch):
     assert flags.get("RTPU_PROFILE_FLUSH_S") == 5.0
     monkeypatch.setenv("RTPU_PROFILE_FLUSH_S", "0.5")
     assert flags.get("RTPU_PROFILE_FLUSH_S") == 0.5
+    # data-service knobs (disaggregated input-data tier)
+    monkeypatch.delenv("RTPU_DATA_CACHE_BYTES", raising=False)
+    assert flags.get("RTPU_DATA_CACHE_BYTES") == 256 << 20
+    monkeypatch.setenv("RTPU_DATA_CACHE_BYTES", "1048576")
+    assert flags.get("RTPU_DATA_CACHE_BYTES") == 1 << 20
+    monkeypatch.delenv("RTPU_DATA_LEASE_S", raising=False)
+    assert flags.get("RTPU_DATA_LEASE_S") == 30.0
+    monkeypatch.setenv("RTPU_DATA_LEASE_S", "2.5")
+    assert flags.get("RTPU_DATA_LEASE_S") == 2.5
+    monkeypatch.delenv("RTPU_DATA_WORKERS_MIN", raising=False)
+    assert flags.get("RTPU_DATA_WORKERS_MIN") == 1
+    monkeypatch.setenv("RTPU_DATA_WORKERS_MIN", "3")
+    assert flags.get("RTPU_DATA_WORKERS_MIN") == 3
+    monkeypatch.setenv("RTPU_DATA_WORKERS_MAX", "garbage")
+    assert flags.get("RTPU_DATA_WORKERS_MAX") == 4  # default on garbage
+    monkeypatch.delenv("RTPU_TESTING_DATA_FAILURE", raising=False)
+    assert flags.get("RTPU_TESTING_DATA_FAILURE") == ""
+    monkeypatch.setenv("RTPU_TESTING_DATA_FAILURE", "25")
+    assert flags.get("RTPU_TESTING_DATA_FAILURE") == "25"
 
 
 def test_explicit_excludes_process_local(monkeypatch):
