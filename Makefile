@@ -49,4 +49,13 @@ bench-store:
 bench-data:
 	JAX_PLATFORMS=cpu python -m ray_tpu._private.data_bench | tee BENCH_data.json
 
-.PHONY: sanitize test obs-smoke bench-store bench-data
+# Control-plane scale envelope: 1M queued plain tasks through the native
+# raylet lane (queue-time spillback path active, shape-indexed backlog),
+# plus the actor/PG/node scenarios.  Writes BENCH_scale.json; the
+# committed file is its round-over-round capture.  The pytest smoke
+# (tests/test_scale_smoke.py) runs --quick; the big envelope is the
+# @slow test.
+bench-scale:
+	JAX_PLATFORMS=cpu python -m ray_tpu._private.scale_bench
+
+.PHONY: sanitize test obs-smoke bench-store bench-data bench-scale

@@ -6,7 +6,9 @@ cluster_resource_scheduler.cc:145 GetBestSchedulableNode with the hybrid
 policy in policy/hybrid_scheduling_policy.cc, and the PG bundle strategies in
 policy/bundle_scheduling_policy.cc).  The local dispatch loop stays in
 scheduler.py (the reference's local_task_manager.cc); this module owns the
-decisions and plumbing that involve OTHER nodes.
+plumbing that involves OTHER nodes.  The placement POLICY itself
+(hybrid_decide / pick_spill_target / node_utilization) lives in
+scheduling_policy.py and is re-exported here for existing call sites.
 """
 
 from __future__ import annotations
@@ -15,7 +17,13 @@ import threading
 from typing import Callable, Optional
 
 from ray_tpu._private import protocol
-from ray_tpu._private import flags as flags_mod
+from ray_tpu._private.scheduling_policy import (  # noqa: F401  (re-export)
+    feasible,
+    hybrid_decide,
+    node_utilization,
+    peer_could_take,
+    pick_spill_target,
+)
 from ray_tpu._private.task_spec import TaskSpec
 
 
@@ -87,57 +95,6 @@ class PeerLinks:
     def drop(self, node_id: bytes):
         with self._lock:
             self._peers.pop(node_id, None)
-
-
-def pick_spill_target(
-    spec: TaskSpec,
-    node_id: bytes,
-    total_resources: dict,
-    cluster_nodes: dict,
-) -> Optional[bytes]:
-    """Pick a peer node for a task this node can't run right now
-    (reference: hybrid policy spillback,
-    policy/hybrid_scheduling_policy.cc — local-first, then best feasible
-    remote by available capacity).  Debits the cached view of the chosen
-    node so the next task in the same pass picks a different node instead
-    of dogpiling this one; the target's own heartbeat re-syncs truth."""
-    if spec.pg_id is not None or spec.spill_count >= flags_mod.get("RTPU_MAX_SPILLS"):
-        return None  # PG bundles are reserved on this node
-    if spec.node_affinity == node_id and not spec.affinity_soft:
-        return None
-    from ray_tpu.util.scheduling_strategies import labels_match
-
-    hard = getattr(spec, "label_selector", None)
-    soft = getattr(spec, "label_selector_soft", None)
-    res = spec.resources or {}
-    locally_feasible = all(
-        total_resources.get(k, 0) >= v for k, v in res.items())
-    best, best_score = None, -1.0
-    for nid, node in cluster_nodes.items():
-        if nid == node_id or not node.alive:
-            continue
-        labels = getattr(node, "labels", None)
-        if hard and not labels_match(hard, labels):
-            continue  # hard label selector excludes this node
-        if not all(node.resources.get(k, 0) >= v for k, v in res.items()):
-            continue  # never feasible there
-        has_now = all(node.available.get(k, 0) >= v for k, v in res.items())
-        if not has_now and locally_feasible and not hard:
-            # feasible here eventually: only spill to nodes with free
-            # capacity right now (a hard selector has no "here" option)
-            continue
-        score = (1000.0 if has_now else 0.0) + sum(
-            node.available.get(k, 0) for k in ("CPU", "TPU"))
-        if soft and labels_match(soft, labels):
-            score += 10000.0  # soft label preference dominates load
-        if score > best_score:
-            best, best_score = nid, score
-    if best is not None:
-        spec.spill_count += 1
-        avail = cluster_nodes[best].available
-        for k, v in res.items():
-            avail[k] = avail.get(k, 0) - v
-    return best
 
 
 def assign_bundles(

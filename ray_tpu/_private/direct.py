@@ -74,6 +74,35 @@ _U32 = struct.Struct("<I")
 
 _native_core = None
 _native_failed = False
+_lane_disabled_reported = False
+
+
+def _report_native_lane_disabled(reason: str):
+    """The C++ transport is OFF for this process — say so ONCE, loudly.
+    Silently switching lanes under RTPU_TESTING_RPC_FAILURE meant the
+    default production path had zero fault-injection coverage and nobody
+    could tell from the outside; now every lane switch leaves a warning
+    on stderr and a ray_tpu_native_lane_disabled gauge on /metrics."""
+    global _lane_disabled_reported
+    if _lane_disabled_reported:
+        return
+    _lane_disabled_reported = True
+    import sys
+
+    print(f"[ray_tpu] WARNING: native C++ transport disabled ({reason}); "
+          f"tasks and actor calls take the Python fallback lane",
+          file=sys.stderr, flush=True)
+    try:
+        from ray_tpu.util.metrics import Gauge
+
+        Gauge("ray_tpu_native_lane_disabled",
+              description="1 when this process runs with the native C++ "
+                          "transport off (chaos injection or "
+                          "RTPU_NATIVE_TRANSPORT=0) and dispatch rides "
+                          "the Python fallback lane",
+              tag_keys=("reason",)).set(1, {"reason": reason})
+    except Exception:
+        pass  # metrics must never block the lane decision
 
 
 def native_core():
@@ -81,9 +110,15 @@ def native_core():
     global _native_core, _native_failed
     if _native_core is not None or _native_failed:
         return _native_core
-    if (os.environ.get("RTPU_NATIVE_TRANSPORT", "1") == "0"
-            or os.environ.get("RTPU_TESTING_RPC_FAILURE")):
+    if os.environ.get("RTPU_NATIVE_TRANSPORT", "1") == "0":
         _native_failed = True
+        _report_native_lane_disabled("RTPU_NATIVE_TRANSPORT=0")
+        return None
+    if os.environ.get("RTPU_TESTING_RPC_FAILURE"):
+        # chaos injects at the Python frame layer; the C++ threads would
+        # bypass it, so the whole transport drops to the Python lane
+        _native_failed = True
+        _report_native_lane_disabled("RTPU_TESTING_RPC_FAILURE chaos")
         return None
     try:
         from ray_tpu.native.build import load_extension
@@ -91,6 +126,7 @@ def native_core():
         _native_core = load_extension("_rtpu_core")
     except Exception:
         _native_failed = True
+        _report_native_lane_disabled("extension failed to load")
     return _native_core
 
 

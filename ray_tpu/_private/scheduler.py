@@ -34,6 +34,7 @@ from typing import Optional
 
 from ray_tpu._private import cluster_scheduler as cluster_mod
 from ray_tpu._private import flags
+from ray_tpu._private import scheduling_policy as policy_mod
 from ray_tpu.util import scheduling_strategies as strategies_mod
 from ray_tpu._private import gcs as gcs_mod
 from ray_tpu._private.object_transfer import ObjectTransfer
@@ -102,6 +103,31 @@ def _self_metrics():
                 "scheduler_tasks_dispatched_total",
                 description="Tasks dispatched to workers by this node "
                             "scheduler"),
+            # queue-time spillback decisions (scheduling_policy.py): how
+            # often a submit stayed local vs. was forwarded, and how long
+            # the decision itself took — measured AT QUEUE TIME, the
+            # latency the 0.25s heartbeat balancer used to hide
+            "spill_local": Counter(
+                "scheduler_spill_decisions_local_total",
+                description="Queue-time spill evaluations that kept the "
+                            "task on the submitting node"),
+            "spill_remote": Counter(
+                "scheduler_spill_decisions_spilled_total",
+                description="Queue-time spill evaluations that forwarded "
+                            "the task to a peer node"),
+            "spill_decision": Histogram(
+                "scheduler_spill_decision_s",
+                description="Seconds spent making one queue-time hybrid "
+                            "spillback decision (local-load snapshot + "
+                            "cluster-view scoring)",
+                boundaries=(0.00001, 0.00005, 0.0002, 0.001,
+                            0.005, 0.02, 0.1)),
+            "backlog": Gauge(
+                "scheduler_backlog_depth",
+                description="Tasks backlogged on a node (Python pending "
+                            "lanes + native raylet queue), labeled by "
+                            "node",
+                tag_keys=("node",)),
         }
     return _SELF_METRICS
 
@@ -205,7 +231,10 @@ class Scheduler:
 
         self._lock = threading.RLock()
         self._wake = threading.Condition(self._lock)
-        self._pending: deque[TaskSpec] = deque()
+        # Pending work: routed lane + shape-indexed plain-task buckets
+        # (scheduling_policy.PendingQueues) so dispatch feasibility is
+        # decided per SHAPE, not per task, under a deep backlog.
+        self._pending = policy_mod.PendingQueues()
         self._actor_workers: dict[bytes, bytes] = {}  # actor_id -> worker_id
         self._pgs: dict[bytes, PlacementGroupState] = {}
         self._task_index: dict[bytes, TaskSpec] = {}  # task_id -> spec (pending/running)
@@ -264,6 +293,15 @@ class Scheduler:
         # advertises zero availability and spills its forwardable pending
         # work — graceful scale-down runs this before termination.
         self._draining = False
+        # Queue-time hybrid spillback (scheduling_policy.hybrid_decide):
+        # submit() consults these before parking a task on a saturated
+        # node.  _has_peers keeps the single-node hot path at one falsy
+        # check; _load_cache bounds per-submit ledger round-trips.
+        self._spill_threshold = float(flags.get("RTPU_SPILL_THRESHOLD"))
+        self._spill_top_k = int(flags.get("RTPU_SPILL_TOP_K"))
+        self._max_spills = int(flags.get("RTPU_MAX_SPILLS"))
+        self._has_peers = False
+        self._load_cache: Optional[list] = None  # [ts, available, queued]
         self._memory_monitor = None
         self._mm_threshold = float(
             os.environ.get("RTPU_MEMORY_MONITOR_THRESHOLD", 0.95))
@@ -373,9 +411,10 @@ class Scheduler:
                     node_resources.get("CPU", 0.0))
                 # The lane is on for EVERY node, head or worker, single-
                 # or multi-node: locally-feasible plain tasks always
-                # dispatch in C++.  Spillback stays Python — the heartbeat
-                # balancer steals only the excess backlog a saturated node
-                # cannot run and hands it to the policy path.
+                # dispatch in C++.  Spillback stays Python — decided at
+                # queue time in submit() (scheduling_policy.hybrid_decide)
+                # before a spec enters the C++ queue; the heartbeat
+                # balancer is the slow-path correction for stale views.
                 self._lane_accept = True
                 self._node_srv.raylet_set_accept(True)
             self._accept_thread = threading.Thread(
@@ -400,6 +439,9 @@ class Scheduler:
                                    for n in self.gcs.list_nodes()}
         except Exception:
             pass
+        self._has_peers = any(
+            nid != self.node_id and n.alive
+            for nid, n in self._cluster_nodes.items())
         self._sched_thread = threading.Thread(
             target=self._schedule_loop, name="sched-loop", daemon=True
         )
@@ -477,6 +519,16 @@ class Scheduler:
     # Public API (called from the driver thread and from worker readers)
     # ------------------------------------------------------------------
     def submit(self, spec: TaskSpec):
+        # Queue-time spillback (scheduling_policy.hybrid_decide): a task
+        # headed for a saturated node is scored against the cached
+        # cluster view and forwarded NOW, at submission, instead of
+        # parking in the backlog until a heartbeat tick notices.  Single
+        # node: _has_peers is False and this costs one falsy check.
+        if (self._has_peers and not self._shutdown
+                and self._spill_eligible(spec)):
+            spec.retries_left = spec.max_retries
+            if self._queue_time_spill(spec):
+                return
         # Fast lane: plain stateless tasks go straight into the native
         # raylet queue — no Python scheduler state, no lock.  Dispatch,
         # resource accounting, and completion run in C++ (see
@@ -537,7 +589,16 @@ class Scheduler:
 
         Plain specs ride this node's native lane (C++ dispatch even in a
         multi-node cluster); the origin is notified from the event merge
-        when the ring reports the task terminal."""
+        when the ring reports the task terminal.
+
+        A spec that arrives while THIS node is saturated was spilled on a
+        stale view: re-run the queue-time decision so it relays onward
+        (capped by RTPU_MAX_SPILLS) instead of sitting in a second
+        backlog until the balancer tick."""
+        if (self._has_peers and not self._shutdown
+                and self._spill_eligible(spec)
+                and self._queue_time_spill(spec)):
+            return
         if (self._lane_accept and not self._draining
                 and not self._shutdown and is_plain_task(spec)
                 and self._native_can_take(spec)):
@@ -559,6 +620,103 @@ class Scheduler:
             self._task_index[spec.task_id] = spec
             self._record_task_event(spec, "PENDING")
             self._wake.notify_all()
+
+    def _spill_eligible(self, spec: TaskSpec) -> bool:
+        """Specs the queue-time fast path may forward: plain tasks with
+        no placement pin.  Everything pinned or policy-routed (actors,
+        PGs, labels, affinity) keeps its existing lane."""
+        return (spec.kind == TASK
+                and spec.pg_id is None
+                and spec.node_affinity is None
+                and not spec.label_selector
+                and not spec.label_selector_soft
+                and spec.spill_count < self._max_spills)
+
+    def _local_load(self) -> tuple[dict, int]:
+        """(available, queued) for the spill decision, from the resource
+        ledger + both pending lanes.  Cached ~5ms: a submit storm must
+        not pay a native-ledger mutex round-trip per task, and view
+        staleness under 5ms is noise next to the 250ms heartbeat the
+        decision used to wait for.  The cache is a MUTABLE optimistic
+        view — _note_local_queue debits it per locally-queued task, so a
+        sub-millisecond burst sees its own load instead of a frozen
+        idle snapshot (the same trick commit_spill plays on the cached
+        view of peers)."""
+        now = time.monotonic()
+        cached = self._load_cache
+        if cached is not None and now - cached[0] < 0.005:
+            return cached[1], cached[2]
+        try:
+            avail = dict(self._res_snapshot())
+        except Exception:
+            avail = dict(self.total_resources)
+        queued = len(self._pending)
+        if self._raylet_native:
+            try:
+                queued += int(
+                    self._node_srv.raylet_stats().get("pending", 0))
+            except Exception:
+                pass
+        self._load_cache = [now, avail, queued]
+        return avail, queued
+
+    def _note_local_queue(self, spec: TaskSpec):
+        """Book a keep-it-local decision on the cached load view: debit
+        availability while it covers the ask, count backlog once it
+        doesn't."""
+        cached = self._load_cache
+        if cached is None:
+            return
+        avail = cached[1]
+        res = spec.resources or {}
+        if all(avail.get(k, 0) >= v for k, v in res.items()):
+            for k, v in res.items():
+                avail[k] = avail.get(k, 0) - v
+        else:
+            cached[2] += 1
+
+    def _queue_time_spill(self, spec: TaskSpec) -> bool:
+        """Score a submit against the cached cluster view with the
+        hybrid policy; True when the spec was handed to a peer (the
+        caller must not queue it locally).  Local-first: below the
+        utilization threshold this is a snapshot read and one compare."""
+        if self._draining:
+            return False
+        t0 = time.monotonic()
+        avail, queued = self._local_load()
+        util = policy_mod.node_utilization(
+            avail, self.total_resources, queued)
+        if util < self._spill_threshold:
+            self._note_local_queue(spec)
+            return False
+        target = policy_mod.hybrid_decide(
+            spec, self.node_id, self.total_resources, self._cluster_nodes,
+            local_utilization=util,
+            threshold=self._spill_threshold,
+            top_k=self._spill_top_k)
+        try:
+            m = _self_metrics()
+            m["spill_decision"].observe(time.monotonic() - t0)
+        except Exception:
+            m = None
+        if target is None:
+            self._note_local_queue(spec)
+            if m is not None:
+                m["spill_local"].inc()
+            return False
+        with self._lock:
+            if self._shutdown:
+                return False
+            forwarded = self._forward(spec, target)
+        if forwarded:
+            policy_mod.commit_spill(spec, target, self._cluster_nodes)
+            if m is not None:
+                m["spill_remote"].inc()
+        else:
+            self._note_local_queue(spec)
+            if m is not None:
+                m["spill_local"].inc()
+        return forwarded
 
     def _evict_task_events_locked(self):
         """Drop the oldest TERMINAL entries past the cap — O(1) amortized
@@ -1001,12 +1159,16 @@ class Scheduler:
         return True
 
     def _balance_native_backlog(self, nodes, alive):
-        """Spillback bridge for the multi-node native lane: when the C++
-        queue holds more work than this node can absorb (idle workers +
-        spawnable headroom) and a live peer advertises free CPU, steal
-        just that excess off the BACK of the native queue and push it to
-        the Python policy path, whose load-aware placement forwards it.
-        The oldest tasks keep their native dispatch position; a node with
+        """SLOW-PATH rebalancer for the multi-node native lane.  Placement
+        is decided at queue time now (submit -> _queue_time_spill, the
+        hybrid policy in scheduling_policy.py); this heartbeat pass only
+        corrects stale-view mistakes — work that landed in the C++ queue
+        while the cached cluster view was wrong (peer died, peer freed up,
+        burst raced the 5ms load cache).  When the C++ queue holds more
+        than this node can absorb and a live peer advertises free CPU, it
+        steals just that excess off the BACK of the native queue and hands
+        it to the Python policy path, whose placement forwards it.  The
+        oldest tasks keep their native dispatch position; a node with
         local capacity never gives work away."""
         try:
             st = self._node_srv.raylet_stats()
@@ -1155,8 +1317,9 @@ class Scheduler:
                         actor_id, state=gcs_mod.DEAD,
                         death_cause="killed before placement")
                     self._cleanup_actor_kv(actor_id)
-                    # Drop queued creation/method tasks for it.
-                    for spec in [s for s in self._pending
+                    # Drop queued creation/method tasks for it (actor
+                    # specs only ever sit on the routed lane).
+                    for spec in [s for s in self._pending.routed
                                  if s.actor_id == actor_id]:
                         self._pending.remove(spec)
                         self._fail_task(spec, ActorDiedError(
@@ -1401,7 +1564,8 @@ class Scheduler:
                 # per-pending-task resource asks (autoscaler demand signal;
                 # capped so a 1M-task backlog doesn't bloat the snapshot)
                 "pending_demand": [
-                    dict(s.resources or {}) for s in list(self._pending)[:512]
+                    dict(s.resources or {})
+                    for s in self._pending.head(512)
                 ],
                 "available_resources": self._res_snapshot(),
                 "total_resources": dict(self.total_resources),
@@ -2208,14 +2372,19 @@ class Scheduler:
                         pass
                 self.gcs.heartbeat(self.node_id, available, queued)
                 try:
-                    _self_metrics()["queue_depth"].set(queued)
+                    m = _self_metrics()
+                    m["queue_depth"].set(queued)
+                    m["backlog"].set(
+                        queued, {"node": self.node_id.hex()[:12]})
                 except Exception:
                     pass
                 if self.is_head:
                     self.gcs.check_node_health()
                 nodes = {n.node_id: n for n in self.gcs.list_nodes()}
                 self._cluster_nodes = nodes
+                self._load_cache = None  # fresh view: re-snapshot load
                 alive = {i for i, n in nodes.items() if n.alive}
+                self._has_peers = bool(alive - {self.node_id})
                 newly_dead = self._known_alive - alive
                 self._known_alive = alive
                 for nid in newly_dead:
@@ -2326,19 +2495,16 @@ class Scheduler:
                 del self._forwarded[tid]
                 spec.origin_node = None
                 spec.spill_count = 0
-                if spec.kind == ACTOR_METHOD:
-                    # requeue: routes to the restarted actor, or fails via
-                    # the DEAD-actor check in the scheduling loop
-                    self._pending.appendleft(spec)
-                    self._task_index[spec.task_id] = spec
-                elif spec.retries_left > 0:
-                    spec.retries_left -= 1
-                    self._pending.appendleft(spec)
-                    self._task_index[spec.task_id] = spec
-                else:
-                    self._fail_task(spec, WorkerCrashedError(
-                        f"node {node_id.hex()[:8]} died executing "
-                        f"{spec.name}"))
+                # A forwarded spec was lost at the SCHEDULING level — the
+                # target died holding it, possibly before ever leasing a
+                # worker — so requeue without charging retries_left
+                # (reference: lease failures retry placement regardless of
+                # max_retries; only execution-level deaths consume a
+                # retry).  If the peer had already started the task this
+                # re-runs it once — the same at-least-once window the
+                # relay race documents in _forward.
+                self._pending.appendleft(spec)
+                self._task_index[spec.task_id] = spec
             self._wake.notify_all()
         if not self.is_head:
             return
@@ -2579,7 +2745,7 @@ class Scheduler:
                         self.gcs.update_actor(dead_actor, state=gcs_mod.DEAD,
                                               death_cause="worker died")
                         self._cleanup_actor_kv(dead_actor)
-                        for spec in [s for s in self._pending
+                        for spec in [s for s in self._pending.routed
                                      if s.actor_id == dead_actor]:
                             self._pending.remove(spec)
                             self._fail_task(spec, ActorDiedError(
@@ -2779,11 +2945,21 @@ class Scheduler:
         return info
 
     def _try_schedule_locked(self) -> bool:
-        """Dispatch as many pending tasks as possible; True if progress made."""
+        """Dispatch as many pending tasks as possible; True if progress made.
+
+        Two passes over PendingQueues: the ROUTED lane (actor methods,
+        PGs, labels, affinity) is scanned spec-by-spec — placement is a
+        property of each spec.  The SHAPE lane then dispatches plain
+        tasks bucket-by-bucket: schedulability there depends only on the
+        resource ask, so one blocked bucket head parks the whole shape
+        (reference: scheduling-class queues in cluster_task_manager.h)
+        and a million-deep backlog costs O(#shapes), not O(#tasks), per
+        wakeup."""
         progress = False
         remaining: deque[TaskSpec] = deque()
-        while self._pending:
-            spec = self._pending.popleft()
+        routed = self._pending.routed
+        while routed:
+            spec = routed.popleft()
             if spec.kind == ACTOR_METHOD:
                 worker_id = self._actor_workers.get(spec.actor_id)
                 info = self._actor_info_cached(spec.actor_id)
@@ -2955,7 +3131,60 @@ class Scheduler:
                          f"-> worker={w.worker_id.hex()[:8]}")
             self._dispatch(w, spec)
             progress = True
-        self._pending = remaining
+        self._pending.routed = remaining
+        # -- shape lane: plain tasks, one feasibility decision per shape --
+        for _key, q in self._pending.shape_buckets():
+            while q:
+                spec = q[0]
+                if self._draining:
+                    # drain: push forwardable work off this node first
+                    target = cluster_mod.pick_spill_target(
+                        spec, self.node_id, self.total_resources,
+                        self._cluster_nodes)
+                    if target is not None:
+                        q.popleft()
+                        if self._forward(spec, target):
+                            progress = True
+                            continue
+                        q.appendleft(spec)  # peer send failed: run here
+                    elif (spec.spill_count < self._max_spills
+                          and cluster_mod.peer_could_take(
+                              spec, self.node_id, self._cluster_nodes)):
+                        # no peer has room RIGHT NOW, but one could take
+                        # this shape once it frees up: hold it pending
+                        # (the reference raylet refuses new leases while
+                        # draining) instead of starting work here.  The
+                        # loop's 1s wait retries against a fresher view.
+                        break
+                granted = self._acquire_resources(spec)
+                if granted is None:
+                    target = cluster_mod.pick_spill_target(
+                        spec, self.node_id, self.total_resources,
+                        self._cluster_nodes)
+                    if target is not None:
+                        q.popleft()
+                        if self._forward(spec, target):
+                            progress = True
+                            continue
+                        q.appendleft(spec)
+                    # this shape can't start here now — every spec
+                    # behind the head would fail the same check
+                    break
+                w = self._find_idle_worker()
+                if w is None:
+                    self._return_resources(spec, granted)
+                    self._pool.maybe_grow()
+                    # no idle worker: no shaped spec can dispatch
+                    self._pending.prune_empty()
+                    return progress
+                q.popleft()
+                w.idle = False
+                w.held_resources = granted
+                w.held_pg = None
+                w.in_flight[spec.task_id] = spec
+                self._dispatch(w, spec)
+                progress = True
+        self._pending.prune_empty()
         return progress
 
     def _acquire_resources(self, spec: TaskSpec) -> Optional[dict]:

@@ -159,7 +159,9 @@ def _render_prometheus(per_node: list[dict]) -> str:
         # App metrics pushed by this node's processes.
         for source in snap["app"]:
             for m in source:
-                name = "ray_tpu_" + _prom_name(m["name"])
+                name = _prom_name(m["name"])
+                if not name.startswith("ray_tpu_"):
+                    name = "ray_tpu_" + name
                 kind = m.get("kind")
                 if kind not in ("counter", "gauge", "histogram"):
                     kind = "untyped"

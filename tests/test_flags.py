@@ -70,6 +70,17 @@ def test_typed_reads(monkeypatch):
     assert flags.get("RTPU_PROFILE_FLUSH_S") == 5.0
     monkeypatch.setenv("RTPU_PROFILE_FLUSH_S", "0.5")
     assert flags.get("RTPU_PROFILE_FLUSH_S") == 0.5
+    # queue-time spillback knobs (scheduling_policy hybrid top-k)
+    monkeypatch.delenv("RTPU_SPILL_THRESHOLD", raising=False)
+    assert flags.get("RTPU_SPILL_THRESHOLD") == 0.5
+    monkeypatch.setenv("RTPU_SPILL_THRESHOLD", "0.8")
+    assert flags.get("RTPU_SPILL_THRESHOLD") == 0.8
+    monkeypatch.setenv("RTPU_SPILL_THRESHOLD", "not-a-fraction")
+    assert flags.get("RTPU_SPILL_THRESHOLD") == 0.5  # default on garbage
+    monkeypatch.delenv("RTPU_SPILL_TOP_K", raising=False)
+    assert flags.get("RTPU_SPILL_TOP_K") == 4
+    monkeypatch.setenv("RTPU_SPILL_TOP_K", "2")
+    assert flags.get("RTPU_SPILL_TOP_K") == 2
     # data-service knobs (disaggregated input-data tier)
     monkeypatch.delenv("RTPU_DATA_CACHE_BYTES", raising=False)
     assert flags.get("RTPU_DATA_CACHE_BYTES") == 256 << 20

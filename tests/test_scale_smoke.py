@@ -8,8 +8,11 @@ regression that would stall the full envelope fails CI in minutes.
 """
 
 import json
+import os
 import subprocess
 import sys
+
+import pytest
 
 
 def test_scale_bench_quick_completes():
@@ -31,3 +34,28 @@ def test_scale_bench_quick_completes():
     assert records["pgs_nodes"]["pgs_created"] == \
         records["pgs_nodes"]["n_pgs"]
     assert records["pgs_nodes"]["n_nodes"] >= 3
+
+
+@pytest.mark.slow
+def test_scale_bench_big_envelope_tasks():
+    """The 1M-queued-task envelope (what `make bench-scale` records in
+    BENCH_scale.json): streamed submit, measured queue peak past 500k,
+    sustained dispatch.  Excluded from tier-1 (`-m 'not slow'`) — this
+    is minutes of wall clock."""
+    script = (
+        "import json\n"
+        "from ray_tpu._private.scale_bench import bench_tasks\n"
+        "r = bench_tasks(n_tasks=1_000_000)\n"
+        "print('BIG-ENVELOPE', json.dumps(r))\n")
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-4000:]
+    line = next(l for l in proc.stdout.splitlines()
+                if l.startswith("BIG-ENVELOPE"))
+    r = json.loads(line.split(" ", 1)[1])
+    assert r["completed"] == r["n_tasks"] == 1_000_000
+    assert r["queue_peak"] >= 500_000, r
+    assert r["dispatch_per_s"] > 10_000, r

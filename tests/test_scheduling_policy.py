@@ -1,0 +1,312 @@
+"""Queue-time hybrid top-k spillback policy (scheduling_policy.py).
+
+The policy is a PURE function over a cached cluster view (reference:
+hybrid_scheduling_policy.cc), so these tests exercise it directly — no
+cluster, no sockets: threshold boundary, deterministic top-k
+tie-breaking, infeasible-everywhere staying local, and the relay
+(stale-view re-spill) rules.  PendingQueues — the shape-indexed backlog
+structure the 1M envelope needs — is covered in the same file, plus one
+in-process two-node integration check that a saturated head forwards at
+QUEUE time (the spill counters move without waiting for a balancer
+tick).
+"""
+
+import os
+
+from ray_tpu._private import scheduling_policy as policy
+from ray_tpu._private.gcs import NodeInfo
+from ray_tpu._private.task_spec import TaskSpec
+
+
+def _spec(task_id=None, cpu=1.0, **kw):
+    return TaskSpec(
+        task_id=task_id or os.urandom(16), kind="task",
+        fn_id=b"\x00" * 20, args_blob=b"", return_ids=[os.urandom(20)],
+        resources={"CPU": cpu}, name="policy_test", **kw)
+
+
+def _node(nid, cpu_total=4.0, cpu_avail=None, alive=True, queued=0):
+    return NodeInfo(
+        node_id=nid, resources={"CPU": cpu_total}, alive=alive,
+        available={"CPU": cpu_total if cpu_avail is None else cpu_avail},
+        queued=queued)
+
+
+LOCAL = b"L" * 16
+
+
+def _view(*nodes):
+    out = {LOCAL: _node(LOCAL)}
+    for n in nodes:
+        out[n.node_id] = n
+    return out
+
+
+# -- node_utilization ----------------------------------------------------
+
+def test_utilization_fraction_of_most_constrained_resource():
+    assert policy.node_utilization({"CPU": 4.0}, {"CPU": 4.0}) == 0.0
+    assert policy.node_utilization({"CPU": 2.0}, {"CPU": 4.0}) == 0.5
+    assert policy.node_utilization({"CPU": 0.0}, {"CPU": 4.0}) == 1.0
+    # max over resources: TPU fully used dominates idle CPU
+    assert policy.node_utilization(
+        {"CPU": 4.0, "TPU": 0.0}, {"CPU": 4.0, "TPU": 4.0}) == 1.0
+
+
+def test_utilization_backlog_scores_past_saturation():
+    busy = policy.node_utilization({"CPU": 0.0}, {"CPU": 4.0})
+    backlogged = policy.node_utilization({"CPU": 0.0}, {"CPU": 4.0},
+                                         queued=8)
+    assert busy == 1.0
+    assert 1.0 < backlogged <= 2.0
+    deeper = policy.node_utilization({"CPU": 0.0}, {"CPU": 4.0},
+                                     queued=100)
+    assert deeper >= backlogged
+
+
+# -- hybrid_decide: threshold boundary ----------------------------------
+
+def test_below_threshold_stays_local():
+    view = _view(_node(b"P" * 16))
+    assert policy.hybrid_decide(
+        _spec(), LOCAL, {"CPU": 4.0}, view,
+        local_utilization=0.49, threshold=0.5) is None
+
+
+def test_at_threshold_spills_to_idle_peer():
+    view = _view(_node(b"P" * 16))
+    # exactly AT the threshold counts as crossed (>=), like the
+    # reference's spread_threshold comparison
+    assert policy.hybrid_decide(
+        _spec(), LOCAL, {"CPU": 4.0}, view,
+        local_utilization=0.5, threshold=0.5) == b"P" * 16
+
+
+def test_local_kept_when_still_least_utilized():
+    # local is past the threshold but every peer is WORSE: stay local
+    view = _view(_node(b"P" * 16, cpu_avail=0.0, queued=50))
+    assert policy.hybrid_decide(
+        _spec(), LOCAL, {"CPU": 4.0}, view,
+        local_utilization=0.75, threshold=0.5) is None
+
+
+# -- hybrid_decide: determinism + top-k ---------------------------------
+
+def test_deterministic_same_view_same_task_same_answer():
+    tid = os.urandom(16)
+    picks = set()
+    for _ in range(20):
+        view = _view(_node(b"A" * 16, cpu_avail=1.0),
+                     _node(b"B" * 16, cpu_avail=1.0),
+                     _node(b"C" * 16, cpu_avail=1.0))
+        picks.add(policy.hybrid_decide(
+            _spec(task_id=tid), LOCAL, {"CPU": 4.0}, view,
+            local_utilization=2.0, threshold=0.5, top_k=3))
+    assert len(picks) == 1
+
+
+def test_tie_break_is_node_id_order():
+    # equal utilization everywhere, an under-threshold candidate exists:
+    # the pick is the FIRST in (util, node_id) order — lowest node id
+    view = _view(_node(b"C" * 16), _node(b"A" * 16), _node(b"B" * 16))
+    assert policy.hybrid_decide(
+        _spec(), LOCAL, {"CPU": 4.0}, view,
+        local_utilization=2.0, threshold=0.5, top_k=3) == b"A" * 16
+
+
+def test_top_k_spreads_saturated_candidates_by_task_id():
+    # every candidate past the threshold: distinct tasks spread over the
+    # k least-utilized instead of dogpiling one node
+    def saturated_view():
+        return _view(_node(b"A" * 16, cpu_avail=1.0, queued=0),
+                     _node(b"B" * 16, cpu_avail=1.0, queued=0),
+                     _node(b"C" * 16, cpu_avail=1.0, queued=0))
+
+    picks = {policy.hybrid_decide(
+        _spec(), LOCAL, {"CPU": 4.0}, saturated_view(),
+        local_utilization=2.0, threshold=0.1, top_k=3)
+        for _ in range(64)}
+    assert len(picks) > 1  # spread happened
+    assert picks <= {b"A" * 16, b"B" * 16, b"C" * 16}
+
+
+def test_top_k_1_always_least_utilized():
+    for _ in range(16):
+        view = _view(_node(b"A" * 16, cpu_avail=3.0),
+                     _node(b"B" * 16, cpu_avail=1.0))
+        assert policy.hybrid_decide(
+            _spec(), LOCAL, {"CPU": 4.0}, view,
+            local_utilization=2.0, threshold=0.1, top_k=1) == b"A" * 16
+
+
+# -- hybrid_decide: feasibility + relay rules ---------------------------
+
+def test_infeasible_everywhere_falls_back_to_local_queue():
+    view = _view(_node(b"P" * 16, cpu_total=2.0))
+    assert policy.hybrid_decide(
+        _spec(cpu=8.0), LOCAL, {"CPU": 16.0}, view,
+        local_utilization=2.0, threshold=0.5) is None
+
+
+def test_dead_peers_are_not_candidates():
+    view = _view(_node(b"P" * 16, alive=False))
+    assert policy.hybrid_decide(
+        _spec(), LOCAL, {"CPU": 4.0}, view,
+        local_utilization=2.0, threshold=0.5) is None
+
+
+def test_draining_peers_are_not_candidates():
+    # a draining node advertises an EMPTY availability map (a busy node
+    # still advertises zeroed keys): it must never be picked, even by
+    # the saturated top-k spread
+    drained = NodeInfo(node_id=b"D" * 16, resources={"CPU": 4.0},
+                       alive=True, available={})
+    view = _view(drained)
+    assert policy.hybrid_decide(
+        _spec(), LOCAL, {"CPU": 4.0}, view,
+        local_utilization=2.0, threshold=0.5) is None
+    busy = _node(b"B" * 16, cpu_avail=0.0)
+    view = _view(drained, busy)
+    assert policy.hybrid_decide(
+        _spec(), LOCAL, {"CPU": 4.0}, view,
+        local_utilization=2.0, threshold=0.1) == b"B" * 16
+    # slow path too: locally infeasible, and the only peer whose TOTALS
+    # cover the ask is draining — wait, don't forward there
+    big_drained = NodeInfo(node_id=b"D" * 16, resources={"CPU": 16.0},
+                           alive=True, available={})
+    assert policy.pick_spill_target(
+        _spec(cpu=8.0), LOCAL, {"CPU": 4.0},
+        {LOCAL: _node(LOCAL), b"D" * 16: big_drained}) is None
+
+
+def test_stale_view_spill_is_respilled_not_dropped():
+    # A spec that arrived via spillback (origin set, one hop burned)
+    # landing on a NOW-saturated node is still eligible to relay onward.
+    spec = _spec(origin_node=b"O" * 16, spill_count=1)
+    view = _view(_node(b"P" * 16))
+    assert policy.hybrid_decide(
+        spec, LOCAL, {"CPU": 4.0}, view,
+        local_utilization=2.0, threshold=0.5) == b"P" * 16
+
+
+def test_spill_cap_settles_the_task():
+    from ray_tpu._private import flags
+
+    spec = _spec(origin_node=b"O" * 16,
+                 spill_count=flags.get("RTPU_MAX_SPILLS"))
+    view = _view(_node(b"P" * 16))
+    assert policy.hybrid_decide(
+        spec, LOCAL, {"CPU": 4.0}, view,
+        local_utilization=2.0, threshold=0.5) is None
+
+
+def test_commit_spill_debits_view_and_counts_hop():
+    spec = _spec(cpu=2.0)
+    view = _view(_node(b"P" * 16, cpu_avail=4.0))
+    policy.commit_spill(spec, b"P" * 16, view)
+    assert spec.spill_count == 1
+    assert view[b"P" * 16].available["CPU"] == 2.0
+
+
+# -- PendingQueues -------------------------------------------------------
+
+def test_pending_queues_shape_bucketing_and_deque_surface():
+    q = policy.PendingQueues()
+    plain1 = _spec(cpu=1.0)
+    plain2 = _spec(cpu=1.0)
+    big = _spec(cpu=4.0)
+    method = TaskSpec(task_id=os.urandom(16), kind="actor_method",
+                      fn_id=b"", args_blob=b"", return_ids=[],
+                      actor_id=os.urandom(16), method_name="f")
+    for s in (plain1, method, plain2, big):
+        q.append(s)
+    assert len(q) == 4
+    assert all(s in q for s in (plain1, plain2, big, method))
+    # routed lane holds ONLY the actor method
+    assert list(q.routed) == [method]
+    # same shape -> same bucket, FIFO; different shape -> different bucket
+    buckets = dict(q.shape_buckets())
+    assert list(buckets[policy.shape_key(plain1)]) == [plain1, plain2]
+    assert list(buckets[policy.shape_key(big)]) == [big]
+    q.remove(plain1)
+    assert plain1 not in q and len(q) == 3
+    q.appendleft(plain1)
+    assert list(dict(q.shape_buckets())[policy.shape_key(plain1)])[0] \
+        is plain1
+    assert len(q.head(2)) == 2 and len(q.head(99)) == 3 + 1
+
+
+def test_pending_queues_routed_predicate():
+    assert not policy.is_routed(_spec())
+    assert policy.is_routed(_spec(pg_id=os.urandom(16)))
+    assert policy.is_routed(_spec(node_affinity=b"N" * 16))
+    assert policy.is_routed(_spec(label_selector={"zone": "a"}))
+    # soft label preference is scoring-only: still shape-schedulable
+    assert not policy.is_routed(_spec(label_selector_soft={"zone": "a"}))
+
+
+# -- integration: the decision happens at QUEUE time --------------------
+
+def test_queue_time_spill_forwards_without_balancer_tick():
+    """Saturate a 2-CPU head with long tasks on a 2-node cluster: the
+    overflow must be FORWARDED at submission (spill counters move, both
+    nodes execute) — placement decided by submit(), not by waiting for
+    the heartbeat balancer to steal."""
+    import subprocess
+    import sys
+
+    script = r"""
+import faulthandler
+import sys
+import time
+
+# hang forensics: dump every thread and die loudly BEFORE the outer
+# subprocess timeout would eat the evidence (same trick as conftest.py)
+faulthandler.dump_traceback_later(150, exit=True, file=sys.stderr)
+import ray_tpu
+import ray_tpu.api as api
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu._private import scheduler as sched_mod
+
+cluster = Cluster(initialize_head=True,
+                  head_node_args={"min_workers": 0, "max_workers": 4,
+                                  "resources": {"CPU": 2.0},
+                                  "object_store_memory": 1 << 26})
+cluster.add_node(min_workers=0, max_workers=4,
+                 resources={"CPU": 2.0}, object_store_memory=1 << 26)
+ray_tpu.init(_existing_node=cluster.head_node)
+cluster.wait_for_nodes(timeout=60)
+# the queue-time decision reads the head's CACHED view; wait one
+# heartbeat for it to learn the peer exists (production submits in
+# that window just stay local)
+sched = cluster.head_node.scheduler
+deadline = time.monotonic() + 30
+while not sched._has_peers and time.monotonic() < deadline:
+    time.sleep(0.05)
+assert sched._has_peers, "head never saw the peer in its cached view"
+
+@ray_tpu.remote(num_cpus=1)
+def where():
+    import os, time
+    time.sleep(0.4)
+    return os.environ["RAY_TPU_NODE_ID"]
+
+refs = [where.remote() for _ in range(8)]
+nodes = set(ray_tpu.get(refs, timeout=120))
+m = sched_mod._self_metrics()
+spilled = sum(m["spill_remote"]._values.values())
+assert len(nodes) == 2, f"one node ran everything: {nodes}"
+assert spilled > 0, "no queue-time spill decision was recorded"
+decisions = m["spill_decision"]._snapshot()["hist"]
+assert decisions, "spill-decision latency histogram is empty"
+print("QUEUE-TIME-SPILL-OK", spilled)
+ray_tpu.shutdown()
+cluster.shutdown()
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=170,
+                          env=env, cwd=os.path.dirname(
+                              os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "QUEUE-TIME-SPILL-OK" in proc.stdout
