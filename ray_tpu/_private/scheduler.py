@@ -275,6 +275,12 @@ class Scheduler:
         # them even while their main loop is busy executing a task.
         self._profiles: "OrderedDict[str, dict]" = OrderedDict()
         self._profile_cap = max(1, int(flags.get("RTPU_PROFILE_CAP")))
+        # Goodput/step-anatomy records (util/goodput.py trackers flush here
+        # over the control socket, "goodput_push" — same lane as
+        # spans_push/profiles_push): (run, source) -> latest record, oldest
+        # evicted past RTPU_GOODPUT_CAP (read at bank time so tests can
+        # retune it without a scheduler restart).
+        self._goodput: "OrderedDict[tuple, dict]" = OrderedDict()
         self._profiler_conns: dict[bytes, object] = {}
         self._profile_cv = threading.Condition(self._lock)
         self._profile_pending: dict[str, int] = {}  # stop replies awaited
@@ -941,6 +947,44 @@ class Scheduler:
                                  if k[0] and not str(k[0])
                                  .startswith("thread:")}),
             } for pid_, prof in self._profiles.items()]
+
+    # -- goodput plane (see util/goodput.py) ------------------------------
+
+    def _bank_goodput(self, rec: dict):
+        """Bank one pushed goodput record ("goodput_push").  A tracker
+        pushes cumulative snapshots, so the latest record per (run, source)
+        supersedes earlier ones; oldest keys evicted past
+        RTPU_GOODPUT_CAP."""
+        run = rec.get("run")
+        if not isinstance(run, str) or not run:
+            return
+        cap = max(1, int(flags.get("RTPU_GOODPUT_CAP")))
+        key = (run, str(rec.get("source") or ""))
+        rec.setdefault("node", self.node_id.hex())
+        with self._lock:
+            if key not in self._goodput:
+                while len(self._goodput) >= cap:
+                    self._goodput.popitem(last=False)
+            self._goodput[key] = rec
+            self._goodput.move_to_end(key)
+
+    def _list_goodput(self) -> list[dict]:
+        with self._lock:
+            return [{
+                "run": run, "source": src, "node": rec.get("node"),
+                "rank": rec.get("rank"), "ts": rec.get("ts"),
+                "steps": rec.get("steps"),
+                "elapsed_s": rec.get("elapsed_s"),
+                "goodput_fraction":
+                    (rec.get("fractions") or {}).get("goodput"),
+                "tokens_per_sec_steady": rec.get("tokens_per_sec_steady"),
+                "mfu": rec.get("mfu"),
+            } for (run, src), rec in self._goodput.items()]
+
+    def _get_goodput(self, run: str) -> list[dict]:
+        with self._lock:
+            return [dict(rec) for (r, _src), rec in self._goodput.items()
+                    if r == run]
 
     def _profiler_conns_snapshot(self) -> list:
         with self._lock:
@@ -2010,6 +2054,16 @@ class Scheduler:
             return self._get_profile(params["profile_id"])
         if method == "list_profiles":
             return self._list_profiles()
+        if method == "goodput_push":
+            # Goodput/step-anatomy records from this node's trainers
+            # (util/goodput.py flush/close).
+            for rec in params.get("records") or ():
+                self._bank_goodput(rec)
+            return True
+        if method == "list_goodput":
+            return self._list_goodput()
+        if method == "get_goodput":
+            return self._get_goodput(params["run"])
         if method == "profile_start":
             return self._profile_start(params["profile_id"],
                                        float(params.get("hz") or 99.0))

@@ -40,6 +40,7 @@ _INDEX_HTML = """<!doctype html><title>ray_tpu dashboard API</title>
 <li><a href="/api/data/jobs">/api/data/jobs (data-service jobs; ?job=&lt;name&gt; for one)</a></li>
 <li><a href="/api/traces">/api/traces (distributed traces; ?trace_id=&lt;hex&gt; for one tree)</a></li>
 <li><a href="/api/profile">/api/profile (CPU profiles; ?id=&lt;profile_id&gt;&amp;format=speedscope|folded|raw)</a></li>
+<li><a href="/api/goodput">/api/goodput (training goodput/step anatomy; ?run=&lt;name&gt; for one run)</a></li>
 <li><a href="/metrics">/metrics (Prometheus)</a></li>
 </ul>"""
 
@@ -466,6 +467,32 @@ class DashboardHead:
                 continue
         return profiling.merge_profiles(parts)
 
+    def _goodput_rows(self):
+        """Merged per-run goodput summary rows from every node."""
+        from ray_tpu.util import goodput as goodput_mod
+
+        rows = []
+        for sock in self._sched_socks():
+            try:
+                rows.extend(_node_rpc(sock, "list_goodput"))
+            except Exception:
+                continue
+        return goodput_mod.merge_goodput_rows(rows)
+
+    def _goodput_get(self, run: str):
+        """One run's records assembled cluster-wide (same shape as
+        ray_tpu.util.state.get_goodput)."""
+        from ray_tpu.util import goodput as goodput_mod
+
+        records = []
+        for sock in self._sched_socks():
+            try:
+                records.extend(_node_rpc(sock, "get_goodput",
+                                         {"run": run}))
+            except Exception:
+                continue
+        return goodput_mod.merge_records(records)
+
     # -- server ------------------------------------------------------------
     def _run(self):
         from aiohttp import web
@@ -595,9 +622,26 @@ class DashboardHead:
             return web.Response(text=json.dumps(data, default=str),
                                 content_type="application/json")
 
+        async def goodput(request):
+            # /api/goodput              -> per-run summary rows
+            # /api/goodput?run=<name>   -> one run merged cluster-wide
+            run = request.query.get("run") or None
+            if run is None:
+                rows = await loop.run_in_executor(None, self._goodput_rows)
+                return web.Response(text=json.dumps(rows, default=str),
+                                    content_type="application/json")
+            rec = await loop.run_in_executor(None, self._goodput_get, run)
+            if rec is None:
+                return web.Response(
+                    text=json.dumps({"error": f"no goodput run {run}"}),
+                    content_type="application/json", status=404)
+            return web.Response(text=json.dumps(rec, default=str),
+                                content_type="application/json")
+
         app.router.add_get("/api/data/jobs", data_jobs)
         app.router.add_get("/api/traces", traces)
         app.router.add_get("/api/profile", profile)
+        app.router.add_get("/api/goodput", goodput)
         app.router.add_get("/metrics", metrics)
 
         async def start():

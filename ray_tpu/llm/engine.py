@@ -28,6 +28,59 @@ from ray_tpu.llm import model as lm
 from ray_tpu.llm.paged_cache import CacheConfig, PageAllocator, init_cache
 from ray_tpu.models.llama import LlamaConfig
 
+# Serving observability (ISSUE 8): the engine-local stats() dict stays the
+# cheap in-process view, but the same events also feed util.metrics so
+# TTFT/TPOT/e2e land on /metrics as real histograms and ride the existing
+# metrics push plane.  Created lazily once per process; every engine in
+# the process shares the instruments.
+_METRICS = None
+_metrics_lock = threading.Lock()
+
+
+def _engine_metrics():
+    global _METRICS
+    with _metrics_lock:
+        if _METRICS is None:
+            from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+            _METRICS = {
+                "ttft": Histogram(
+                    "llm_ttft_s", "Time to first token (submit -> first "
+                    "emitted token)"),
+                "tpot": Histogram(
+                    "llm_tpot_s", "Time per output token after the first "
+                    "(decode steady state)"),
+                "e2e": Histogram(
+                    "llm_e2e_s", "End-to-end request latency (submit -> "
+                    "stream end)"),
+                "queue_wait": Histogram(
+                    "llm_queue_wait_s", "Submit -> admission wait (slot + "
+                    "pages available)"),
+                "prefill_t": Histogram(
+                    "llm_prefill_s", "Prefill compute time per request"),
+                "prefills": Counter(
+                    "llm_prefills_total", "Prefill executions"),
+                "decode_steps": Counter(
+                    "llm_decode_steps_total", "Batched decode steps"),
+                "tokens": Counter(
+                    "llm_tokens_total", "Tokens emitted to callers"),
+                "admitted": Counter(
+                    "llm_admitted_total", "Requests admitted to slots"),
+                "preempted": Counter(
+                    "llm_preempted_total", "Requests preempted/evicted "
+                    "from their slot"),
+                "active_slots": Gauge(
+                    "llm_active_slots", "Decode slots currently occupied"),
+                "free_pages": Gauge(
+                    "llm_free_pages", "Allocatable KV-cache pages free"),
+                "page_occupancy": Gauge(
+                    "llm_page_occupancy", "Fraction of allocatable KV "
+                    "pages in use"),
+                "waiting": Gauge(
+                    "llm_waiting", "Requests queued awaiting admission"),
+            }
+        return _METRICS
+
 
 def _inject_kv_pages_impl(cache_k, cache_v, idx, kv_k, kv_v):
     """Scatter shipped KV pages into the paged cache (P/D decode side).
@@ -79,6 +132,8 @@ class _Request:
     kind: str = "normal"
     first_token: Optional[int] = None  # decode_kv: token prefill sampled
     kv: Optional[tuple] = None  # decode_kv: (kv_k, kv_v) page arrays
+    first_token_at: Optional[float] = None  # monotonic ts of first emit
+    emitted: int = 0  # tokens delivered to the caller
 
 
 @dataclass
@@ -126,6 +181,8 @@ class LLMEngine:
         # compute times, rings of the last 128.
         self._queue_waits: "deque[float]" = deque(maxlen=128)
         self._prefill_times: "deque[float]" = deque(maxlen=128)
+        self._m = _engine_metrics()
+        self._gauges_at = 0.0  # last gauge refresh (throttled in _loop)
 
     # ------------------------- public API ---------------------------------
 
@@ -260,8 +317,33 @@ class LLMEngine:
         while not self._stop.is_set():
             admitted = self._admit()
             stepped = self._decode_all()
+            now = time.monotonic()
+            if now - self._gauges_at >= 0.25:
+                self._gauges_at = now
+                self._refresh_gauges()
             if not admitted and not stepped:
                 time.sleep(0.002)
+
+    def _refresh_gauges(self):
+        m = self._m
+        free = self.allocator.num_free()
+        allocatable = self.cfg.num_pages - 1  # page 0 is the null page
+        m["active_slots"].set(sum(s is not None for s in self._slots))
+        m["free_pages"].set(free)
+        if allocatable > 0:
+            m["page_occupancy"].set(1.0 - free / allocatable)
+        m["waiting"].set(self._waiting.qsize())
+
+    def _finish_request(self, req: _Request):
+        """Latency histograms at stream end (successful finishes only;
+        prefill_only requests are half a request and are skipped)."""
+        if req.kind == "prefill_only":
+            return
+        now = time.monotonic()
+        self._m["e2e"].observe(now - req.submitted_at)
+        if req.first_token_at is not None and req.emitted > 1:
+            self._m["tpot"].observe(
+                (now - req.first_token_at) / (req.emitted - 1))
 
     def _admit(self) -> bool:
         """Move waiting requests into free slots while pages last
@@ -345,6 +427,7 @@ class LLMEngine:
                          num_tokens=len(req.prompt_tokens),
                          last_token=last, rng=rng)
             if last in req.params.stop_token_ids:
+                self._finish_request(req)
                 req.out_queue.put(None)
                 self.allocator.free(pages)
             else:
@@ -356,6 +439,7 @@ class LLMEngine:
                 else:
                     self._emit(slot, last)
                 if len(slot.generated) >= req.params.max_tokens:
+                    self._finish_request(req)
                     req.out_queue.put(None)
                     self.allocator.free(pages)
                 else:
@@ -383,9 +467,14 @@ class LLMEngine:
             jnp.asarray(slot_positions), self.model_cfg)
         out = self._sample_one(np.asarray(logits), req.params, rng)
         self._stats["prefills"] += 1
-        self._prefill_times.append(time.monotonic() - t0)
+        dt = time.monotonic() - t0
+        self._prefill_times.append(dt)
         self._queue_waits.append(t0 - req.submitted_at)
         self._stats["admitted"] += 1
+        self._m["prefills"].inc()
+        self._m["admitted"].inc()
+        self._m["prefill_t"].observe(dt)
+        self._m["queue_wait"].observe(max(0.0, t0 - req.submitted_at))
         return out
 
     def _decode_all(self) -> bool:
@@ -446,6 +535,7 @@ class LLMEngine:
             rows = np.asarray(jnp.stack(steps)) if burst > 1 else [
                 np.asarray(steps[0])]
             self._stats["decode_steps"] += burst
+            self._m["decode_steps"].inc(burst)
             for row in rows:
                 for i, s in active_slots:
                     if self._slots[i] is not s:
@@ -458,6 +548,7 @@ class LLMEngine:
             jnp.asarray(active), self.model_cfg)
         logits_np = np.asarray(logits)
         self._stats["decode_steps"] += 1
+        self._m["decode_steps"].inc()
         for i, s in active_slots:
             tok = self._sample_one(logits_np[i], s.request.params, s.rng)
             self._accept_token(i, s, tok)
@@ -468,6 +559,7 @@ class LLMEngine:
         s.num_tokens += 1  # last_token's KV is now in the cache
         sp = s.request.params
         if tok in sp.stop_token_ids:
+            self._finish_request(s.request)
             s.request.out_queue.put(None)
             self.allocator.free(s.pages)
             self._slots[i] = None
@@ -475,6 +567,7 @@ class LLMEngine:
         s.generated.append(tok)
         self._emit(s, tok)
         if len(s.generated) >= sp.max_tokens:
+            self._finish_request(s.request)
             s.request.out_queue.put(None)
             self.allocator.free(s.pages)
             self._slots[i] = None
@@ -483,7 +576,13 @@ class LLMEngine:
 
     def _emit(self, slot: _Slot, token: int):
         self._stats["tokens_generated"] += 1
-        slot.request.out_queue.put(int(token))
+        req = slot.request
+        req.emitted += 1
+        if req.first_token_at is None:
+            req.first_token_at = time.monotonic()
+            self._m["ttft"].observe(req.first_token_at - req.submitted_at)
+        self._m["tokens"].inc()
+        req.out_queue.put(int(token))
 
     def _sample_one(self, logits: np.ndarray, params: SamplingParams,
                     rng: Optional[np.random.Generator]) -> int:

@@ -33,7 +33,16 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ray_tpu.ops.attention import NEG_INF, flash_attention, repeat_kv_heads
-from ray_tpu.parallel.sharding import to_partition_spec
+from ray_tpu.parallel.sharding import shard_map, to_partition_spec
+
+
+def _axis_size(axis_name: str) -> int:
+    """``jax.lax.axis_size`` across versions: older jax lacks it; there
+    ``psum(1, axis)`` is statically resolved to the same number."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
 
 
 def _shard_positions(idx, s_loc: int, sp: int, layout: str):
@@ -94,7 +103,7 @@ def ring_attention(
     ``impl="zigzag"`` uses; correctness is exact for both layouts (masks
     compare true global positions).
     """
-    sp = jax.lax.axis_size(axis_name)
+    sp = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
     if sm_scale is None:
@@ -168,7 +177,7 @@ def ulysses_attention(
     holds the FULL sequence for heads/sp heads and runs dense (flash)
     attention locally; a reverse all-to-all restores sequence sharding.
     """
-    sp = jax.lax.axis_size(axis_name)
+    sp = _axis_size(axis_name)
     h = q.shape[2]
     if h % sp != 0:
         raise ValueError(f"ulysses needs heads ({h}) % sp ({sp}) == 0")
@@ -246,7 +255,7 @@ def sequence_parallel_attention(
             ql, kl, vl, sp_axis, causal=causal, sm_scale=sm_scale,
             layout="zigzag" if impl == "zigzag" else "contiguous")
 
-    out = jax.shard_map(
+    out = shard_map(
         local, mesh=mesh,
         in_specs=(q_spec, kv_spec, kv_spec),
         out_specs=q_spec,

@@ -1,0 +1,7 @@
+"""Parallelism layer: device mesh, logical sharding rules, comm estimator.
+
+Submodules import lazily on purpose: ``mesh``/``sharding`` pull in jax,
+while ``comm`` (the analytic per-axis collective-volume estimator behind
+``rtpu comm``) is pure arithmetic and must stay importable from the CLI
+without initializing a backend.
+"""

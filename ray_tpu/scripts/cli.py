@@ -317,6 +317,131 @@ def cmd_profile(args):
               f"{row['frame']}")
 
 
+def cmd_goodput(args):
+    """Training goodput/step anatomy: list instrumented runs, or print one
+    run's per-step anatomy split and badput table (records banked per node
+    by GoodputTracker pushes, merged here — see ray_tpu/util/goodput.py)."""
+    from ray_tpu.util import goodput as goodput_mod
+
+    sock = find_address(args.address)
+    nodes = [n for n in _rpc(sock, "list_nodes") if n["alive"]]
+
+    def _fanout(method, params=None):
+        out = []
+        for n in nodes:
+            try:
+                out.extend(_rpc(n["sched_socket"], method, params))
+            except Exception:
+                continue
+        return out
+
+    if not args.run:
+        rows = goodput_mod.merge_goodput_rows(_fanout("list_goodput"))
+        print("======== Goodput runs ========")
+        for r in rows:
+            age = time.time() - (r.get("ts") or 0)
+            gf = r.get("goodput_fraction") or 0.0
+            mfu = r.get("mfu")
+            tok = r.get("tokens_per_sec_steady")
+            extras = ""
+            if mfu is not None:
+                extras += f"mfu={mfu:.3f} "
+            if tok:
+                extras += f"tok/s={tok:,.0f} "
+            print(f"  {r['run']:24s} steps={r.get('steps') or 0:<6d} "
+                  f"goodput={gf * 100:5.1f}% {extras}{age:7.1f}s ago")
+        if not rows:
+            print("  (none — instrument a loop with "
+                  "ray_tpu.util.goodput.GoodputTracker)")
+        return
+
+    rec = goodput_mod.merge_records(
+        _fanout("get_goodput", {"run": args.run}))
+    if rec is None:
+        sys.exit(f"no goodput records for run {args.run!r}")
+    s = rec["summary"]
+    print(f"======== Goodput: {rec['run']} ========")
+    print(f"sources={rec['num_sources']} steps={s['steps']} "
+          f"restarts={s['restarts']} elapsed={s['elapsed_s']:.2f}s "
+          f"compile={s['compile_s']:.2f}s")
+    tok = s.get("tokens_per_sec_steady")
+    if tok:
+        print(f"steady-state throughput: {tok:,.0f} tok/s "
+              f"(post-warmup steps only)")
+    if s.get("mfu") is not None:
+        print(f"mfu: {s['mfu']:.3f} (counted flops per MFU_PROFILE.md)")
+    print("---- wall-time attribution (sums to elapsed) ----")
+    for name in goodput_mod.BUCKETS:
+        sec = s["buckets"].get(name, 0.0)
+        frac = s["fractions"].get(name, 0.0)
+        bar = "#" * int(round(frac * 40))
+        print(f"  {name:10s} {sec:9.2f}s {frac * 100:5.1f}%  {bar}")
+    anatomy = s.get("anatomy") or {}
+    if anatomy:
+        print("---- per-step anatomy (recent steps) ----")
+        print(f"  {'phase':10s} {'mean':>9s} {'p50':>9s} {'p90':>9s}")
+        for phase in (*goodput_mod.PHASES, "total"):
+            a = anatomy.get(phase)
+            if not a or (phase != "total" and not a.get("mean_ms")):
+                continue
+            print(f"  {phase:10s} {a['mean_ms']:8.1f}ms {a['p50_ms']:8.1f}ms "
+                  f"{a['p90_ms']:8.1f}ms")
+
+
+def cmd_comm(args):
+    """Analytic per-axis collective-volume estimate for a dense LM step
+    (ray_tpu/parallel/comm.py) — the ICI comm bound, no cluster needed."""
+    from ray_tpu.parallel import comm
+
+    if args.model:
+        preset = comm.MODEL_PRESETS.get(args.model)
+        if preset is None:
+            sys.exit(f"unknown model {args.model!r}; one of "
+                     f"{sorted(comm.MODEL_PRESETS)}")
+        cfg = dict(preset)
+    else:
+        cfg = {}
+    overrides = {"n_params": args.params, "n_layers": args.layers,
+                 "d_model": args.d_model, "d_kv": args.d_kv,
+                 "batch": args.batch, "seq": args.seq}
+    cfg.update({k: v for k, v in overrides.items() if v is not None})
+    missing = [k for k in ("n_params", "n_layers", "d_model", "batch",
+                           "seq") if not cfg.get(k)]
+    if missing:
+        sys.exit(f"missing {missing}; pass --model PRESET or the explicit "
+                 f"flags")
+    axes = comm.parse_mesh(args.mesh)
+    events = comm.estimate_train_comm(
+        axes, n_params=cfg["n_params"], n_layers=cfg["n_layers"],
+        d_model=cfg["d_model"], batch=cfg["batch"], seq=cfg["seq"],
+        dtype_bytes=args.dtype_bytes, d_kv=cfg.get("d_kv"))
+    total_dev = comm.mesh_total(axes)
+    print(f"======== Comm volume: {args.model or 'custom'} on "
+          f"mesh {axes} ({total_dev} devices) ========")
+    print(f"params={cfg['n_params']:,} batch={cfg['batch']} "
+          f"seq={cfg['seq']} dtype_bytes={args.dtype_bytes}")
+    if not events:
+        print("  (no collective traffic: every parallel axis has size 1)")
+        return
+    print(f"  {'axis':5s} {'op':15s} {'what':12s} {'events':>7s} "
+          f"{'MB/event':>9s} {'MB/step/dev':>12s}")
+    for ev in events:
+        print(f"  {ev.axis:5s} {ev.op:15s} {ev.what:12s} "
+              f"{ev.events_per_step:7d} "
+              f"{ev.bytes_per_event / 1e6:9.2f} "
+              f"{ev.bytes_per_step / 1e6:12.2f}")
+    s = comm.summarize(events, ici_gbps=args.ici_gbps,
+                       dcn_gbps=args.dcn_gbps)
+    print("---- per-axis totals (per device per step) ----")
+    for axis, nbytes in sorted(s.per_axis_bytes.items()):
+        rate = args.dcn_gbps if axis == "dcn" else args.ici_gbps
+        print(f"  {axis:5s} {nbytes / 1e6:10.2f} MB  "
+              f"-> {s.per_axis_seconds[axis] * 1e3:8.2f} ms "
+              f"@ {rate:g} GB/s")
+    print(f"total {s.total_bytes / 1e6:10.2f} MB; serialized lower bound "
+          f"{s.bound_seconds * 1e3:.2f} ms/step")
+
+
 def cmd_summary(args):
     from ray_tpu.util.state import summarize_events
 
@@ -561,6 +686,32 @@ def main(argv=None):
                     help="write the profile instead of printing: .json = "
                          "speedscope, .folded/.txt = folded stacks")
     sp.set_defaults(fn=cmd_profile)
+    sp = sub.add_parser("goodput")
+    sp.add_argument("run", nargs="?", default=None,
+                    help="run name to inspect (omit to list known runs)")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_goodput)
+    sp = sub.add_parser("comm")
+    sp.add_argument("--model", default=None,
+                    help="model preset (gpt2_124m, llama3_8b, "
+                         "llama3_8b_dry); explicit flags override")
+    sp.add_argument("--mesh", default="fsdp=8,tp=2",
+                    help='axis sizes, e.g. "dcn=2,fsdp=8,tp=2"')
+    sp.add_argument("--params", type=int, default=None)
+    sp.add_argument("--layers", type=int, default=None)
+    sp.add_argument("--d-model", type=int, default=None)
+    sp.add_argument("--d-kv", type=int, default=None,
+                    help="K/V width for sp ring-attention traffic "
+                         "(default d_model; GQA models are smaller)")
+    sp.add_argument("--batch", type=int, default=None,
+                    help="GLOBAL batch size")
+    sp.add_argument("--seq", type=int, default=None)
+    sp.add_argument("--dtype-bytes", type=int, default=2)
+    sp.add_argument("--ici-gbps", type=float, default=45.0,
+                    help="per-axis ICI link rate for the time bound")
+    sp.add_argument("--dcn-gbps", type=float, default=12.5,
+                    help="cross-slice DCN rate for the time bound")
+    sp.set_defaults(fn=cmd_comm)
     sp = sub.add_parser("microbenchmark")
     sp.set_defaults(fn=cmd_microbenchmark)
     sp = sub.add_parser("start")

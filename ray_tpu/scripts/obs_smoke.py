@@ -59,7 +59,10 @@ def main() -> int:
         trace = None
         while time.monotonic() < deadline:
             trace = state.get_trace(root.trace_id)
-            if trace["summary"]["num_spans"] >= 5:
+            # wait for the driver's spans too, not just the workers' —
+            # they flush on their own interval
+            if trace["summary"]["num_spans"] >= 5 and \
+                    trace["summary"]["num_processes"] >= 3:
                 break
             time.sleep(0.25)
         s = trace["summary"]
@@ -133,6 +136,98 @@ def main() -> int:
                    for line in folded.splitlines()), folded[:2000]
         print(f"profiling ok (profile {pid}: {prof['samples']} samples, "
               f"tasks {sorted(t for t in tasks if not t.startswith('thread:'))})")
+
+        # -- goodput / step anatomy -----------------------------------
+        # A tiny instrumented train loop (AOT-compiled matmul step) must
+        # produce a goodput report whose wall-time buckets sum to elapsed
+        # time, export the anatomy histograms + MFU gauge to /metrics,
+        # and surface through /api/goodput.
+        import jax
+        import numpy as np
+
+        from ray_tpu.util import goodput as goodput_mod
+
+        x0 = np.ones((256, 256), dtype=np.float32)
+        gp = goodput_mod.GoodputTracker(run="obs-smoke-train",
+                                        tokens_per_step=256)
+        with gp.compile_bracket():
+            compiled = jax.jit(lambda x: (x @ x.T).sum()).lower(x0).compile()
+        gp.set_flops_per_step(*goodput_mod.step_flops(
+            compiled, n_params=256 * 256, tokens=256))
+        for i in range(6):
+            with gp.step() as st:
+                with st.phase("data"):
+                    arr = x0 + i
+                with st.phase("h2d"):
+                    dev = jax.device_put(arr)
+                with st.phase("compute"):
+                    jax.block_until_ready(compiled(dev))
+        rep = gp.report()
+        assert rep["steps"] == 6 and rep["compile_s"] > 0, rep
+        bucket_sum = sum(rep["buckets"].values())
+        assert abs(bucket_sum - rep["elapsed_s"]) <= \
+            0.05 * rep["elapsed_s"], rep["buckets"]
+        assert rep["model_tflops_per_s"] is not None \
+            and rep["mfu"] is not None, rep
+        gp.close()  # final goodput_push to the node scheduler
+
+        want = ("# TYPE ray_tpu_train_step_s histogram",
+                "# TYPE ray_tpu_train_step_phase_s histogram",
+                "ray_tpu_train_mfu",
+                "ray_tpu_train_goodput_fraction")
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            text = _get(url + "/metrics")
+            if all(w in text for w in want):
+                break
+            time.sleep(0.5)
+        for w in want:
+            assert w in text, f"{w!r} missing from /metrics"
+
+        rows = json.loads(_get(url + "/api/goodput"))
+        assert any(r["run"] == "obs-smoke-train" for r in rows), rows
+        one = json.loads(_get(url + "/api/goodput?run=obs-smoke-train"))
+        assert one["summary"]["steps"] == 6, one
+        print(f"goodput ok (goodput={rep['fractions']['goodput']:.0%} "
+              f"compile={rep['compile_s'] * 1e3:.0f}ms "
+              f"mfu={rep['mfu']:.2%} of "
+              f"{rep['peak_tflops']:.0f} TFLOP/s peak)")
+
+        # -- serving metrics ------------------------------------------
+        # A short LLM-engine run must land TTFT/TPOT histograms and the
+        # prefill counter on /metrics.
+        from ray_tpu.llm.engine import (
+            EngineConfig,
+            LLMEngine,
+            SamplingParams,
+        )
+        from ray_tpu.models import llama
+
+        mcfg = llama.LlamaConfig(
+            vocab_size=128, d_model=64, n_layers=2, n_heads=4,
+            n_kv_heads=2, d_ff=128, max_seq_len=256, dtype="float32",
+            remat=False)
+        params = llama.init(mcfg, jax.random.PRNGKey(0))
+        eng = LLMEngine(params, mcfg, EngineConfig(
+            max_slots=2, num_pages=32, page_size=8, max_seq_len=256,
+            prefill_buckets=(16, 32)))
+        toks = eng.generate([1, 5, 9, 3], SamplingParams(max_tokens=8))
+        eng.stop()
+        assert len(toks) == 8, toks
+
+        want = ("# TYPE ray_tpu_llm_ttft_s histogram",
+                "# TYPE ray_tpu_llm_tpot_s histogram",
+                "# TYPE ray_tpu_llm_e2e_s histogram",
+                "ray_tpu_llm_prefills_total")
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            text = _get(url + "/metrics")
+            if all(w in text for w in want):
+                break
+            time.sleep(0.5)
+        for w in want:
+            assert w in text, f"{w!r} missing from /metrics"
+        print("serving metrics ok (ttft/tpot/e2e histograms live)")
         print("obs-smoke: PASS")
         return 0
     finally:

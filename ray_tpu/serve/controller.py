@@ -337,7 +337,7 @@ class ServeController:
         for _ in range(max(0, want)):
             replica = ray_tpu.remote(ReplicaActor).options(**opts).remote(
                 ds.cls_blob, ds.init_args_blob, ds.config.user_config,
-                ds.app_name)
+                ds.app_name, ds.name)
             with self._lock:
                 if ds.deleted:
                     # deleted between the `want` computation and now: the

@@ -10,9 +10,43 @@ from __future__ import annotations
 
 import inspect
 import threading
+import time as time_mod
 from typing import Any, Dict, Optional
 
 import cloudpickle
+
+# Request-latency instrumentation (ISSUE 8 serving side): histograms and
+# counters shared by every replica in the process, labelled per
+# app/deployment so /metrics separates them.  Lazy so importing the module
+# never touches the metrics registry.
+_METRICS = None
+_metrics_lock = threading.Lock()
+
+
+def _replica_metrics():
+    global _METRICS
+    with _metrics_lock:
+        if _METRICS is None:
+            from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+            tags = ("app", "deployment")
+            _METRICS = {
+                "latency": Histogram(
+                    "serve_request_latency_s",
+                    "Replica handle_request wall time (stream results "
+                    "count until stream registration)", tag_keys=tags),
+                "requests": Counter(
+                    "serve_requests_total", "Requests handled per replica "
+                    "deployment", tag_keys=tags),
+                "errors": Counter(
+                    "serve_errors_total", "Requests that raised",
+                    tag_keys=tags),
+                "ongoing": Gauge(
+                    "serve_ongoing_requests", "In-flight requests "
+                    "(streams stay in-flight until exhausted)",
+                    tag_keys=tags),
+            }
+        return _METRICS
 
 
 def _drain_async_gen(agen):
@@ -49,12 +83,15 @@ def _resolve_handles(obj, app_name: str):
 class ReplicaActor:
     def __init__(self, serialized_cls: bytes, init_args: bytes,
                  user_config: Optional[dict] = None,
-                 app_name: str = "default"):
+                 app_name: str = "default", deployment: str = ""):
         cls = cloudpickle.loads(serialized_cls)
         args, kwargs = cloudpickle.loads(init_args)
         args = _resolve_handles(args, app_name)
         kwargs = _resolve_handles(kwargs, app_name)
         self._user = cls(*args, **kwargs)
+        self._m = _replica_metrics()
+        self._mtags = {"app": app_name,
+                       "deployment": deployment or cls.__name__}
         self._ongoing = 0
         self._lock = threading.Lock()
         self._total = 0
@@ -86,6 +123,8 @@ class ReplicaActor:
             # autoscaling averages over look_back_period_s for the same
             # reason — instantaneous samples miss bursts)
             self._peak = max(self._peak, self._ongoing)
+            self._m["ongoing"].set(self._ongoing, tags=self._mtags)
+        t0 = time_mod.monotonic()
         model_id_token = None
         try:
             # Resolve forwarded DeploymentResponse refs (composition
@@ -120,13 +159,20 @@ class ReplicaActor:
                     "status": out.status, "headers": out.headers,
                     "body": out.body}}
             return out
+        except BaseException:
+            self._m["errors"].inc(tags=self._mtags)
+            raise
         finally:
             if model_id_token is not None:
                 from ray_tpu.serve import multiplex
 
                 multiplex._current_model_id.reset(model_id_token)
+            self._m["requests"].inc(tags=self._mtags)
+            self._m["latency"].observe(time_mod.monotonic() - t0,
+                                       tags=self._mtags)
             with self._lock:
                 self._ongoing -= 1
+                self._m["ongoing"].set(self._ongoing, tags=self._mtags)
 
     def _register_stream(self, out) -> dict:
         """Park a generator result; the proxy pulls chunks with

@@ -25,7 +25,6 @@ exist; the multichip sharding itself is validated by
 from __future__ import annotations
 
 import os
-import time
 from typing import Optional
 
 from ray_tpu.train.trainer import JaxTrainer
@@ -38,9 +37,17 @@ V5E16_MESH = {"fsdp": 8, "tp": 2}
 
 
 def llama3_train_loop(config: dict):
-    """Per-worker loop: mesh -> sharded state -> jitted step -> orbax."""
+    """Per-worker loop: mesh -> sharded state -> jitted step -> orbax.
+
+    Instrumented with the goodput/step-anatomy tracker (util/goodput.py):
+    the step is AOT-compiled under an explicit compile bracket (so the
+    compiled program's cost_analysis feeds the MFU gauge), each step is
+    split into data / h2d / compute / checkpoint phases, and the reported
+    ``tokens_per_sec`` is STEADY-STATE — post-warmup steps only, never
+    diluted by step-0 compile (``compile_s`` is reported separately).
+    """
     import jax
-    import jax.numpy as jnp
+    import numpy as np
 
     from ray_tpu import train
     from ray_tpu.models import llama
@@ -51,6 +58,7 @@ def llama3_train_loop(config: dict):
         default_optimizer,
         make_train_step,
     )
+    from ray_tpu.util import goodput as goodput_mod
 
     dry = config.get("dry_run", False)
     cfg = (llama.LlamaConfig.llama3_8b_dry() if dry
@@ -75,34 +83,79 @@ def llama3_train_loop(config: dict):
     with mesh:
         state = create_train_state(llama, cfg, mesh, opt,
                                    jax.random.PRNGKey(config.get("seed", 0)))
+        # Pin the output state to the input layout: the step is AOT-compiled
+        # below and iterated, so it must be a sharding fixed point.  Scalar
+        # leaves (the step counter) come back single-device — replicate
+        # them over the mesh so input and output trees agree.
+        rep = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+        state_sh = jax.tree_util.tree_map(
+            lambda x: x.sharding
+            if isinstance(x.sharding, jax.sharding.NamedSharding) else rep,
+            state)
+        state = jax.device_put(state, state_sh)
         step = make_train_step(llama, cfg, mesh, opt,
-                               attn_impl=config.get("attn_impl", "flash"))
-        rng = jax.random.PRNGKey(1234)
+                               attn_impl=config.get("attn_impl", "flash"),
+                               out_shardings=(state_sh, rep))
         tok_per_step = batch * seq_len
-        t0 = time.perf_counter()
+        run_name = config.get("run_name") or (
+            "llama3-8b-dry" if dry else "llama3-8b")
+        gp = goodput_mod.GoodputTracker(run=run_name,
+                                        tokens_per_step=tok_per_step)
+        np_rng = np.random.default_rng(config.get("seed", 0) + 1234)
+
+        def host_batch():
+            return np_rng.integers(0, cfg.vocab_size,
+                                   size=(batch, seq_len + 1),
+                                   dtype=np.int32)
+
+        # AOT-compile so compile time is bracketed apart from the steps
+        # and cost_analysis() prices the step for the MFU gauge.
+        first = jax.device_put(host_batch())
+        with gp.compile_bracket():
+            compiled = step.lower(state, first).compile()
+        params = state["params"] if isinstance(state, dict) \
+            and "params" in state else state
+        n_params = sum(int(x.size)
+                       for x in jax.tree_util.tree_leaves(params))
+        gp.set_flops_per_step(*goodput_mod.step_flops(
+            compiled, n_params=n_params, tokens=tok_per_step))
+
+        tokens = first
         for i in range(steps):
-            rng, k = jax.random.split(rng)
-            tokens = jax.random.randint(
-                k, (batch, seq_len + 1), 0, cfg.vocab_size,
-                dtype=jnp.int32)
-            state, metrics = step(state, tokens)
-            if (i + 1) % ckpt_every == 0 or i + 1 == steps:
-                loss = float(metrics["loss"])
-                dt = time.perf_counter() - t0
-                ckpt = None
-                ctx = train.get_context()
-                ckpt_dir = os.path.join(
-                    ctx.experiment_dir, f"ckpt-{i + 1:06d}",
-                    f"worker-{ctx.get_world_rank()}")
-                os.makedirs(ckpt_dir, exist_ok=True)
-                # sharded orbax save: each process persists its
-                # addressable shards; restore reshards onto any mesh
-                save_pytree(ckpt_dir, state)  # {params, opt_state, step}
-                ckpt = Checkpoint.from_directory(ckpt_dir)
-                train.report(
-                    {"loss": loss, "step": i + 1,
-                     "tokens_per_sec": tok_per_step * (i + 1) / dt},
-                    checkpoint=ckpt)
+            with gp.step() as st:
+                if i > 0:
+                    with st.phase("data"):
+                        batch_np = host_batch()
+                    with st.phase("h2d"):
+                        tokens = jax.device_put(batch_np)
+                with st.phase("compute"):
+                    state, metrics = compiled(state, tokens)
+                    jax.block_until_ready(metrics["loss"])
+                if (i + 1) % ckpt_every == 0 or i + 1 == steps:
+                    loss = float(metrics["loss"])
+                    ctx = train.get_context()
+                    ckpt_dir = os.path.join(
+                        ctx.experiment_dir, f"ckpt-{i + 1:06d}",
+                        f"worker-{ctx.get_world_rank()}")
+                    with st.phase("checkpoint"):
+                        os.makedirs(ckpt_dir, exist_ok=True)
+                        # sharded orbax save: each process persists its
+                        # addressable shards; restore reshards onto any
+                        # mesh
+                        save_pytree(ckpt_dir, state)
+                    ckpt = Checkpoint.from_directory(ckpt_dir)
+                    rep = gp.report()
+                    train.report(
+                        {"loss": loss, "step": i + 1,
+                         "tokens_per_sec":
+                             rep["tokens_per_sec_steady"] or 0.0,
+                         "compile_s": rep["compile_s"],
+                         "mfu": rep["mfu"],
+                         "model_tflops_per_s": rep["model_tflops_per_s"],
+                         "flops_source": rep["flops_source"],
+                         "goodput_fraction": rep["fractions"]["goodput"]},
+                        checkpoint=ckpt)
+        gp.close()
 
 
 def train_llama3_8b(num_workers: int = 1, dry_run: bool = False,

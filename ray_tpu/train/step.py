@@ -57,11 +57,17 @@ def make_train_step(
     loss_fn: Optional[Callable] = None,
     donate: bool = True,
     attn_impl: Optional[str] = None,
+    out_shardings: Any = None,
 ) -> Callable:
     """Build the jitted SPMD train step: (state, batch) -> (state, metrics).
 
     attn_impl "ring"/"ulysses" enables sequence-parallel attention over the
     mesh's sp axis (model must accept attn_impl/mesh kwargs in loss_fn).
+
+    ``out_shardings`` (a pytree prefix for ``(new_state, metrics)``) pins
+    the output layout.  Required when the step is AOT-compiled and called
+    in a loop: without it GSPMD may reshard small params in the output,
+    and the fixed executable then rejects its own output as input.
     """
     if loss_fn is None:
         loss_kwargs = {}
@@ -89,7 +95,10 @@ def make_train_step(
         return new_state, {"loss": loss_val, "grad_norm": grad_norm}
 
     donate_argnums = (0,) if donate else ()
-    return jax.jit(step_fn, donate_argnums=donate_argnums)
+    jit_kwargs = {}
+    if out_shardings is not None:
+        jit_kwargs["out_shardings"] = out_shardings
+    return jax.jit(step_fn, donate_argnums=donate_argnums, **jit_kwargs)
 
 
 def default_optimizer(

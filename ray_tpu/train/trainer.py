@@ -15,6 +15,24 @@ from ray_tpu.train.config import RunConfig, ScalingConfig
 from ray_tpu.train.controller import Result, TrainController
 
 
+def _with_goodput_flush(fn: Callable) -> Callable:
+    """Wrap the per-worker train fn so its active GoodputTracker (if the
+    loop created one — util/goodput.py) pushes a final record when the fn
+    returns or raises, even when the loop never called close()."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        from ray_tpu.util import goodput
+
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            goodput.flush_current(final=True)
+
+    return wrapped
+
+
 class JaxTrainer:
     def __init__(
         self,
@@ -53,7 +71,7 @@ class JaxTrainer:
     def fit(self) -> Result:
         factory = self._dataset_factory if self._datasets else None
         controller = TrainController(
-            self._train_fn,
+            _with_goodput_flush(self._train_fn),
             self._train_loop_config,
             self._scaling_config,
             self._run_config,

@@ -276,6 +276,39 @@ def get_profile(profile_id: str) -> Optional[Dict[str, Any]]:
     return profiling.merge_profiles(parts)
 
 
+def list_goodput() -> List[Dict[str, Any]]:
+    """Goodput/step-anatomy summary rows cluster-wide (one per run per
+    reporting process), newest first.  Flushes the driver's own tracker
+    first so a just-finished loop is part of the answer."""
+    from ray_tpu.util import goodput
+
+    goodput.flush_current()
+    rows: List[dict] = []
+    for n in _alive_nodes():
+        try:
+            rows.extend(_node_rpc(n["sched_socket"], "list_goodput"))
+        except (OSError, RuntimeError):
+            continue
+    return goodput.merge_goodput_rows(rows)
+
+
+def get_goodput(run: str) -> Optional[Dict[str, Any]]:
+    """Assemble one run's goodput records cluster-wide: per-process
+    records plus a merged summary whose badput buckets sum to elapsed
+    wall time (see util/goodput.py for the bucket definitions)."""
+    from ray_tpu.util import goodput
+
+    goodput.flush_current()
+    records: List[dict] = []
+    for n in _alive_nodes():
+        try:
+            records.extend(_node_rpc(n["sched_socket"], "get_goodput",
+                                     {"run": run}))
+        except (OSError, RuntimeError):
+            continue
+    return goodput.merge_records(records)
+
+
 def record_profile(duration: float = 5.0, hz: float = 99.0,
                    profile_id: Optional[str] = None,
                    ) -> Optional[Dict[str, Any]]:

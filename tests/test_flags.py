@@ -100,6 +100,25 @@ def test_typed_reads(monkeypatch):
     assert flags.get("RTPU_TESTING_DATA_FAILURE") == ""
     monkeypatch.setenv("RTPU_TESTING_DATA_FAILURE", "25")
     assert flags.get("RTPU_TESTING_DATA_FAILURE") == "25"
+    # goodput-plane knobs (step-anatomy tracker + per-node record bank)
+    monkeypatch.delenv("RTPU_GOODPUT_CAP", raising=False)
+    assert flags.get("RTPU_GOODPUT_CAP") == 128
+    monkeypatch.setenv("RTPU_GOODPUT_CAP", "4")
+    assert flags.get("RTPU_GOODPUT_CAP") == 4
+    monkeypatch.setenv("RTPU_GOODPUT_CAP", "not-a-count")
+    assert flags.get("RTPU_GOODPUT_CAP") == 128  # default on garbage
+    monkeypatch.delenv("RTPU_GOODPUT_FLUSH_S", raising=False)
+    assert flags.get("RTPU_GOODPUT_FLUSH_S") == 5.0
+    monkeypatch.setenv("RTPU_GOODPUT_FLUSH_S", "1.5")
+    assert flags.get("RTPU_GOODPUT_FLUSH_S") == 1.5
+    monkeypatch.delenv("RTPU_GOODPUT_PEAK_TFLOPS", raising=False)
+    assert flags.get("RTPU_GOODPUT_PEAK_TFLOPS") == 197.0
+    monkeypatch.setenv("RTPU_GOODPUT_PEAK_TFLOPS", "121")
+    assert flags.get("RTPU_GOODPUT_PEAK_TFLOPS") == 121.0
+    monkeypatch.delenv("RTPU_GOODPUT_WARMUP", raising=False)
+    assert flags.get("RTPU_GOODPUT_WARMUP") == 1
+    monkeypatch.setenv("RTPU_GOODPUT_WARMUP", "3")
+    assert flags.get("RTPU_GOODPUT_WARMUP") == 3
 
 
 def test_explicit_excludes_process_local(monkeypatch):

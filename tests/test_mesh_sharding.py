@@ -13,6 +13,7 @@ from ray_tpu.parallel.mesh import (
 from ray_tpu.parallel.sharding import (
     DEFAULT_RULES,
     logical_spec,
+    shard_map,
     to_partition_spec,
 )
 
@@ -84,9 +85,9 @@ def test_dcn_multi_slice_mesh():
         return jax.lax.psum(v, ("dcn", "fsdp"))
 
     out = jax.jit(
-        jax.shard_map(summed, mesh=mesh,
-                      in_specs=P(("dcn", "dp", "fsdp")),
-                      out_specs=P(("dcn", "dp", "fsdp"))))(xs)
+        shard_map(summed, mesh=mesh,
+                  in_specs=P(("dcn", "dp", "fsdp")),
+                  out_specs=P(("dcn", "dp", "fsdp"))))(xs)
     assert out.shape == x.shape
 
 
