@@ -65,6 +65,34 @@ _TELEMETRY_PERIOD_S = 2.0
 
 _thread_tasks: Dict[int, Tuple[str, Optional[str]]] = {}
 
+# Sidecar attribution file (worker processes only): the pool points
+# RTPU_TASK_ATTR_PATH at logs/worker-<id8>.task, and the note_task bracket
+# mirrors "what this worker executes NOW" there so the node's log monitor
+# can tag captured stdout/stderr lines with task + trace.  A scheduler-side
+# view can't do this: plain tasks dispatch on the native raylet lane and
+# never enter the Python in_flight table.  Kept to two syscalls per task
+# (ftruncate+pwrite on a cached fd) so microtask throughput is untouched.
+_attr_fd: Optional[int] = None
+_attr_lock = threading.Lock()
+
+
+def _write_task_attr(name: str, task_id: str, trace_id: str) -> None:
+    global _attr_fd
+    path = os.environ.get("RTPU_TASK_ATTR_PATH")
+    if not path:
+        return
+    try:
+        with _attr_lock:
+            if _attr_fd is None:
+                _attr_fd = os.open(path,
+                                   os.O_WRONLY | os.O_CREAT, 0o644)
+            data = f"{name}\t{task_id}\t{trace_id}\n".encode(
+                "utf-8", "replace")
+            os.ftruncate(_attr_fd, 0)
+            os.pwrite(_attr_fd, data, 0)
+    except OSError:
+        pass  # attribution is best-effort; never fail the task for it
+
 
 def note_task(spec) -> Optional[tuple]:
     """Attribute the calling thread's samples to ``spec`` until
@@ -75,6 +103,9 @@ def note_task(spec) -> Optional[tuple]:
     name = (getattr(spec, "name", None) or getattr(spec, "method_name", None)
             or getattr(spec, "kind", None) or "task")
     _thread_tasks[ident] = (str(name), getattr(spec, "trace_id", None))
+    tid = getattr(spec, "task_id", None)
+    _write_task_attr(str(name), tid.hex() if tid else "",
+                     getattr(spec, "trace_id", None) or "")
     return (ident, prev)
 
 
@@ -84,8 +115,10 @@ def clear_task(token: Optional[tuple]) -> None:
     ident, prev = token
     if prev is None:
         _thread_tasks.pop(ident, None)
+        _write_task_attr("", "", "")
     else:
         _thread_tasks[ident] = prev
+        _write_task_attr(prev[0], "", prev[1] or "")
 
 
 def current_task(ident: Optional[int] = None) -> Optional[tuple]:

@@ -281,6 +281,16 @@ class Scheduler:
         # evicted past RTPU_GOODPUT_CAP (read at bank time so tests can
         # retune it without a scheduler restart).
         self._goodput: "OrderedDict[tuple, dict]" = OrderedDict()
+        # Reference-table snapshots (_private/ref_tracker.py flushes here
+        # over the control socket, "refs_push" — the memory plane of the
+        # same telemetry lane): (proc, pid) -> latest table, replaced on
+        # every push (never appended: a process's table supersedes its
+        # previous one), oldest process evicted past RTPU_REFS_CAP.
+        self._ref_tables: "OrderedDict[tuple, dict]" = OrderedDict()
+        # Task-attributed worker-log ring for `rtpu logs` (satellite of
+        # the memory plane): structured rows banked by the log monitor.
+        self._log_ring: deque = deque(
+            maxlen=max(1, int(flags.get("RTPU_LOG_RING_CAP"))))
         self._profiler_conns: dict[bytes, object] = {}
         self._profile_cv = threading.Condition(self._lock)
         self._profile_pending: dict[str, int] = {}  # stop replies awaited
@@ -369,8 +379,26 @@ class Scheduler:
         if os.environ.get("RTPU_LOG_TO_DRIVER", "1") != "0":
             from ray_tpu._private.log_monitor import LogMonitor
 
+            def _worker_tasks():
+                # worker tag -> (task name, task id, trace id) executing
+                # NOW: the scheduler-side view of the note_task bracket,
+                # sampled by the log monitor at line-capture time
+                out = {}
+                with self._lock:
+                    for wid, w in self._pool.workers.items():
+                        spec = next(iter(w.in_flight.values()), None)
+                        if spec is None:
+                            continue
+                        out[f"worker-{wid.hex()[:8]}"] = (
+                            spec.name or spec.method_name or spec.kind,
+                            spec.task_id.hex() if spec.task_id else "",
+                            getattr(spec, "trace_id", None) or "")
+                return out
+
             self._log_monitor = LogMonitor(self._pool.logs_dir,
-                                           self._forward_worker_logs)
+                                           self._forward_worker_logs,
+                                           tasks=_worker_tasks,
+                                           emit_rows=self._bank_log_rows)
         # Node service transport: the native event loop (one C++ epoll
         # serving thread, the raylet's asio-loop counterpart —
         # src/ray/raylet/main.cc runs the node manager the same way) when
@@ -985,6 +1013,55 @@ class Scheduler:
         with self._lock:
             return [dict(rec) for (r, _src), rec in self._goodput.items()
                     if r == run]
+
+    def _bank_refs(self, push: dict):
+        """Bank a process's reference-table snapshot (refs_push lane).
+        Replace, never append: the table is a point-in-time statement of
+        what the process holds NOW, so a retry or a stale interval can
+        never double-count.  Keyed by (proc, pid); oldest process evicted
+        past RTPU_REFS_CAP."""
+        key = (str(push.get("proc") or "worker"), int(push.get("pid") or 0))
+        rec = {
+            "node": self.node_id,
+            "proc": key[0],
+            "pid": key[1],
+            "worker_id": push.get("worker_id") or "",
+            "ts": float(push.get("ts") or time.time()),
+            "refs": list(push.get("refs") or ()),
+        }
+        cap = max(1, int(flags.get("RTPU_REFS_CAP")))
+        with self._lock:
+            if key not in self._ref_tables:
+                while len(self._ref_tables) >= cap:
+                    self._ref_tables.popitem(last=False)
+            self._ref_tables[key] = rec
+            self._ref_tables.move_to_end(key)
+
+    def _list_refs(self) -> list[dict]:
+        with self._lock:
+            return [dict(rec) for rec in self._ref_tables.values()]
+
+    def _bank_log_rows(self, rows: list[dict]):
+        """Bank task-attributed worker-log rows for `rtpu logs` (the log
+        monitor calls this on its own thread; deque append is atomic)."""
+        self._log_ring.extend(rows)
+
+    def _logs_search(self, params: dict) -> list[dict]:
+        """Filtered view of the attributed log ring: task matches by task
+        name OR task-id prefix, trace by trace-id prefix."""
+        task = params.get("task") or ""
+        trace = params.get("trace") or ""
+        limit = int(params.get("limit") or 1000)
+        out = []
+        for row in list(self._log_ring):
+            if task and not (
+                    (row.get("task") or "").startswith(task)
+                    or (row.get("task_id") or "").startswith(task)):
+                continue
+            if trace and not (row.get("trace_id") or "").startswith(trace):
+                continue
+            out.append(dict(row, node=self.node_id))
+        return out[-limit:]
 
     def _profiler_conns_snapshot(self) -> list:
         with self._lock:
@@ -2064,6 +2141,24 @@ class Scheduler:
             return self._list_goodput()
         if method == "get_goodput":
             return self._get_goodput(params["run"])
+        if method == "refs_push":
+            # Reference-table snapshots from this node's processes
+            # (_private/ref_tracker.py flusher).
+            self._bank_refs(params)
+            return True
+        if method == "list_refs":
+            return self._list_refs()
+        if method == "store_audit":
+            # Per-object store audit (size/seal/age/pins + occupancy and
+            # fragmentation summary) straight from the shm daemon.
+            mr = params.get("max_rows")  # 0 is a real cap (summary only)
+            mt = params.get("max_tombstones")
+            return self._store.audit(
+                max_rows=int(flags.get("RTPU_AUDIT_MAX_ROWS")
+                             if mr is None else mr),
+                max_tombstones=int(4096 if mt is None else mt))
+        if method == "logs_search":
+            return self._logs_search(params)
         if method == "profile_start":
             return self._profile_start(params["profile_id"],
                                        float(params.get("hz") or 99.0))
@@ -2095,6 +2190,24 @@ class Scheduler:
                 "available": self._res_snapshot(),
                 "resources": dict(self.total_resources),
             }
+            # Occupancy/fragmentation/eviction-pressure gauges from the
+            # summary-only audit (max_rows=0: one tiny round trip, no
+            # per-object rows on the scrape path).
+            try:
+                aud = self._store.audit(max_rows=0,
+                                        max_tombstones=0)["summary"]
+                runtime.update({
+                    "store_capacity_bytes": aud.get("capacity", 0),
+                    "store_occupancy": aud.get("occupancy", 0.0),
+                    "store_fragmentation": aud.get("fragmentation", 0.0),
+                    "store_free_blocks": aud.get("free_blocks", 0),
+                    "store_largest_free_bytes": aud.get("largest_free", 0),
+                    "store_evictions_total": aud.get("evictions", 0),
+                    "store_spills_total": aud.get("spills", 0),
+                    "store_spilled_bytes": aud.get("spilled_bytes", 0),
+                })
+            except Exception:
+                pass
             app = list(sources.values())
             # A standalone node process (no driver/worker context in this
             # process) has nobody flushing ITS registry — the scheduler's

@@ -199,12 +199,28 @@ def main(argv=None) -> int:
                                     args.reps, salt_base)
                 results[key] = round(rate, 1)
                 salt_base += 1000
+        # End-of-run store audit (summary only, off the measured path):
+        # records what the bench left the segment looking like, so a
+        # perf regression can be correlated with occupancy/fragmentation
+        # drift between rounds.
+        aud_client = StoreClient(srv.socket_path, srv.shm_name,
+                                 srv.capacity)
+        s = aud_client.audit(max_rows=0, max_tombstones=0)["summary"]
+        audit = {k: s.get(k) for k in
+                 ("capacity", "used", "num_objects", "free_blocks",
+                  "largest_free", "evictions", "spills")}
+        audit["occupancy"] = round(s.get("occupancy", 0.0), 4)
+        audit["fragmentation"] = round(s.get("fragmentation", 0.0), 4)
+        aud_client.close()
     finally:
         srv.shutdown()
 
     for name, rate in results.items():
         print(f"{name:48s} {rate:12.1f} /s", file=sys.stderr)
-    print(json.dumps({"store_bench": results}))
+    print(f"store audit after run: occ={audit['occupancy']:.1%} "
+          f"frag={audit['fragmentation']:.1%} "
+          f"evictions={audit['evictions']}", file=sys.stderr)
+    print(json.dumps({"store_bench": results, "store_audit": audit}))
     return 0
 
 

@@ -20,6 +20,7 @@ from typing import Callable, Optional
 import cloudpickle
 
 from ray_tpu._private import ids
+from ray_tpu._private import ref_tracker
 from ray_tpu._private.serialization import (
     deserialize, payload_parts, serialized_size, write_payload)
 from ray_tpu.core.object_ref import ObjectRef
@@ -130,10 +131,16 @@ class WorkerContext:
         self._ref_lock = threading.RLock()
         object_ref_mod.set_lifecycle_hooks(self._on_ref_created,
                                            self._on_ref_deleted)
+        # Reference-table telemetry: periodic refs_push snapshots feed the
+        # cluster memory view (`rtpu memory` / state.list_objects).
+        ref_tracker.ensure_flusher()
 
     def _on_ref_created(self, oid: bytes) -> None:
         with self._ref_lock:
-            self._ref_counts[oid] = self._ref_counts.get(oid, 0) + 1
+            n = self._ref_counts.get(oid, 0) + 1
+            self._ref_counts[oid] = n
+        if n == 1:
+            ref_tracker.note_created(oid)
 
     def _on_ref_deleted(self, oid: bytes) -> None:
         with self._ref_lock:
@@ -143,6 +150,7 @@ class WorkerContext:
                 return
             self._ref_counts.pop(oid, None)
             owned = self._owned_puts.pop(oid, None) is not None
+        ref_tracker.note_deleted(oid)
         ms = self.memstore
         if ms is not None:
             ms.discard(oid)
@@ -204,6 +212,7 @@ class WorkerContext:
         sink = getattr(self._tls, "escape_sink", None)
         if sink is not None:
             sink.append(oid)
+        ref_tracker.annotate(oid, escaped=True)
         owned = getattr(self, "_owned_puts", None)
         if owned is not None:
             owned.pop(oid, None)  # other processes may now hold refs
@@ -425,7 +434,9 @@ class WorkerContext:
         if track_owned:
             with self._ref_lock:
                 self._owned_puts[oid] = size  # only >= _EAGER_DELETE_MIN
-        return ObjectRef(oid)
+        ref = ObjectRef(oid)
+        ref_tracker.annotate(oid, kind="put")
+        return ref
 
     def get_object(self, ref: ObjectRef, timeout: Optional[float] = None):
         start = time.monotonic()

@@ -473,6 +473,10 @@ def main():
         metrics_mod.shutdown_flusher(flush=True)
         tracing.shutdown_flusher(flush=True)
         profiling.shutdown_sampler(flush=True)
+        from ray_tpu._private import ref_tracker
+
+        ref_tracker.shutdown_flusher(flush=False)  # refs die with us
+        ref_tracker.clear()
     sys.exit(0)
 
 

@@ -93,6 +93,10 @@ class WorkerPool:
         env["PYTHONUNBUFFERED"] = "1"
         os.makedirs(self.logs_dir, exist_ok=True)
         tag = f"worker-{worker_id.hex()[:8]}"
+        # The note_task bracket mirrors the executing task here; the log
+        # monitor joins it against captured lines (rtpu logs --task).
+        env["RTPU_TASK_ATTR_PATH"] = os.path.join(self.logs_dir,
+                                                  tag + ".task")
         out = open(os.path.join(self.logs_dir, tag + ".out"), "ab")
         err = open(os.path.join(self.logs_dir, tag + ".err"), "ab")
         try:

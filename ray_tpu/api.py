@@ -158,8 +158,11 @@ def shutdown():
         # Final profile flush needs the driver context: stop the sampler
         # BEFORE detaching it (a later init() resumes via ensure_sampler).
         from ray_tpu._private import profiling
+        from ray_tpu._private import ref_tracker
 
         profiling.shutdown_sampler(flush=True)
+        ref_tracker.shutdown_flusher(flush=False)  # driver refs die here
+        ref_tracker.clear()
         worker_mod.set_global_worker(None)
         node.shutdown()
     else:

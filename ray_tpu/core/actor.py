@@ -15,6 +15,7 @@ from typing import Optional
 import cloudpickle
 
 from ray_tpu._private import ids
+from ray_tpu._private import ref_tracker
 from ray_tpu._private.task_spec import ACTOR_CREATION, ACTOR_METHOD, TaskSpec
 from ray_tpu._private.worker import global_worker
 from ray_tpu.core.object_ref import ObjectRef
@@ -142,6 +143,8 @@ class ActorHandle:
         else:
             worker.submit(spec)
         refs = [ObjectRef(oid) for oid in return_ids]
+        for oid in return_ids:
+            ref_tracker.annotate(oid, kind="actor_return")
         return refs[0] if num_returns == 1 else refs
 
     def __reduce__(self):

@@ -13,6 +13,7 @@ from typing import Any, Optional
 import cloudpickle
 
 from ray_tpu._private import ids
+from ray_tpu._private import ref_tracker
 from ray_tpu._private.runtime_env import package as package_runtime_env
 from ray_tpu._private.task_spec import TASK, TaskSpec
 from ray_tpu._private.worker import global_worker
@@ -114,6 +115,8 @@ class RemoteFunction:
         if record is not None:
             record(spec)
         refs = [ObjectRef(oid) for oid in return_ids]
+        for oid in return_ids:
+            ref_tracker.annotate(oid, kind="task_return")
         return refs[0] if num_returns == 1 else refs
 
     def __call__(self, *args: Any, **kwargs: Any):

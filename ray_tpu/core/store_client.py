@@ -11,6 +11,7 @@ shm_store.cc for the protocol).
 
 from __future__ import annotations
 
+import json
 import mmap
 import os
 import socket
@@ -39,6 +40,7 @@ ST_VIEW = 8  # GET_INLINE: too big to inline; pin kept, (offset, size) back
 _OP_CREATE, _OP_SEAL, _OP_GET, _OP_RELEASE = 1, 2, 3, 4
 _OP_DELETE, _OP_CONTAINS, _OP_STATS, _OP_ABORT = 5, 6, 7, 8
 _OP_PUT, _OP_GET_INLINE, _OP_PULL, _OP_PUSH = 9, 10, 11, 12
+_OP_AUDIT = 13
 
 # Objects at or below this come back as inline bytes from GET_INLINE (one
 # round trip, daemon-side copy, no pin/RELEASE); bigger ones come back as
@@ -851,6 +853,45 @@ class StoreClient:
     def stats(self) -> dict:
         _, used, num_objects = self._call(_OP_STATS, b"\x00" * ID_LEN)
         return {"used_bytes": used, "num_objects": num_objects}
+
+    def audit(self, max_rows: int = 10000,
+              max_tombstones: int = 4096) -> dict:
+        """Point-in-time store audit: occupancy/fragmentation summary,
+        one row per resident/spilled object (size, seal state, pin count,
+        create age, idle time), and the newest eviction tombstones.
+
+        Variable-length response, so it bypasses the native conn's
+        fixed-frame ``call`` and speaks the wire protocol directly on the
+        checked-out socket (the ``put_parts`` idiom)."""
+
+        def attempt(first):
+            entry = self._checkout()
+            sock, nc = entry
+            try:
+                sock.sendall(_REQ.pack(_OP_AUDIT, b"\x00" * ID_LEN,
+                                       max_rows, max_tombstones))
+                status, length, _ = _RESP.unpack(
+                    self._recv_exact(sock, _RESP.size))
+                if status != ST_OK:
+                    raise RuntimeError(f"audit failed: status={status}")
+                payload = self._recv_exact(sock, length)
+            except BaseException:
+                sock.close()
+                raise
+            self._checkin(entry)
+            return payload
+
+        payload = self._with_retry(attempt, "audit")
+        doc = json.loads(payload.decode("utf-8"))
+        s = doc.get("summary", {})
+        cap = s.get("capacity") or 1
+        # derived gauges computed client-side so every surface (metrics,
+        # dashboard, CLI) agrees on the arithmetic
+        s["occupancy"] = s.get("used", 0) / cap
+        free = max(cap - s.get("used", 0), 0)
+        s["fragmentation"] = (
+            1.0 - s.get("largest_free", 0) / free if free else 0.0)
+        return doc
 
     def close(self):
         self._closed = True  # in-flight retries surface instead of spinning

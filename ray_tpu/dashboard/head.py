@@ -41,6 +41,7 @@ _INDEX_HTML = """<!doctype html><title>ray_tpu dashboard API</title>
 <li><a href="/api/traces">/api/traces (distributed traces; ?trace_id=&lt;hex&gt; for one tree)</a></li>
 <li><a href="/api/profile">/api/profile (CPU profiles; ?id=&lt;profile_id&gt;&amp;format=speedscope|folded|raw)</a></li>
 <li><a href="/api/goodput">/api/goodput (training goodput/step anatomy; ?run=&lt;name&gt; for one run)</a></li>
+<li><a href="/api/memory">/api/memory (cluster objects by creation call site, store occupancy, leak report)</a></li>
 <li><a href="/metrics">/metrics (Prometheus)</a></li>
 </ul>"""
 
@@ -141,11 +142,23 @@ def _render_prometheus(per_node: list[dict]) -> str:
         "workers": "Alive worker processes on the node",
         "store_used_bytes": "Object store bytes in use on the node",
         "store_num_objects": "Objects resident in the node's store",
+        "store_capacity_bytes": "Object store capacity on the node",
+        "store_occupancy": "Object store used/capacity fraction",
+        "store_fragmentation":
+            "Free-space fragmentation (1 - largest_free/free)",
+        "store_free_blocks": "Free-list blocks in the node's store",
+        "store_largest_free_bytes":
+            "Largest contiguous free block in the node's store",
+        "store_evictions_total": "Objects lossily evicted (no spill copy)",
+        "store_spills_total": "Objects spilled to disk under pressure",
+        "store_spilled_bytes": "Bytes currently spilled to disk",
     }
     for snap in per_node:
         rt = snap["runtime"]
         node = rt["node_id"].hex()[:12]
         for key, help_ in _NODE_GAUGES.items():
+            if key not in rt:  # audit gauges are best-effort per scrape
+                continue
             f = fam(f"ray_tpu_node_{key}", "gauge", help_)
             # node_id makes these unique per node: set, don't sum
             f["series"][(("node_id", node),)] = rt[key]
@@ -479,6 +492,18 @@ class DashboardHead:
                 continue
         return goodput_mod.merge_goodput_rows(rows)
 
+    def _memory(self):
+        """The `ray memory` view over HTTP: cluster objects grouped by
+        creation call site + per-node store occupancy + the leak report.
+        Runs through the state API, which needs a driver context — the
+        head process has one (same caveat as /api/serve)."""
+        try:
+            from ray_tpu.util.state import memory_summary
+
+            return memory_summary()
+        except Exception as e:
+            return {"error": f"memory view unavailable: {e!r}"}
+
     def _goodput_get(self, run: str):
         """One run's records assembled cluster-wide (same shape as
         ray_tpu.util.state.get_goodput)."""
@@ -642,6 +667,7 @@ class DashboardHead:
         app.router.add_get("/api/traces", traces)
         app.router.add_get("/api/profile", profile)
         app.router.add_get("/api/goodput", goodput)
+        app.router.add_get("/api/memory", json_handler(self._memory))
         app.router.add_get("/metrics", metrics)
 
         async def start():

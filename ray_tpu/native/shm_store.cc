@@ -36,6 +36,8 @@
 // the client maps its zero-copy view immediately (it owes a RELEASE,
 // exactly like GET).  Either way a get is ONE round trip.
 
+#include <algorithm>
+#include <chrono>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -72,7 +74,8 @@ namespace {
 
 constexpr uint8_t OP_CREATE = 1, OP_SEAL = 2, OP_GET = 3, OP_RELEASE = 4,
                   OP_DELETE = 5, OP_CONTAINS = 6, OP_STATS = 7, OP_ABORT = 8,
-                  OP_PUT = 9, OP_GET_INLINE = 10, OP_PULL = 11, OP_PUSH = 12;
+                  OP_PUT = 9, OP_GET_INLINE = 10, OP_PULL = 11, OP_PUSH = 12,
+                  OP_AUDIT = 13;
 // Daemon-to-daemon transfer ops (TCP peer listener).  XFER_PULL_RANGE is
 // the striped plane: <u64 offset | u64 length> follows the id and the
 // response carries only that byte range (length 0 = size probe, no
@@ -118,11 +121,23 @@ struct IdHash {
 bool ReadFull(int fd, void* buf, size_t n);
 bool WriteFull(int fd, const void* buf, size_t n);
 
+// Coarse monotonic clock for per-object create/access stamps.  One
+// steady_clock read per Create/Get — nanoseconds against a syscall-bearing
+// op, so the audit accounting never taxes the zero-copy hot path.
+uint64_t NowMs() {
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::milliseconds>(
+          std::chrono::steady_clock::now().time_since_epoch())
+          .count());
+}
+
 struct ObjectEntry {
   uint64_t offset = 0;
   uint64_t size = 0;
   bool sealed = false;
   int refcount = 0;  // pinned while > 0 (creator or active getters)
+  uint64_t create_ms = 0;       // NowMs() at Create (or restore)
+  uint64_t last_access_ms = 0;  // NowMs() at the most recent sealed Get
   // Delete() arrived while pinned: the extent is freed on the LAST
   // Release instead — freeing under an active zero-copy Get view would
   // let the next Create scribble over live reader memory.
@@ -185,6 +200,16 @@ class FreeListAllocator {
   }
   uint64_t used() const { return used_; }
   uint64_t capacity() const { return capacity_; }
+  // Fragmentation view for the audit plane: how many free extents the
+  // arena has shattered into, and the biggest contiguous allocation that
+  // can still succeed (the number that actually gates a large Create).
+  uint64_t free_blocks() const { return free_.size(); }
+  uint64_t largest_free() const {
+    uint64_t best = 0;
+    for (const auto& kv : free_)
+      if (kv.second > best) best = kv.second;
+    return best;
+  }
 
  private:
   uint64_t capacity_;
@@ -231,6 +256,8 @@ class Store {
       e.sealed = false;
       e.delete_pending = false;
       e.refcount += 1;  // creator pin, on top of surviving old-reader pins
+      e.create_ms = NowMs();
+      e.last_access_ms = e.create_ms;
       *offset = off;
       return ST_OK;
     }
@@ -238,6 +265,8 @@ class Store {
     e.offset = off;
     e.size = size;
     e.refcount = 1;  // creator holds a ref until seal
+    e.create_ms = NowMs();
+    e.last_access_ms = e.create_ms;
     objects_[id] = e;
     *offset = off;
     return ST_OK;
@@ -272,6 +301,7 @@ class Store {
       if (it != objects_.end() && it->second.delete_pending) return ST_EVICTED;
       if (it != objects_.end() && it->second.sealed) {
         it->second.refcount++;
+        it->second.last_access_ms = NowMs();
         if (it->second.in_lru) {
           lru_.erase(it->second.lru_it);
           it->second.in_lru = false;
@@ -377,6 +407,89 @@ class Store {
     *num_objects = objects_.size();
   }
 
+  // Full-store audit as one JSON document: an occupancy/fragmentation
+  // summary plus one row per resident or spilled object (size, seal
+  // state, pin count, create age, idle time) and a capped slice of the
+  // eviction tombstones.  Built under the store mutex — the audit is a
+  // cold diagnostic path; serializing it against mutations keeps every
+  // row a consistent point-in-time snapshot.  Rows beyond `max_rows`
+  // are counted, not silently dropped.
+  std::string AuditJson(uint64_t max_rows, uint64_t max_tombstones) {
+    std::unique_lock<std::mutex> lk(mu_);
+    uint64_t now = NowMs();
+    uint64_t spilled_bytes = 0;
+    for (const auto& kv : spilled_) spilled_bytes += kv.second;
+    std::string out;
+    out.reserve(256 + 160 * std::min<uint64_t>(
+                          max_rows, objects_.size() + spilled_.size()));
+    char buf[256];
+    snprintf(buf, sizeof(buf),
+             "{\"summary\":{\"capacity\":%llu,\"used\":%llu,"
+             "\"num_objects\":%llu,\"free_blocks\":%llu,"
+             "\"largest_free\":%llu,\"evictions\":%llu,\"spills\":%llu,"
+             "\"restores\":%llu,\"spilled_objects\":%llu,"
+             "\"spilled_bytes\":%llu,\"tombstones\":%llu},",
+             (unsigned long long)alloc_.capacity(),
+             (unsigned long long)alloc_.used(),
+             (unsigned long long)objects_.size(),
+             (unsigned long long)alloc_.free_blocks(),
+             (unsigned long long)alloc_.largest_free(),
+             (unsigned long long)evictions_, (unsigned long long)spills_,
+             (unsigned long long)restores_,
+             (unsigned long long)spilled_.size(),
+             (unsigned long long)spilled_bytes,
+             (unsigned long long)evicted_.size());
+    out += buf;
+    out += "\"objects\":[";
+    uint64_t rows = 0, dropped = 0;
+    for (const auto& kv : objects_) {
+      const ObjectEntry& e = kv.second;
+      if (e.delete_pending) continue;  // logically gone, awaiting Release
+      if (rows >= max_rows) {
+        dropped++;
+        continue;
+      }
+      snprintf(buf, sizeof(buf),
+               "%s{\"id\":\"%s\",\"size\":%llu,\"sealed\":%d,"
+               "\"refcount\":%d,\"age_ms\":%llu,\"idle_ms\":%llu,"
+               "\"spilled\":0}",
+               rows ? "," : "", HexId(kv.first).c_str(),
+               (unsigned long long)e.size, e.sealed ? 1 : 0, e.refcount,
+               (unsigned long long)(now - std::min(e.create_ms, now)),
+               (unsigned long long)(now - std::min(e.last_access_ms, now)));
+      out += buf;
+      rows++;
+    }
+    for (const auto& kv : spilled_) {
+      if (rows >= max_rows) {
+        dropped++;
+        continue;
+      }
+      snprintf(buf, sizeof(buf),
+               "%s{\"id\":\"%s\",\"size\":%llu,\"sealed\":1,"
+               "\"refcount\":0,\"age_ms\":0,\"idle_ms\":0,\"spilled\":1}",
+               rows ? "," : "", HexId(kv.first).c_str(),
+               (unsigned long long)kv.second);
+      out += buf;
+      rows++;
+    }
+    out += "],\"objects_dropped\":";
+    out += std::to_string(dropped);
+    out += ",\"tombstone_ids\":[";
+    uint64_t nt = 0;
+    // newest-first: post-restart leak triage cares about the most recent
+    // losses, and the ring can hold up to a million ids
+    for (auto it = evicted_order_.rbegin();
+         it != evicted_order_.rend() && nt < max_tombstones; ++it, ++nt) {
+      if (nt) out += ",";
+      out += "\"";
+      out += HexId(*it);
+      out += "\"";
+    }
+    out += "]}";
+    return out;
+  }
+
  private:
   void DecrefLocked(ObjectEntry& e, const ObjectId& id) {
     if (e.refcount > 0) e.refcount--;
@@ -408,11 +521,13 @@ class Store {
         // data preserved on disk; a later Get restores transparently
         alloc_.Free(it->second.offset);
         objects_.erase(it);
+        spills_++;
         return true;
       }
       alloc_.Free(it->second.offset);
       objects_.erase(it);
       RecordEvictedLocked(victim);
+      evictions_++;
     }
     return true;
   }
@@ -469,8 +584,11 @@ class Store {
     e.size = size;
     e.sealed = true;
     e.refcount = 0;  // Get's fast path takes the caller's ref
+    e.create_ms = NowMs();  // restore time: the in-shm age restarts
+    e.last_access_ms = e.create_ms;
     objects_[id] = e;
     DropSpilledLocked(id);
+    restores_++;
     return ST_OK;
   }
 
@@ -494,6 +612,11 @@ class Store {
   }
 
   static constexpr size_t kMaxTombstones = 1 << 20;
+  // Lifetime pressure counters (monotonic since daemon start; a restart
+  // zeroes them, which the incarnation bump already makes visible).
+  uint64_t evictions_ = 0;  // lossy evictions (data dropped, tombstoned)
+  uint64_t spills_ = 0;     // evictions that preserved data on disk
+  uint64_t restores_ = 0;   // spilled objects pulled back into shm
   std::mutex mu_;
   std::condition_variable cv_;
   FreeListAllocator alloc_;
@@ -1054,6 +1177,27 @@ void ServeClient(Store* store, uint8_t* base, int fd) {
           status = PushToPeer(store, base, id, host, port);
         }
         break;
+      }
+      case OP_AUDIT: {
+        // arg0 = max object rows, arg1 = max tombstone ids.  Response is
+        // the 17-byte header (r0 = payload length, r1 = resident object
+        // count) followed by the JSON payload — the same variable-length
+        // framing as an inline GET, so it rides the existing socket pool.
+        uint64_t used = 0, nobj = 0;
+        store->Stats(&used, &nobj);
+        std::string payload = store->AuditJson(
+            std::min<uint64_t>(arg0, 1u << 20),
+            std::min<uint64_t>(arg1, 1u << 20));
+        r0 = payload.size();
+        r1 = nobj;
+        uint8_t resp[kRespLen];
+        resp[0] = ST_OK;
+        memcpy(resp + 1, &r0, 8);
+        memcpy(resp + 1 + 8, &r1, 8);
+        if (!WriteFull(fd, resp, kRespLen) ||
+            !WriteFull(fd, payload.data(), payload.size()))
+          conn_broken = true;
+        continue;  // response already written
       }
       case OP_GET_INLINE: {
         // arg0 = timeout_ms, arg1 = client's inline size cap

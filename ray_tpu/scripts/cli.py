@@ -88,22 +88,147 @@ def cmd_status(args):
         print(f"  {r['name']}={r['value']!r}")
 
 
+def _gather_memory(sock):
+    """Fetch per-node store audits + banked reference tables + the head's
+    location directory (the inputs to state.merge_object_rows)."""
+    audits, tables = [], []
+    for n in _rpc(sock, "list_nodes"):
+        if not n["alive"]:
+            continue
+        nid = n["node_id"].hex()
+        try:
+            doc = _rpc(n["sched_socket"], "store_audit")
+            doc["node_id"] = nid
+            audits.append(doc)
+        except Exception as e:  # noqa: BLE001
+            print(f"  {nid[:12]}  store unreachable: {e}")
+        try:
+            tables.extend(_rpc(n["sched_socket"], "list_refs"))
+        except Exception:
+            pass
+    for t in tables:
+        if isinstance(t.get("node"), bytes):
+            t["node"] = t["node"].hex()
+    try:
+        locs = _rpc(sock, "list_object_locations")
+    except Exception:
+        locs = {}
+    loc_by_hex = {oid.hex(): [x.hex() for x in ns]
+                  for oid, ns in locs.items()}
+    return audits, tables, loc_by_hex
+
+
 def cmd_memory(args):
+    """Cluster memory introspection (reference: `ray memory`): per-node
+    store occupancy/fragmentation, then every known object grouped by
+    its creating call site with size/age/refcount/holder columns;
+    --leaks appends the cross-referenced leak report."""
+    from ray_tpu.util import state as state_mod
+
     sock = find_address(args.address)
-    nodes = _rpc(sock, "list_nodes")
+    audits, tables, loc_by_hex = _gather_memory(sock)
     print("======== Object store memory ========")
-    for n in nodes:
+    for doc in audits:
+        s = doc.get("summary") or {}
+        cap = s.get("capacity") or 0
+        print(f"  {doc['node_id'][:12]}  "
+              f"used={s.get('used', 0) / 1e6:.1f}/{cap / 1e6:.1f}MB "
+              f"occ={s.get('occupancy', 0) * 100:5.1f}% "
+              f"frag={s.get('fragmentation', 0) * 100:5.1f}% "
+              f"objects={s.get('num_objects', 0)} "
+              f"evictions={s.get('evictions', 0)} "
+              f"spills={s.get('spills', 0)} "
+              f"spilled={s.get('spilled_bytes', 0) / 1e6:.1f}MB")
+    objects = state_mod.merge_object_rows(audits, tables, loc_by_hex)
+    for spec in (args.filter or ()):
+        if "=" not in spec:
+            sys.exit(f"--filter expects key=value, got {spec!r}")
+        key, value = spec.split("=", 1)
+        objects = [r for r in objects
+                   if r.get(key) == value or str(r.get(key)) == value]
+    by_site: dict = {}
+    for r in objects:
+        by_site.setdefault(r.get("site") or "(no call site recorded)",
+                           []).append(r)
+    print(f"======== {len(objects)} object(s) by creation call site "
+          f"========")
+    for g in state_mod.group_objects_by_site(objects):
+        tasks = ", ".join(g["tasks"]) or "-"
+        print(f"\n--- {g['site']}")
+        print(f"    {g['count']} object(s), "
+              f"{g['total_bytes'] / 1e6:.2f} MB, {g['ref_count']} ref(s), "
+              f"{g['pinned']} pinned, max age {g['max_age_s']:.0f}s; "
+              f"tasks: {tasks}")
+        print(f"    {'OBJECT':40s} {'SIZE':>10s} {'AGE':>7s} {'STATE':8s} "
+              f"{'REFS':>4s}  HOLDERS")
+        rows = sorted(by_site[g["site"]],
+                      key=lambda r: -(r.get("size_bytes") or 0))
+        for r in rows[:args.limit]:
+            holders = " -> ".join(
+                f"{h.get('proc') or '?'}:{h.get('pid') or '?'}"
+                + (f" ({h['task']})" if h.get("task") else "")
+                for h in (r.get("holders") or ())) or "-"
+            age = (f"{r['age_s']:.0f}s"
+                   if r.get("age_s") is not None else "-")
+            # full 40-hex ids: creator processes share an id prefix, so a
+            # truncated id is ambiguous
+            print(f"    {r['object_id']:40s} "
+                  f"{r.get('size_bytes') or 0:>10d} {age:>7s} "
+                  f"{r.get('seal_state') or '?':8s} "
+                  f"{r.get('ref_count', 0):>4d}  {holders}")
+        if len(rows) > args.limit:
+            print(f"    ... {len(rows) - args.limit} more")
+    if args.leaks:
+        # GCS-lost ids keep held_lost classification alive across store
+        # daemon restarts (the daemon's tombstone ring dies with it)
+        lost = state_mod.lost_held_ids(
+            audits, tables,
+            lambda oid: _rpc(sock, "object_lost", {"oid": oid}))
+        rep = state_mod.leak_report(audits, tables, args.leak_age,
+                                    lost_ids=lost)
+        th = rep["thresholds"]
+        print(f"\n======== Leak report ({rep['checked_objects']} objects "
+              f"checked, age threshold {th['age_s']:g}s) ========")
+        for leak in rep["leaks"]:
+            print(f"  [{leak['kind']:12s}] {leak['object_id']} "
+                  f"{leak.get('size_bytes') or 0:>10d}B "
+                  f"node={(leak.get('node_id') or '?')[:12]}  "
+                  f"{leak['detail']}; site: {leak.get('site') or '?'}")
+        if not rep["leaks"]:
+            print("  (no leaks detected)")
+
+
+def cmd_logs(args):
+    """Task-attributed worker logs: each node's log monitor captures
+    worker stdout/stderr tagged with the task executing at capture time
+    (a bounded ring on the scheduler); filter by task name / task-id
+    prefix (--task) or trace-id prefix (--trace)."""
+    sock = find_address(args.address)
+    rows = []
+    for n in _rpc(sock, "list_nodes"):
         if not n["alive"]:
             continue
         try:
-            stats = _rpc(n["sched_socket"], "store_stats")
-        except Exception as e:  # noqa: BLE001
-            print(f"  {n['node_id'].hex()[:12]}  unreachable: {e}")
+            part = _rpc(n["sched_socket"], "logs_search",
+                        {"task": args.task or "", "trace": args.trace or "",
+                         "limit": args.limit})
+        except Exception:
             continue
-        line = " ".join(f"{k}={v}" for k, v in sorted(stats.items()))
-        print(f"  {n['node_id'].hex()[:12]}  {line}")
-    locs = _rpc(sock, "list_object_locations")
-    print(f"Objects tracked in directory: {len(locs)}")
+        for r in part:
+            if isinstance(r.get("node"), bytes):
+                r["node"] = r["node"].hex()
+        rows.extend(part)
+    rows.sort(key=lambda r: r.get("ts") or 0.0)
+    rows = rows[-args.limit:]
+    if not rows:
+        what = " matching the filter" if (args.task or args.trace) else ""
+        print(f"(no captured worker log lines{what})")
+        return
+    for r in rows:
+        when = time.strftime("%H:%M:%S", time.localtime(r.get("ts") or 0))
+        stream = "!" if r.get("stream") == "stderr" else " "
+        print(f"{when} {(r.get('node') or '?')[:8]} {r['worker']:>14s} "
+              f"{r.get('task') or '-':<20s}{stream} {r['line']}")
 
 
 def cmd_stack(args):
@@ -654,11 +779,35 @@ def cmd_data(args):
 def main(argv=None):
     p = argparse.ArgumentParser(prog="ray_tpu")
     sub = p.add_subparsers(dest="command", required=True)
-    for name, fn in [("status", cmd_status), ("memory", cmd_memory),
+    for name, fn in [("status", cmd_status),
                      ("stack", cmd_stack), ("summary", cmd_summary)]:
         sp = sub.add_parser(name)
         sp.add_argument("--address", default=None)
         sp.set_defaults(fn=fn)
+    sp = sub.add_parser("memory")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--filter", action="append", default=[],
+                    metavar="KEY=VALUE",
+                    help="keep objects whose rendered field equals VALUE "
+                         "(same key=value filters as list_tasks); "
+                         "repeatable")
+    sp.add_argument("--limit", type=int, default=10,
+                    help="object rows shown per call-site group")
+    sp.add_argument("--leaks", action="store_true",
+                    help="append the leak report (unreferenced bytes, "
+                         "age outliers, refs on evicted objects)")
+    sp.add_argument("--leak-age", type=float, default=None,
+                    help="age-outlier threshold seconds "
+                         "(default RTPU_LEAK_AGE_S)")
+    sp.set_defaults(fn=cmd_memory)
+    sp = sub.add_parser("logs")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--task", default=None,
+                    help="task name or task-id hex prefix to filter by")
+    sp.add_argument("--trace", default=None,
+                    help="trace-id hex prefix to filter by")
+    sp.add_argument("--limit", type=int, default=1000)
+    sp.set_defaults(fn=cmd_logs)
     sp = sub.add_parser("timeline")
     sp.add_argument("--address", default=None)
     sp.add_argument("--output", "-o", default=None)

@@ -97,11 +97,334 @@ def list_tasks(filters: Optional[list] = None) -> List[Dict[str, Any]]:
     return rows
 
 
-def list_objects() -> List[Dict[str, Any]]:
+def list_refs() -> List[Dict[str, Any]]:
+    """Merged per-process reference tables cluster-wide (refs_push lane):
+    one record per worker/driver with its live ObjectRef rows (count,
+    pin/lineage membership, and — when RTPU_RECORD_REF_CREATION_SITES is
+    on — the creating call site, task and trace).  Flushes the driver's
+    own table first so just-created refs are part of the answer."""
+    from ray_tpu._private import ref_tracker
+
+    ref_tracker.flush_refs()
+    tables: List[dict] = []
+    for n in _alive_nodes():
+        try:
+            tables.extend(_node_rpc(n["sched_socket"], "list_refs"))
+        except (OSError, RuntimeError):
+            continue
+    for t in tables:
+        if isinstance(t.get("node"), bytes):
+            t["node"] = t["node"].hex()
+    return tables
+
+
+def store_audits(max_rows: Optional[int] = None,
+                 max_tombstones: int = 4096) -> List[Dict[str, Any]]:
+    """Per-node object-store audits (shm daemon OP_AUDIT): occupancy/
+    fragmentation summary + per-object rows + recent eviction
+    tombstones, stamped with the owning node id."""
+    params: Dict[str, Any] = {"max_tombstones": max_tombstones}
+    if max_rows is not None:
+        params["max_rows"] = max_rows
+    out: List[dict] = []
+    for n in _alive_nodes():
+        try:
+            doc = _node_rpc(n["sched_socket"], "store_audit", params)
+        except (OSError, RuntimeError):
+            continue
+        doc["node_id"] = n["node_id"].hex()
+        out.append(doc)
+    return out
+
+
+def list_objects(filters: Optional[list] = None) -> List[Dict[str, Any]]:
+    """One row per known object: the store audit (size, seal state, pin
+    count, age, idle time) joined with the GCS location directory
+    (primary copy) and the merged reference tables (holders: which
+    process created/holds the ref, at which call site, under which
+    task/trace).  Filters are (key, '=', value) triples on the rendered
+    rows — the same syntax :func:`list_tasks` supports."""
     locs = _rpc("list_object_locations")
-    return [{"object_id": oid.hex(),
-             "locations": [n.hex() for n in nodes]}
-            for oid, nodes in locs.items()]
+    loc_by_hex = {oid.hex(): [n.hex() for n in nodes]
+                  for oid, nodes in locs.items()}
+    out = merge_object_rows(store_audits(), list_refs(), loc_by_hex)
+    for key, op, value in (filters or ()):
+        if op != "=":
+            raise ValueError(f"unsupported filter op {op!r}")
+        out = [r for r in out
+               if r.get(key) == value or str(r.get(key)) == str(value)]
+    return out
+
+
+def merge_object_rows(audits: List[dict], tables: List[dict],
+                      loc_by_hex: Dict[str, list]) -> List[Dict[str, Any]]:
+    """Pure join of per-node store audits + merged reference tables + the
+    GCS location directory into :func:`list_objects` rows.  The CLI
+    fetches the three inputs over raw scheduler RPC (it has no driver
+    context) and reuses this merge."""
+    holders: Dict[str, List[dict]] = {}
+    sites: Dict[str, dict] = {}  # attribution even after refs died
+    for table in tables:
+        for r in table.get("refs") or ():
+            oid = r["object_id"]
+            # a real user site beats "<internal>" (a worker creating its
+            # own return object records no user frame)
+            if (r.get("site") and r["site"] != "<internal>"
+                    and oid not in sites):
+                sites[oid] = r
+            if r.get("kind") == "dropped":
+                continue  # attribution-only row, nothing holds the oid
+            holders.setdefault(oid, []).append({
+                "node": table.get("node"), "proc": table.get("proc"),
+                "pid": table.get("pid"), "count": r.get("count", 0),
+                "pinned": r.get("pinned", False),
+                "lineage": r.get("lineage", False),
+                "site": r.get("site"), "task": r.get("task"),
+                "trace_id": r.get("trace_id"), "kind": r.get("kind"),
+            })
+    rows: Dict[str, dict] = {}
+    for doc in audits:
+        nid = doc["node_id"]
+        for o in doc.get("objects") or ():
+            oid = o["id"]
+            row = rows.get(oid)
+            if row is None:
+                hs = holders.get(oid, [])
+                src = (next((h for h in hs
+                             if h.get("site")
+                             and h["site"] != "<internal>"), None)
+                       or sites.get(oid))
+                locations = loc_by_hex.get(oid, [])
+                row = rows[oid] = {
+                    "object_id": oid,
+                    "size_bytes": o.get("size", 0),
+                    "seal_state": "SEALED" if o.get("sealed") else
+                                  "CREATED",
+                    "pinned": bool(o.get("refcount", 0) > 0),
+                    "pin_count": o.get("refcount", 0),
+                    "spilled": bool(o.get("spilled")),
+                    "age_s": round(o.get("age_ms", 0) / 1e3, 3),
+                    "idle_s": round(o.get("idle_ms", 0) / 1e3, 3),
+                    "primary_copy": (locations[0] if locations else nid),
+                    "locations": locations or [nid],
+                    "nodes_resident": [],
+                    "ref_count": sum(h["count"] for h in hs),
+                    "holders": hs,
+                    "site": src["site"] if src else None,
+                    "task": src["task"] if src else None,
+                    "trace_id": src["trace_id"] if src else None,
+                }
+            row["nodes_resident"].append(nid)
+    # refs whose object is not resident anywhere (pending, inlined, or
+    # lost): still one row each, so `rtpu memory` explains every holder
+    for oid, hs in holders.items():
+        if oid in rows:
+            continue
+        src = (next((h for h in hs if h.get("site")
+                     and h["site"] != "<internal>"), None)
+               or sites.get(oid))
+        locations = loc_by_hex.get(oid, [])
+        rows[oid] = {
+            "object_id": oid, "size_bytes": 0, "seal_state": "ABSENT",
+            "pinned": False, "pin_count": 0, "spilled": False,
+            "age_s": None, "idle_s": None,
+            "primary_copy": locations[0] if locations else None,
+            "locations": locations, "nodes_resident": [],
+            "ref_count": sum(h["count"] for h in hs), "holders": hs,
+            "site": src["site"] if src else None,
+            "task": src["task"] if src else None,
+            "trace_id": src["trace_id"] if src else None,
+        }
+    return list(rows.values())
+
+
+def detect_leaks(age_s: Optional[float] = None,
+                 grace_s: float = 10.0) -> Dict[str, Any]:
+    """Cross-reference store-resident objects against the merged
+    reference tables and flag:
+
+    - ``unreferenced``: sealed, unpinned bytes no process holds a ref to
+      (and no lineage entry can recover a consumer for) — orphaned until
+      LRU pressure happens to evict them.  A ``grace_s`` window skips
+      objects younger than the refs flush interval.
+    - ``age_outlier``: resident objects older than ``age_s`` (default
+      RTPU_LEAK_AGE_S) that have not been read since creation.
+    - ``held_lost``: refs still held on objects that are gone from every
+      store (eviction tombstone) — attributed to their creating call
+      site so the holder can be found even after a daemon restart.
+
+    Tombstoned ids themselves are NEVER leaks: a tombstone means the
+    store already reclaimed (or never kept) the bytes."""
+    audits = store_audits()
+    tables = list_refs()
+    lost = lost_held_ids(audits, tables,
+                         lambda oid: _rpc("object_lost", {"oid": oid}))
+    return leak_report(audits, tables, age_s, grace_s, lost_ids=lost)
+
+
+def lost_held_ids(audits: List[dict], tables: List[dict], query,
+                  cap: int = 512) -> set:
+    """GCS-lost ids among held-but-nowhere-resident refs.  The daemon's
+    eviction-tombstone ring dies with the daemon, so after a store
+    restart the durable GCS loss record is what lets ``held_lost``
+    classification still fire; ``query(oid_bytes) -> bool`` is the
+    caller's ``object_lost`` RPC (the CLI supplies its own transport)."""
+    resident = {o["id"] for doc in audits
+                for o in doc.get("objects") or ()}
+    tomb = {t for doc in audits for t in doc.get("tombstone_ids") or ()}
+    # live refs only: lost_ids feed held_lost classification, and a
+    # lineage-only row on a lost object is reclamation, not a leak
+    held = {r["object_id"] for table in tables
+            for r in table.get("refs") or ()
+            if r.get("count", 0) > 0}
+    lost: set = set()
+    for oid in sorted(held - resident - tomb)[:cap]:
+        try:
+            if query(bytes.fromhex(oid)):
+                lost.add(oid)
+        except Exception:
+            break  # best-effort: a dead head just means no extra class
+    return lost
+
+
+def leak_report(audits: List[dict], tables: List[dict],
+                age_s: Optional[float] = None,
+                grace_s: float = 10.0,
+                lost_ids: Optional[set] = None) -> Dict[str, Any]:
+    """Pure leak cross-reference over already-fetched audits/ref tables
+    (classes as documented on :func:`detect_leaks`).  ``lost_ids``
+    extends the store tombstones with GCS-lost ids (``lost_held_ids``)
+    so held refs on objects wiped by a daemon restart still classify."""
+    from ray_tpu._private import flags
+
+    if age_s is None:
+        age_s = float(flags.get("RTPU_LEAK_AGE_S"))
+    tombstones = set(lost_ids or ())
+    for doc in audits:
+        tombstones.update(doc.get("tombstone_ids") or ())
+    held: Dict[str, List[dict]] = {}
+    sites: Dict[str, dict] = {}  # attribution, incl. dropped-prov rows
+    for table in tables:
+        for r in table.get("refs") or ():
+            if r.get("site") and r["object_id"] not in sites:
+                sites[r["object_id"]] = r
+            if r.get("count", 0) > 0 or r.get("lineage"):
+                held.setdefault(r["object_id"], []).append(dict(
+                    r, node=table.get("node"), proc=table.get("proc"),
+                    pid=table.get("pid")))
+    leaks: List[dict] = []
+    resident: set = set()
+    checked = 0
+    for doc in audits:
+        nid = doc["node_id"]
+        for o in doc.get("objects") or ():
+            oid = o["id"]
+            resident.add(oid)
+            checked += 1
+            age = o.get("age_ms", 0) / 1e3
+            idle = o.get("idle_ms", 0) / 1e3
+            hs = held.get(oid)
+            src = sites.get(oid) or {}
+            site = next((h.get("site") for h in (hs or ())
+                         if h.get("site")), None) or src.get("site")
+            task = next((h.get("task") for h in (hs or ())
+                         if h.get("task")), None) or src.get("task")
+            if (hs is None and o.get("sealed")
+                    and not o.get("refcount") and age > grace_s):
+                leaks.append({
+                    "kind": "unreferenced", "object_id": oid,
+                    "node_id": nid, "size_bytes": o.get("size", 0),
+                    "age_s": round(age, 3), "site": site, "task": task,
+                    "detail": "no live ref in any process"})
+            elif age > age_s and idle >= age - grace_s:
+                leaks.append({
+                    "kind": "age_outlier", "object_id": oid,
+                    "node_id": nid, "size_bytes": o.get("size", 0),
+                    "age_s": round(age, 3), "site": site, "task": task,
+                    "detail": f"resident {age:.0f}s, never re-read"})
+    for oid, hs in held.items():
+        if oid in resident or oid not in tombstones:
+            continue
+        live = sum(h.get("count", 0) for h in hs)
+        if live <= 0:
+            # lineage bookkeeping only: no process can still read this
+            # oid, so its loss is reclamation, not a leak
+            continue
+        src = next((h for h in hs if h.get("site")), hs[0])
+        leaks.append({
+            "kind": "held_lost", "object_id": oid,
+            "node_id": src.get("node"), "size_bytes": 0,
+            "age_s": src.get("age_s"), "site": src.get("site"),
+            "task": src.get("task"),
+            "detail": f"{live} live ref(s) on a store-evicted object"})
+    leaks.sort(key=lambda r: r.get("size_bytes") or 0, reverse=True)
+    return {"leaks": leaks, "checked_objects": checked,
+            "nodes": len(audits),
+            "thresholds": {"age_s": age_s, "grace_s": grace_s}}
+
+
+def memory_summary() -> Dict[str, Any]:
+    """The `ray memory` view: cluster objects grouped by creation call
+    site (size totals, counts, ages, holder tasks), plus each node's
+    occupancy/fragmentation summary and the leak report.  Shared by the
+    dashboard's /api/memory and the `rtpu memory` CLI."""
+    objects = list_objects()
+    node_summaries = [dict((doc.get("summary") or {}),
+                           node_id=doc["node_id"])
+                      for doc in store_audits(max_rows=0)]
+    return {"groups": group_objects_by_site(objects),
+            "objects": len(objects),
+            "nodes": node_summaries, "leak_report": detect_leaks()}
+
+
+def group_objects_by_site(objects: List[dict]) -> List[Dict[str, Any]]:
+    """Pure `ray memory`-style grouping of :func:`list_objects` rows by
+    creation call site, largest total first."""
+    groups: Dict[str, dict] = {}
+    for r in objects:
+        key = r.get("site") or "(no call site recorded)"
+        g = groups.setdefault(key, {
+            "site": key, "count": 0, "total_bytes": 0, "ref_count": 0,
+            "pinned": 0, "max_age_s": 0.0, "tasks": set(), "kinds": set(),
+            "example": r["object_id"]})
+        g["count"] += 1
+        g["total_bytes"] += r.get("size_bytes") or 0
+        g["ref_count"] += r.get("ref_count") or 0
+        g["pinned"] += 1 if r.get("pinned") else 0
+        g["max_age_s"] = max(g["max_age_s"], r.get("age_s") or 0.0)
+        if r.get("task"):
+            g["tasks"].add(r["task"])
+        for h in r.get("holders") or ():
+            if h.get("kind"):
+                g["kinds"].add(h["kind"])
+    rows = []
+    for g in groups.values():
+        g["tasks"] = sorted(g["tasks"])
+        g["kinds"] = sorted(g["kinds"])
+        rows.append(g)
+    rows.sort(key=lambda g: g["total_bytes"], reverse=True)
+    return rows
+
+
+def search_logs(task: Optional[str] = None, trace: Optional[str] = None,
+                limit: int = 1000) -> List[Dict[str, Any]]:
+    """Task-attributed worker-log lines cluster-wide (the log monitor's
+    ring on each node), filtered by task name / task-id prefix and/or
+    trace-id prefix, oldest first."""
+    rows: List[dict] = []
+    for n in _alive_nodes():
+        try:
+            part = _node_rpc(n["sched_socket"], "logs_search",
+                             {"task": task or "", "trace": trace or "",
+                              "limit": limit})
+        except (OSError, RuntimeError):
+            continue
+        for r in part:
+            if isinstance(r.get("node"), bytes):
+                r["node"] = r["node"].hex()
+        rows.extend(part)
+    rows.sort(key=lambda r: r.get("ts") or 0.0)
+    return rows[-limit:]
 
 
 def list_placement_groups() -> List[Dict[str, Any]]:

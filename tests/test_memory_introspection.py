@@ -1,0 +1,384 @@
+"""Object & memory introspection: ref provenance, store audit, leaks.
+
+The `ray memory` counterpart: per-process reference tables with call-site
+provenance (_private/ref_tracker.py), the shm daemon's OP_AUDIT
+(native/shm_store.cc AuditJson), and the pure merge/leak cross-reference
+in util/state.py that every surface (state API, dashboard /api/memory,
+`rtpu memory`) shares.  The restart tests pin the two recovery contracts:
+a tombstoned object is never a leak, and a deliberately leaked ref keeps
+its call-site attribution across a store-daemon SIGKILL (held_lost via
+the durable GCS loss record, since the daemon's tombstone ring dies with
+the daemon).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from ray_tpu.core.store_client import StoreClient, StoreServer
+from ray_tpu.util.state import (
+    group_objects_by_site,
+    leak_report,
+    lost_held_ids,
+    merge_object_rows,
+)
+
+O1 = "aa" * 20
+O2 = "bb" * 20
+O3 = "cc" * 20
+
+
+def _audit(node="11" * 8, objects=(), tombstones=()):
+    return {"node_id": node, "objects": list(objects),
+            "tombstone_ids": list(tombstones), "summary": {}}
+
+
+def _obj(oid, size=1000, sealed=True, refcount=0, age_ms=0, idle_ms=0):
+    return {"id": oid, "size": size, "sealed": sealed,
+            "refcount": refcount, "age_ms": age_ms, "idle_ms": idle_ms,
+            "spilled": 0}
+
+
+def _table(refs, node="11" * 8, proc="driver", pid=1):
+    return {"node": node, "proc": proc, "pid": pid, "refs": list(refs)}
+
+
+def _ref(oid, count=1, site=None, task=None, kind="ref", lineage=False,
+         pinned=False):
+    return {"object_id": oid, "count": count, "pinned": pinned,
+            "lineage": lineage, "site": site, "task": task,
+            "trace_id": None, "kind": kind, "escaped": False,
+            "age_s": 1.0}
+
+
+# ---------------------------------------------------------------------------
+# merge_object_rows: the list_objects join
+
+
+def test_merge_joins_audit_refs_and_locations():
+    audits = [_audit(objects=[_obj(O1, size=4096, refcount=2,
+                                   age_ms=5000, idle_ms=1500)])]
+    tables = [_table([_ref(O1, count=3, site="/app/train.py:10",
+                           task="train_step")])]
+    rows = merge_object_rows(audits, tables, {O1: ["11" * 8, "22" * 8]})
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["object_id"] == O1
+    assert r["size_bytes"] == 4096
+    assert r["seal_state"] == "SEALED"
+    assert r["pinned"] and r["pin_count"] == 2
+    assert r["age_s"] == 5.0 and r["idle_s"] == 1.5
+    assert r["primary_copy"] == "11" * 8
+    assert r["ref_count"] == 3
+    assert r["site"] == "/app/train.py:10" and r["task"] == "train_step"
+
+
+def test_merge_prefers_user_site_over_internal():
+    # a worker creating its own return object records "<internal>"; the
+    # driver's real user frame must win the attribution
+    audits = [_audit(objects=[_obj(O1)])]
+    tables = [
+        _table([_ref(O1, site="<internal>", kind="task_return")],
+               proc="worker", pid=7),
+        _table([_ref(O1, site="/app/main.py:3", task="f")]),
+    ]
+    r = merge_object_rows(audits, tables, {})[0]
+    assert r["site"] == "/app/main.py:3"
+    assert len(r["holders"]) == 2
+
+
+def test_merge_emits_absent_rows_for_held_nonresident():
+    tables = [_table([_ref(O2, count=2, site="/app/main.py:9")])]
+    rows = merge_object_rows([_audit(objects=[])], tables, {})
+    assert [r["object_id"] for r in rows] == [O2]
+    assert rows[0]["seal_state"] == "ABSENT"
+    assert rows[0]["ref_count"] == 2
+    assert rows[0]["site"] == "/app/main.py:9"
+
+
+def test_merge_dropped_rows_attribute_without_holding():
+    # a count-0 "dropped" row is provenance only: it names the site but
+    # must never count as a holder or a ref
+    audits = [_audit(objects=[_obj(O1, size=123)])]
+    tables = [_table([_ref(O1, count=0, site="/app/gen.py:5",
+                           kind="dropped")])]
+    r = merge_object_rows(audits, tables, {})[0]
+    assert r["site"] == "/app/gen.py:5"
+    assert r["holders"] == [] and r["ref_count"] == 0
+
+
+# ---------------------------------------------------------------------------
+# leak_report: the three classes and their negations
+
+
+def test_leak_unreferenced_after_grace():
+    audits = [_audit(objects=[
+        _obj(O1, size=9000, age_ms=60_000),          # orphaned: leak
+        _obj(O2, size=100, age_ms=1_000),            # young: in grace
+        _obj(O3, size=50, refcount=1, age_ms=60_000),  # pinned: not a leak
+    ])]
+    rep = leak_report(audits, [], age_s=3600.0, grace_s=10.0)
+    assert [(l["kind"], l["object_id"]) for l in rep["leaks"]] == [
+        ("unreferenced", O1)]
+    assert rep["checked_objects"] == 3
+
+
+def test_leak_age_outlier_only_when_never_reread():
+    audits = [_audit(objects=[
+        _obj(O1, size=500, age_ms=400_000, idle_ms=395_000),  # never read
+        _obj(O2, size=500, age_ms=400_000, idle_ms=2_000),    # hot: fine
+    ])]
+    tables = [_table([_ref(O1, site="/app/a.py:1"),
+                      _ref(O2, site="/app/a.py:2")])]
+    rep = leak_report(audits, tables, age_s=300.0, grace_s=10.0)
+    assert [(l["kind"], l["object_id"]) for l in rep["leaks"]] == [
+        ("age_outlier", O1)]
+    assert rep["leaks"][0]["site"] == "/app/a.py:1"
+
+
+def test_leak_held_lost_and_tombstones_never_leak():
+    # O1: tombstoned AND still held -> held_lost, attributed to its site.
+    # O2: tombstoned, nobody holds it -> NOT a leak (bytes reclaimed).
+    audits = [_audit(objects=[], tombstones=[O1, O2])]
+    tables = [_table([_ref(O1, count=2, site="/app/leaky.py:42",
+                           task="gen")])]
+    rep = leak_report(audits, tables, age_s=3600.0, grace_s=0.0)
+    assert len(rep["leaks"]) == 1
+    leak = rep["leaks"][0]
+    assert leak["kind"] == "held_lost" and leak["object_id"] == O1
+    assert leak["site"] == "/app/leaky.py:42" and leak["task"] == "gen"
+
+
+def test_leak_lost_ids_extend_tombstones():
+    # daemon restarted: its tombstone ring is empty, but the GCS loss
+    # record (lost_ids) still classifies the held ref
+    tables = [_table([_ref(O1, count=1, site="/app/leaky.py:7")])]
+    rep = leak_report([_audit()], tables, age_s=3600.0, grace_s=0.0)
+    assert rep["leaks"] == []  # not resident, not known lost: no verdict
+    rep = leak_report([_audit()], tables, age_s=3600.0, grace_s=0.0,
+                      lost_ids={O1})
+    assert [(l["kind"], l["site"]) for l in rep["leaks"]] == [
+        ("held_lost", "/app/leaky.py:7")]
+
+
+def test_lost_held_ids_queries_only_candidates():
+    # resident and already-tombstoned ids never hit the GCS; only the
+    # held-but-nowhere ids do
+    audits = [_audit(objects=[_obj(O1)], tombstones=[O2])]
+    tables = [_table([_ref(O1), _ref(O2), _ref(O3)])]
+    asked = []
+
+    def query(oid):
+        asked.append(oid.hex())
+        return True
+
+    lost = lost_held_ids(audits, tables, query)
+    assert asked == [O3]
+    assert lost == {O3}
+
+
+# ---------------------------------------------------------------------------
+# group_objects_by_site: the `ray memory` grouping
+
+
+def test_group_by_site_totals_and_order():
+    rows = [
+        {"object_id": O1, "site": "/app/a.py:1", "size_bytes": 100,
+         "ref_count": 1, "pinned": True, "age_s": 5.0, "task": "f",
+         "holders": [{"kind": "put"}]},
+        {"object_id": O2, "site": "/app/a.py:1", "size_bytes": 300,
+         "ref_count": 2, "pinned": False, "age_s": 9.0, "task": "g",
+         "holders": []},
+        {"object_id": O3, "site": None, "size_bytes": 50, "ref_count": 0,
+         "pinned": False, "age_s": 1.0, "task": None, "holders": []},
+    ]
+    groups = group_objects_by_site(rows)
+    assert [g["site"] for g in groups] == [
+        "/app/a.py:1", "(no call site recorded)"]
+    g = groups[0]
+    assert g["count"] == 2 and g["total_bytes"] == 400
+    assert g["ref_count"] == 3 and g["pinned"] == 1
+    assert g["max_age_s"] == 9.0 and g["tasks"] == ["f", "g"]
+    assert g["kinds"] == ["put"]
+
+
+# ---------------------------------------------------------------------------
+# ref_tracker: provenance capture + dropped ring
+
+
+def test_ref_tracker_provenance_and_dropped_ring(monkeypatch):
+    from ray_tpu._private import ref_tracker as rt
+
+    monkeypatch.setattr(rt, "_record_sites", True)
+    rt.clear()
+    oid = os.urandom(20)
+
+    # two wrappers stand in for the production depth (_on_ref_created ->
+    # ObjectRef.__init__) that _call_site's _getframe(3) skips over
+    def _hook(o):
+        rt.note_created(o)
+
+    def _create(o):
+        _hook(o)
+
+    _create(oid)
+    rt.annotate(oid, kind="put", escaped=True)
+    ctx = SimpleNamespace(_ref_counts={oid: 2}, _owned_puts={oid},
+                          _lineage=set())
+    rows = rt.snapshot(ctx)
+    assert len(rows) == 1
+    r = rows[0]
+    # this test file is outside the package: the site is OUR line above
+    assert os.path.basename(__file__) in (r["site"] or "")
+    assert r["count"] == 2 and r["pinned"] and r["kind"] == "put"
+    # last ref dies: provenance moves to the dropped ring and resurfaces
+    # as a count-0 attribution-only row
+    rt.note_deleted(oid)
+    rows = rt.snapshot(SimpleNamespace(_ref_counts={}, _owned_puts=set(),
+                                       _lineage=set()))
+    dropped = [x for x in rows if x["kind"] == "dropped"]
+    assert len(dropped) == 1
+    assert dropped[0]["count"] == 0
+    assert os.path.basename(__file__) in (dropped[0]["site"] or "")
+    rt.clear()
+
+
+# ---------------------------------------------------------------------------
+# store OP_AUDIT end to end against a real daemon
+
+
+@pytest.fixture
+def store_pair(tmp_path):
+    srv = StoreServer(str(tmp_path / "store.sock"),
+                      f"rtpu_aud_{os.getpid()}", 1 << 22)
+    client = StoreClient(srv.socket_path, srv.shm_name, srv.capacity)
+    yield srv, client
+    client.close()
+    srv.shutdown()
+
+
+def test_store_audit_rows_summary_and_tombstones(store_pair):
+    srv, client = store_pair
+    a, b = os.urandom(20), os.urandom(20)
+    client.put(a, b"x" * 4096)
+    client.put(b, b"y" * 1024)
+    client.release(a)
+    client.release(b)
+    doc = client.audit()
+    s = doc["summary"]
+    assert s["capacity"] == 1 << 22
+    assert s["used"] >= 5120 and s["num_objects"] == 2
+    assert 0.0 < s["occupancy"] < 1.0
+    assert 0.0 <= s["fragmentation"] <= 1.0
+    rows = {r["id"]: r for r in doc["objects"]}
+    assert rows[a.hex()]["size"] == 4096 and rows[a.hex()]["sealed"] == 1
+    assert rows[b.hex()]["size"] == 1024
+    # max_rows=0 is summary-only, not "no cap"
+    lean = client.audit(max_rows=0)
+    assert lean["objects"] == [] and lean["objects_dropped"] == 2
+    assert lean["summary"]["num_objects"] == 2
+    # a deleted object leaves the rows and enters the tombstone ring
+    client.delete(a)
+    doc = client.audit()
+    assert a.hex() not in {r["id"] for r in doc["objects"]}
+    assert a.hex() in doc["tombstone_ids"]
+
+
+# ---------------------------------------------------------------------------
+# leak detection across a store-daemon restart (cluster, subprocess)
+
+_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "PATH": "/usr/bin:/bin:/usr/local/bin",
+    "PYTHONPATH": ".",
+    "HOME": "/root",
+    "RTPU_REFS_FLUSH_S": "0.5",
+}
+
+
+def _run(script):
+    proc = subprocess.run([sys.executable, "-c", textwrap.dedent(script)],
+                          capture_output=True, text=True, timeout=300,
+                          env=dict(_ENV), cwd="/root/repo")
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    return proc.stdout
+
+
+def test_tombstoned_objects_are_not_leaks_after_store_restart():
+    """Objects whose refs were dropped BEFORE the daemon died are
+    reclaimed-by-definition after recovery: the detector must not
+    resurrect them as leaks of any class."""
+    out = _run("""
+        import os, signal, time
+        import numpy as np
+        import ray_tpu
+        ray_tpu.init(resources={"CPU": 4.0})
+        import ray_tpu.api as api
+        node = api._global_node
+
+        @ray_tpu.remote
+        def produce(tag):
+            return np.full((50_000,), tag, dtype=np.int64)
+
+        refs = [produce.remote(i) for i in range(4)]
+        for i in range(len(refs)):
+            ray_tpu.get(refs[i], timeout=60)
+        gone = [x.hex() for x in refs]
+        del refs  # every ref dies before the crash
+        time.sleep(0.5)
+        os.kill(node.store_server._proc.pid, signal.SIGKILL)
+        deadline = time.monotonic() + 30
+        while (node.store_server.incarnation < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+        assert node.store_server.incarnation >= 1, "no daemon recovery"
+        time.sleep(1.5)  # loss registration + refs flush
+        from ray_tpu.util import state
+        rep = state.detect_leaks(age_s=3600.0, grace_s=3600.0)
+        leaked = {l["object_id"] for l in rep["leaks"]}
+        overlap = leaked & set(gone)
+        assert not overlap, (overlap, rep["leaks"])
+        print("NO-FALSE-LEAKS")
+        ray_tpu.shutdown()
+    """)
+    assert "NO-FALSE-LEAKS" in out
+
+
+def test_leaked_ref_keeps_call_site_across_store_restart():
+    """A ref held across a daemon SIGKILL points at bytes that no longer
+    exist anywhere: held_lost, attributed to the creating call site via
+    the GCS loss record (the daemon's own tombstone ring was wiped)."""
+    out = _run("""
+        import os, signal, time
+        import ray_tpu
+        ray_tpu.init(resources={"CPU": 2.0})
+        import ray_tpu.api as api
+        node = api._global_node
+        leaked = ray_tpu.put(b"x" * (1 << 20))  # LEAK-SITE
+        time.sleep(1.0)  # location publish + refs flush
+        os.kill(node.store_server._proc.pid, signal.SIGKILL)
+        deadline = time.monotonic() + 30
+        while (node.store_server.incarnation < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+        assert node.store_server.incarnation >= 1, "no daemon recovery"
+        time.sleep(1.5)
+        from ray_tpu.util import state
+        rep = state.detect_leaks(age_s=3600.0, grace_s=3600.0)
+        mine = [l for l in rep["leaks"]
+                if l["object_id"] == leaked.hex()]
+        assert mine, rep["leaks"]
+        assert mine[0]["kind"] == "held_lost", mine[0]
+        site = mine[0]["site"] or ""
+        assert "<string>" in site, mine[0]  # this -c script's frame
+        print("HELD-LOST", site)
+        del leaked
+        ray_tpu.shutdown()
+    """)
+    assert "HELD-LOST" in out
