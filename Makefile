@@ -49,6 +49,14 @@ bench-store:
 bench-data:
 	JAX_PLATFORMS=cpu python -m ray_tpu._private.data_bench | tee BENCH_data.json
 
+# Serving load wall: a concurrency ladder of shared-prefix traffic over
+# two real LLM engines behind the real request routers (pow-2 vs
+# prefix-aware), page pool sized below the working set so the top rung
+# hits eviction + preemption.  The committed BENCH_serve.json is its
+# capture.
+bench-serve:
+	JAX_PLATFORMS=cpu python -m ray_tpu._private.serve_bench | tee BENCH_serve.json
+
 # Control-plane scale envelope: 1M queued plain tasks through the native
 # raylet lane (queue-time spillback path active, shape-indexed backlog),
 # plus the actor/PG/node scenarios.  Writes BENCH_scale.json; the
@@ -58,4 +66,4 @@ bench-data:
 bench-scale:
 	JAX_PLATFORMS=cpu python -m ray_tpu._private.scale_bench
 
-.PHONY: sanitize test obs-smoke bench-store bench-data bench-scale
+.PHONY: sanitize test obs-smoke bench-store bench-data bench-serve bench-scale

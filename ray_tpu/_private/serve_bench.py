@@ -1,0 +1,266 @@
+"""Serving load-wall benchmark: prefix-aware vs pow-2 routing.
+
+A concurrency ladder of shared-prefix chat-style traffic (G prompt
+families, each = a 24-token shared prefix + a unique tail) driven through
+TWO real LLM engines behind the REAL request-router classes
+(serve/request_router/) — no cluster, no actors, so the numbers isolate
+routing policy + engine paging, not RPC overhead.  The page pool is sized
+BELOW the working set (max_slots * pages-per-seq > num_pages), so the top
+rung drives both engines into prefix-cache page eviction and
+recompute-preemption: the serving load wall.
+
+Per rung and policy: TTFT p50/p90, request/token throughput, engine
+preemptions + page evictions, and the aggregate prefix-cache hit rate.
+The acceptance block asserts the top rung saw NONZERO preemptions and
+evictions and that prefix-aware routing beat pow-2 on hit rate.
+
+Run: ``make bench-serve`` or ``python -m ray_tpu._private.serve_bench``
+(from the repo root).  Prints one JSON line: ``{"serve_bench": {...}}``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import threading
+import time
+
+# engine geometry: sequences grow from 5 pages at admission to 8 by the
+# last decode step, so 8 slots want 64 pages against 39 allocatable —
+# the top rung MUST evict resident prefix pages AND preempt active
+# sequences to make progress
+_PAGE_SIZE = 8
+_NUM_PAGES = 48
+_MAX_SLOTS = 8
+_PREFIX_TOKENS = 24   # shared per family; 3 full pages, all cacheable
+_TAIL_TOKENS = 8      # unique per request
+_MAX_TOKENS = 24
+_FAMILIES = 16
+
+
+class _FakeReplica:
+    def __init__(self, rid: bytes):
+        self.actor_id = rid
+
+
+def _percentile(xs, frac):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return round(xs[int((len(xs) - 1) * frac)] * 1e3, 2)  # ms
+
+
+def _build_requests(n: int, seed: int):
+    rng = random.Random(seed)
+    out = []
+    for i in range(n):
+        fam = i % _FAMILIES
+        base = 1 + (fam * 5) % 90
+        prefix = [base, base + 1, base + 2] * (_PREFIX_TOKENS // 3)
+        tail = [rng.randrange(1, 127) for _ in range(_TAIL_TOKENS)]
+        hint = f"family-{fam:02d}:" + "q" * 48
+        out.append((hint, prefix + tail))
+    return out
+
+
+def _run_cell(model, router_cls, n_requests: int, concurrency: int,
+              seed: int):
+    """One (policy, rung) cell: fresh engines + fresh router."""
+    from ray_tpu.llm.engine import EngineConfig, LLMEngine, SamplingParams
+
+    params, cfg = model
+    engines = {}
+    for rid in (b"e1", b"e2"):
+        eng = LLMEngine(params, cfg, EngineConfig(
+            max_slots=_MAX_SLOTS, num_pages=_NUM_PAGES,
+            page_size=_PAGE_SIZE, max_seq_len=256,
+            prefill_buckets=(16, 32, 64)))
+        eng.start()
+        engines[rid] = eng
+    router = router_cls("bench", f"{router_cls.__name__}-c{concurrency}")
+    router.update_replicas([_FakeReplica(rid) for rid in engines])
+    requests = _build_requests(n_requests, seed)
+    random.seed(seed)
+
+    next_i = [0]
+    ilock = threading.Lock()
+    ttfts, e2es = [], []
+    tokens_out = [0]
+    rlock = threading.Lock()
+    errors = []
+    done = threading.Event()
+
+    def stats_pump():
+        # the controller lane stand-in: periodic replica-stats refresh
+        while not done.wait(0.2):
+            try:
+                router.update_stats({
+                    rid: {"queue_len": (st := e.stats())["waiting"]
+                          + st["active_slots"],
+                          "age_s": 0.0, "engine": st}
+                    for rid, e in engines.items()})
+            except Exception:  # noqa: BLE001 — pump must not die mid-bench
+                pass
+
+    def worker():
+        while True:
+            with ilock:
+                i = next_i[0]
+                if i >= len(requests):
+                    return
+                next_i[0] += 1
+            hint, toks = requests[i]
+            rep = router.choose(hint)
+            router.on_send(rep.actor_id)
+            t0 = time.monotonic()
+            try:
+                req = engines[rep.actor_id].submit(
+                    toks, SamplingParams(max_tokens=_MAX_TOKENS))
+                first = None
+                n_out = 0
+                while True:
+                    item = req.out_queue.get(timeout=300)
+                    if item is None:
+                        break
+                    if isinstance(item, Exception):
+                        raise item
+                    if first is None:
+                        first = time.monotonic() - t0
+                    n_out += 1
+                with rlock:
+                    if first is not None:
+                        ttfts.append(first)
+                    e2es.append(time.monotonic() - t0)
+                    tokens_out[0] += n_out
+            except Exception as e:  # noqa: BLE001
+                with rlock:
+                    errors.append(f"{type(e).__name__}: {e}")
+            finally:
+                router.on_done(rep.actor_id)
+
+    pump = threading.Thread(target=stats_pump, daemon=True)
+    pump.start()
+    t_start = time.monotonic()
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    wall = time.monotonic() - t_start
+    done.set()
+    pump.join(timeout=2)
+
+    preempted = evictions = hits = lookups = 0
+    for e in engines.values():
+        st = e.stats()
+        preempted += st["preempted"]
+        evictions += st["page_evictions"]
+        pc = st["prefix_cache"] or {}
+        hits += pc.get("hit_tokens", 0)
+        lookups += pc.get("lookup_tokens", 0)
+        e.stop()
+    if errors:
+        raise RuntimeError(f"{len(errors)} request(s) failed; first: "
+                           f"{errors[0]}")
+    decisions = dict(router._decisions)
+    return {
+        "requests": len(e2es),
+        "wall_s": round(wall, 2),
+        "req_per_s": round(len(e2es) / wall, 1),
+        "tok_per_s": round(tokens_out[0] / wall, 1),
+        "ttft_p50_ms": _percentile(ttfts, 0.5),
+        "ttft_p90_ms": _percentile(ttfts, 0.9),
+        "e2e_p90_ms": _percentile(e2es, 0.9),
+        "preempted": preempted,
+        "page_evictions": evictions,
+        "prefix_hit_rate": round(hits / max(lookups, 1), 3),
+        "decisions": decisions,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ladder", default="4:128,16:256,32:1024",
+                    help="comma list of concurrency:requests rungs")
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args(argv)
+
+    from ray_tpu.models import llama
+    from ray_tpu.serve.request_router import Pow2Router, PrefixAwareRouter
+
+    import jax
+
+    cfg = llama.LlamaConfig(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=256, dtype="float32", remat=False)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    model = (params, cfg)
+
+    ladder = []
+    for rung in args.ladder.split(","):
+        c, n = rung.split(":")
+        ladder.append((int(c), int(n)))
+
+    # absorb prefill/decode JIT compiles before any timed cell
+    from ray_tpu.llm.engine import EngineConfig, LLMEngine, SamplingParams
+    print("warmup: compiling prefill/decode", file=sys.stderr)
+    warm = LLMEngine(params, cfg, EngineConfig(
+        max_slots=_MAX_SLOTS, num_pages=_NUM_PAGES, page_size=_PAGE_SIZE,
+        max_seq_len=256, prefill_buckets=(16, 32, 64)))
+    warm.generate(list(range(1, _PREFIX_TOKENS + _TAIL_TOKENS + 1)),
+                  SamplingParams(max_tokens=_MAX_TOKENS))
+    warm.stop()
+
+    rows = []
+    for concurrency, n_requests in ladder:
+        row = {"concurrency": concurrency, "requests": n_requests}
+        for name, cls in (("pow2", Pow2Router),
+                          ("prefix_aware", PrefixAwareRouter)):
+            print(f"running: c={concurrency} n={n_requests} policy={name}",
+                  file=sys.stderr)
+            row[name] = _run_cell(model, cls, n_requests, concurrency,
+                                  args.seed)
+            print(f"  {name:13s} {row[name]['req_per_s']:7.1f} req/s  "
+                  f"ttft p50 {row[name]['ttft_p50_ms']}ms  "
+                  f"hit {row[name]['prefix_hit_rate']:.1%}  "
+                  f"preempt {row[name]['preempted']}  "
+                  f"evict {row[name]['page_evictions']}", file=sys.stderr)
+        rows.append(row)
+
+    top = rows[-1]
+    results = {
+        "engines": 2,
+        "max_slots": _MAX_SLOTS,
+        "num_pages": _NUM_PAGES,
+        "page_size": _PAGE_SIZE,
+        "prompt_tokens": _PREFIX_TOKENS + _TAIL_TOKENS,
+        "max_tokens": _MAX_TOKENS,
+        "families": _FAMILIES,
+        "ladder": rows,
+        "acceptance": {
+            "top_rung_requests": top["requests"],
+            "nonzero_preemptions": top["prefix_aware"]["preempted"] > 0
+            and top["pow2"]["preempted"] > 0,
+            "nonzero_page_evictions":
+                top["prefix_aware"]["page_evictions"] > 0
+                and top["pow2"]["page_evictions"] > 0,
+            "prefix_aware_beats_pow2":
+                top["prefix_aware"]["prefix_hit_rate"]
+                > top["pow2"]["prefix_hit_rate"],
+        },
+    }
+    ok = all(bool(v) for k, v in results["acceptance"].items()
+             if k != "top_rung_requests")
+    print(json.dumps({"serve_bench": results}))
+    if not ok:
+        print(f"ACCEPTANCE FAILED: {results['acceptance']}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -382,20 +382,27 @@ class WorkerRuntime:
                 if spec.max_concurrency > 1:
                     self.actor_pools[spec.actor_id] = ThreadPoolExecutor(
                         max_workers=spec.max_concurrency)
-                self._store_returns(spec, None)
+                result = None
             elif spec.kind == ACTOR_METHOD:
                 if self.actor_pools.get(spec.actor_id) is not None:
                     # concurrent actor: the pool provides the parallelism
-                    self._store_returns(spec, self._invoke_method(spec))
+                    result = self._invoke_method(spec)
                 else:
                     # serialize against direct-path deliveries of the same
                     # actor (direct.py executes on per-connection threads)
                     with self.actor_lock(spec.actor_id):
-                        self._store_returns(spec, self._invoke_method(spec))
+                        result = self._invoke_method(spec)
             else:
                 fn = self._load_function(spec.fn_id)
                 args, kwargs = self._resolve_args(spec.args_blob)
-                self._store_returns(spec, fn(*args, **kwargs))
+                result = fn(*args, **kwargs)
+            # Close + flush the span BEFORE sealing returns: the moment a
+            # return object is visible, the caller may kill this process
+            # (kill-after-result is how short-lived actors are used), and
+            # a span still buffered at SIGKILL is lost from the trace.
+            tracing.end_task_span(token, ok=True)
+            token = None
+            self._store_returns(spec, result)
         except BaseException as e:  # noqa: BLE001 - report everything upstream
             ok, error = False, repr(e)
             tb = traceback.format_exc()

@@ -37,6 +37,7 @@ _INDEX_HTML = """<!doctype html><title>ray_tpu dashboard API</title>
 <li><a href="/api/tasks/summary">/api/tasks/summary</a></li>
 <li><a href="/api/cluster_status">/api/cluster_status</a></li>
 <li><a href="/api/serve">/api/serve</a></li>
+<li><a href="/api/serve/routing">/api/serve/routing (request-router stats: policy, queue depths, prefix-cache)</a></li>
 <li><a href="/api/data/jobs">/api/data/jobs (data-service jobs; ?job=&lt;name&gt; for one)</a></li>
 <li><a href="/api/traces">/api/traces (distributed traces; ?trace_id=&lt;hex&gt; for one tree)</a></li>
 <li><a href="/api/profile">/api/profile (CPU profiles; ?id=&lt;profile_id&gt;&amp;format=speedscope|folded|raw)</a></li>
@@ -317,6 +318,23 @@ class DashboardHead:
             return serve_api.status()
         except Exception as e:
             return {"error": f"serve not running: {type(e).__name__}"}
+
+    def _serve_routing(self):
+        """Request-router snapshots straight from the controller's GCS KV
+        records (namespace serve_routing) — no driver context needed."""
+        import json as json_mod
+
+        out = []
+        for key in self._gcs.kv_keys("serve_routing"):
+            blob = self._gcs.kv_get("serve_routing", bytes(key))
+            if blob is None:
+                continue
+            try:
+                out.append(json_mod.loads(bytes(blob).decode()))
+            except (ValueError, UnicodeDecodeError):
+                continue
+        return sorted(out, key=lambda d: (d.get("app", ""),
+                                          d.get("deployment", "")))
 
     def _data_jobs(self, job: Optional[str] = None):
         """Data-service job snapshots straight from the coordinator's GCS
@@ -630,6 +648,8 @@ class DashboardHead:
         app.router.add_get("/api/jobs/logs", job_logs)
         app.router.add_get("/api/node_stats", json_handler(self._node_stats))
         app.router.add_get("/api/serve", json_handler(self._serve_status))
+        app.router.add_get("/api/serve/routing",
+                           json_handler(self._serve_routing))
         app.router.add_get("/status", status_page)
         app.router.add_get("/api/nodes", json_handler(self._nodes))
         app.router.add_get("/api/actors", json_handler(self._actors))

@@ -12,6 +12,7 @@ queues.  Static shapes throughout: no recompiles after warmup.
 
 from __future__ import annotations
 
+import os
 import queue as queue_mod
 import threading
 import time
@@ -25,7 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.llm import model as lm
-from ray_tpu.llm.paged_cache import CacheConfig, PageAllocator, init_cache
+from ray_tpu.llm.paged_cache import (CacheConfig, PageAllocator, PrefixCache,
+                                     init_cache)
 from ray_tpu.models.llama import LlamaConfig
 
 # Serving observability (ISSUE 8): the engine-local stats() dict stays the
@@ -69,6 +71,18 @@ def _engine_metrics():
                 "preempted": Counter(
                     "llm_preempted_total", "Requests preempted/evicted "
                     "from their slot"),
+                "prefix_hit": Counter(
+                    "llm_prefix_hit_tokens_total", "Prompt tokens served "
+                    "from resident prefix-cache pages"),
+                "prefix_lookup": Counter(
+                    "llm_prefix_lookup_tokens_total", "Prompt tokens "
+                    "looked up against the prefix cache"),
+                "page_evictions": Counter(
+                    "llm_page_evictions_total", "Prefix-cache pages "
+                    "reclaimed to satisfy allocations"),
+                "prefix_resident": Gauge(
+                    "llm_prefix_resident_pages", "Cached-resident KV "
+                    "pages with no live owner"),
                 "active_slots": Gauge(
                     "llm_active_slots", "Decode slots currently occupied"),
                 "free_pages": Gauge(
@@ -134,6 +148,10 @@ class _Request:
     kv: Optional[tuple] = None  # decode_kv: (kv_k, kv_v) page arrays
     first_token_at: Optional[float] = None  # monotonic ts of first emit
     emitted: int = 0  # tokens delivered to the caller
+    # Tokens produced toward max_tokens, surviving preemption/resume: a
+    # preempted request folds its generated tokens into the prompt, so
+    # len(slot.generated) restarts from zero while `produced` does not.
+    produced: int = 0
 
 
 @dataclass
@@ -160,6 +178,15 @@ class LLMEngine:
             page_size=self.cfg.page_size, dtype=model_cfg.dtype)
         self.cache_k, self.cache_v = init_cache(ccfg)
         self.allocator = PageAllocator(self.cfg.num_pages)
+        # Prefix caching (ISSUE 10): finished sequences leave their full
+        # prompt pages resident; later prompts sharing a page-aligned
+        # prefix skip that prefill compute.  A pure index over pages — all
+        # page ownership still flows through self.allocator, so swapping
+        # the allocator (tests do) starts from an empty, consistent state.
+        self.prefix_cache: Optional[PrefixCache] = (
+            PrefixCache(self.cfg.page_size)
+            if os.environ.get("RTPU_PREFIX_CACHE", "1").lower()
+            not in ("0", "false") else None)
         self.max_pages_per_seq = -(-self.cfg.max_seq_len
                                    // self.cfg.page_size)
         self._waiting: queue_mod.Queue = queue_mod.Queue()
@@ -174,7 +201,7 @@ class LLMEngine:
         # decode-state host mirrors (device arrays rebuilt when they change)
         self._stats = {"prefills": 0, "decode_steps": 0,
                        "tokens_generated": 0, "preempted": 0,
-                       "admitted": 0}
+                       "admitted": 0, "page_evictions": 0}
         # Queue/admission observability (VERDICT round-2: the serving
         # bench conflated queue wait with prefill; these separate them):
         # recent per-request queue waits (submit -> admission) and prefill
@@ -301,9 +328,15 @@ class LLMEngine:
             return round(xs[int((len(xs) - 1) * frac)] * 1e3, 2) \
                 if xs else None
 
+        pc = self.prefix_cache
         return {**self._stats, "active_slots": active,
                 "free_pages": self.allocator.num_free(),
                 "waiting": self._waiting.qsize(),
+                # prefix-cache plane (ISSUE 10): hit/miss + resident pages
+                # + recent block digests — the router's KV-locality signal
+                "prefix_cache": pc.stats() if pc is not None else None,
+                "resident_pages": self.allocator.num_resident(),
+                "prefix_digests": pc.digests() if pc is not None else [],
                 # admission observability: time requests spent queued
                 # before a slot/pages freed up, vs pure prefill compute
                 "p50_queue_wait_ms": _pctile(self._queue_waits, 0.5),
@@ -315,8 +348,29 @@ class LLMEngine:
 
     def _loop(self):
         while not self._stop.is_set():
-            admitted = self._admit()
-            stepped = self._decode_all()
+            try:
+                admitted = self._admit()
+                stepped = self._decode_all()
+            except Exception as e:  # noqa: BLE001 — a dead scheduler
+                # thread would hang every generate() forever; fail the
+                # in-flight requests loudly instead and keep serving.
+                import traceback
+
+                traceback.print_exc()
+                for i, s in enumerate(self._slots):
+                    if s is not None:
+                        s.request.out_queue.put(e)
+                        s.request.out_queue.put(None)
+                        self.allocator.free(s.pages)
+                        self._slots[i] = None
+                while True:
+                    try:
+                        req = self._waiting.get_nowait()
+                    except queue_mod.Empty:
+                        break
+                    req.out_queue.put(e)
+                    req.out_queue.put(None)
+                continue
             now = time.monotonic()
             if now - self._gauges_at >= 0.25:
                 self._gauges_at = now
@@ -333,6 +387,7 @@ class LLMEngine:
         if allocatable > 0:
             m["page_occupancy"].set(1.0 - free / allocatable)
         m["waiting"].set(self._waiting.qsize())
+        m["prefix_resident"].set(self.allocator.num_resident())
 
     def _finish_request(self, req: _Request):
         """Latency histograms at stream end (successful finishes only;
@@ -363,9 +418,12 @@ class LLMEngine:
                     self._waiting.queue.appendleft(req)  # type: ignore[attr-defined]
                     return admitted
             if req.kind == "prefill_only":
-                # KV only lives for the prefill: compute, extract, free.
+                # KV only lives for the prefill compute+extract; afterwards
+                # the full prompt pages stay CACHED-RESIDENT (not freed),
+                # so repeat prefills of shared prompts and the P/D decode
+                # hand-back both find warm pages.
                 n_pages = -(-len(req.prompt_tokens) // self.cfg.page_size)
-                if not self.allocator.can_allocate(n_pages):
+                if not self._reserve(n_pages):
                     self._waiting.queue.appendleft(req)  # type: ignore[attr-defined]
                     return admitted
                 pages = self.allocator.allocate(n_pages)
@@ -378,6 +436,7 @@ class LLMEngine:
                     kv_v = np.asarray(self.cache_v[:, idx])
                     req.out_queue.put(("prefill_done", last, kv_k, kv_v))
                     req.out_queue.put(None)
+                    self._register_blocks(req.prompt_tokens, pages)
                 except Exception as e:  # noqa: BLE001
                     req.out_queue.put(e)
                     req.out_queue.put(None)
@@ -385,13 +444,25 @@ class LLMEngine:
                     self.allocator.free(pages)
                 admitted = True
                 continue
-            n_pages = -(-(len(req.prompt_tokens) + req.params.max_tokens)
-                        // self.cfg.page_size)
-            if not self.allocator.can_allocate(n_pages):
-                # put back; wait for a slot to finish and free pages
+            # Lazy allocation (ISSUE 10): admit with just the pages the
+            # prompt + the first decode write need; _ensure_capacity grows
+            # the slot as decode advances, evicting cache LRU or preempting
+            # when the pool runs dry.  Admitting lazily is what lets the
+            # pool oversubscribe — the load wall the serving bench climbs.
+            n = len(req.prompt_tokens)
+            matched: List[int] = []
+            if self.prefix_cache is not None and req.kind == "normal":
+                matched = self.prefix_cache.match(req.prompt_tokens)
+            need_total = n // self.cfg.page_size + 1
+            # pin matched pages BEFORE eviction can consider them
+            self.allocator.retain(matched)
+            if not self._reserve(need_total - len(matched)):
+                self.allocator.free(matched)  # unpin; stays resident
                 self._waiting.queue.appendleft(req)  # type: ignore[attr-defined]
                 return admitted
-            pages = self.allocator.allocate(n_pages)
+            pages = matched + self.allocator.allocate(
+                need_total - len(matched))
+            prefix_len = len(matched) * self.cfg.page_size
             rng = (np.random.default_rng(req.params.seed)
                    if req.params.temperature > 0 else None)
             try:
@@ -417,12 +488,22 @@ class LLMEngine:
                         jnp.asarray(kv_v, self.cache_v.dtype))
                     last = int(req.first_token)
                 else:
-                    last = self._prefill(req, pages, rng)
+                    last = self._prefill(req, pages, rng, prefix_len)
             except Exception as e:  # noqa: BLE001 — surface to caller
                 self.allocator.free(pages)
                 req.out_queue.put(e)
                 req.out_queue.put(None)
                 continue
+            if self.prefix_cache is not None and req.kind == "normal":
+                # commit hit/lookup accounting only on successful admission
+                # (a request bouncing off a full pool retries its match)
+                self.prefix_cache.note_lookup(n, prefix_len)
+                self._m["prefix_lookup"].inc(n)
+                if prefix_len:
+                    self._m["prefix_hit"].inc(prefix_len)
+            # every full prompt page — freshly computed or injected — is
+            # now index-able for later prompts sharing the prefix
+            self._register_blocks(req.prompt_tokens, pages)
             slot = _Slot(request=req, pages=pages,
                          num_tokens=len(req.prompt_tokens),
                          last_token=last, rng=rng)
@@ -436,9 +517,10 @@ class LLMEngine:
                     # the prefill engine already delivered this token to
                     # the caller; count it, don't re-emit
                     self._stats["tokens_generated"] += 1
+                    req.produced += 1
                 else:
                     self._emit(slot, last)
-                if len(slot.generated) >= req.params.max_tokens:
+                if req.produced >= req.params.max_tokens:
                     self._finish_request(req)
                     req.out_queue.put(None)
                     self.allocator.free(pages)
@@ -447,24 +529,50 @@ class LLMEngine:
             admitted = True
 
     def _prefill(self, req: _Request, pages: List[int],
-                 rng: Optional[np.random.Generator]) -> int:
+                 rng: Optional[np.random.Generator],
+                 prefix_len: int = 0) -> int:
         n = len(req.prompt_tokens)
-        bucket = self.cfg.bucket_for(n)
-        tokens = np.zeros(bucket, np.int32)
-        tokens[:n] = req.prompt_tokens
-        # map each padded position to (page, slot); positions beyond the
-        # allocated pages land in the null page (masked out of attention)
-        page_rows = np.zeros(bucket, np.int32)
-        for i in range(bucket):
-            pi = i // self.cfg.page_size
-            page_rows[i] = pages[pi] if pi < len(pages) else 0
-        slot_positions = np.arange(bucket, dtype=np.int32) \
-            % self.cfg.page_size
+        ps = self.cfg.page_size
         t0 = time.monotonic()
-        logits, self.cache_k, self.cache_v = lm.prefill(
-            self.params, jnp.asarray(tokens), self.cache_k, self.cache_v,
-            jnp.asarray(page_rows), jnp.int32(n),
-            jnp.asarray(slot_positions), self.model_cfg)
+        if prefix_len > 0:
+            # prefix-cache hit: pages[:prefix_len//ps] already hold the
+            # prefix KV; compute only the suffix, attending through the
+            # full page table (suffix writes never touch shared pages —
+            # every write position is >= prefix_len)
+            suffix = req.prompt_tokens[prefix_len:]
+            ls = len(suffix)
+            bucket = self.cfg.bucket_for(ls)
+            tokens = np.zeros(bucket, np.int32)
+            tokens[:ls] = suffix
+            positions = prefix_len + np.arange(bucket, dtype=np.int32)
+            page_rows = np.zeros(bucket, np.int32)
+            for i in range(bucket):
+                pi = (prefix_len + i) // ps
+                page_rows[i] = pages[pi] if pi < len(pages) else 0
+            slot_positions = positions % ps
+            table = np.zeros(self.max_pages_per_seq, np.int32)
+            table[:len(pages)] = pages
+            logits, self.cache_k, self.cache_v = lm.prefill_with_prefix(
+                self.params, jnp.asarray(tokens), self.cache_k,
+                self.cache_v, jnp.asarray(page_rows), jnp.int32(ls),
+                jnp.asarray(slot_positions), jnp.asarray(table),
+                jnp.asarray(positions), self.model_cfg)
+        else:
+            bucket = self.cfg.bucket_for(n)
+            tokens = np.zeros(bucket, np.int32)
+            tokens[:n] = req.prompt_tokens
+            # map each padded position to (page, slot); positions beyond
+            # the allocated pages land in the null page (masked out of
+            # attention)
+            page_rows = np.zeros(bucket, np.int32)
+            for i in range(bucket):
+                pi = i // ps
+                page_rows[i] = pages[pi] if pi < len(pages) else 0
+            slot_positions = np.arange(bucket, dtype=np.int32) % ps
+            logits, self.cache_k, self.cache_v = lm.prefill(
+                self.params, jnp.asarray(tokens), self.cache_k,
+                self.cache_v, jnp.asarray(page_rows), jnp.int32(n),
+                jnp.asarray(slot_positions), self.model_cfg)
         out = self._sample_one(np.asarray(logits), req.params, rng)
         self._stats["prefills"] += 1
         dt = time.monotonic() - t0
@@ -477,11 +585,118 @@ class LLMEngine:
         self._m["queue_wait"].observe(max(0.0, t0 - req.submitted_at))
         return out
 
+    def _reserve(self, n: int) -> bool:
+        """Make n pages allocatable, reclaiming LRU prefix-cache pages as
+        needed.  Returns False (leaving partial reclaims in place — they
+        were the coldest blocks anyway) if the pool can't cover it."""
+        if n <= 0:
+            return True
+        pc = self.prefix_cache
+        while self.allocator.num_free() < n:
+            page = pc.evict_one(self.allocator.refcount) \
+                if pc is not None else None
+            if page is None:
+                return False
+            self.allocator.reclaim(page)
+            self._stats["page_evictions"] += 1
+            self._m["page_evictions"].inc()
+        return True
+
+    def _register_blocks(self, tokens: List[int], pages: List[int]) -> None:
+        if self.prefix_cache is None:
+            return
+        cached = self.prefix_cache.insert(tokens, pages)
+        self.allocator.mark_cached(cached)
+
+    def _preempt(self, i: int, s: _Slot) -> None:
+        """Evict a running sequence (vLLM's recompute preemption): accepted
+        tokens fold into the prompt and the request requeues at the FRONT.
+        Its full pages are registered in the prefix cache first, so the
+        resume prefill usually restarts from a long prefix hit rather than
+        from scratch."""
+        req = s.request
+        seq = req.prompt_tokens + s.generated
+        # KV is resident exactly for positions < num_tokens
+        self._register_blocks(seq[:s.num_tokens], s.pages)
+        req.prompt_tokens = seq
+        req.kind = "normal"
+        req.kv = None
+        req.first_token = None
+        self.allocator.free(s.pages)
+        self._slots[i] = None
+        self._stats["preempted"] += 1
+        self._m["preempted"].inc()
+        self._waiting.queue.appendleft(req)  # type: ignore[attr-defined]
+
+    def _ensure_capacity(self, steps: int) -> None:
+        """Grow each slot's page list to cover the next `steps` decode
+        writes (lazy allocation's other half).  Earliest-submitted slots
+        grow first; when the pool is dry even after cache eviction, the
+        LATEST-submitted slot is preempted — FCFS under pressure."""
+        ps = self.cfg.page_size
+        order = sorted(
+            ((i, s) for i, s in enumerate(self._slots) if s is not None),
+            key=lambda t: t[1].request.submitted_at)
+        for i, s in order:
+            while self._slots[i] is s:
+                sp = s.request.params
+                remaining = max(1, sp.max_tokens - s.request.produced)
+                k = min(steps, remaining)
+                need = min((s.num_tokens + k - 1) // ps + 1,
+                           self.max_pages_per_seq)
+                delta = need - len(s.pages)
+                if delta <= 0:
+                    break
+                if self._reserve(delta):
+                    s.pages.extend(self.allocator.allocate(delta))
+                    break
+                victim = max(
+                    ((j, t) for j, t in enumerate(self._slots)
+                     if t is not None),
+                    key=lambda t: t[1].request.submitted_at)
+                self._preempt(*victim)
+                # if we preempted ourselves the while condition exits
+
     def _decode_all(self) -> bool:
         active_slots = [(i, s) for i, s in enumerate(self._slots)
                         if s is not None]
         if not active_slots:
             return False
+        all_greedy = all(s.request.params.temperature <= 0
+                         for _, s in active_slots)
+        # Burst decode: chain several device-fed greedy steps and fetch
+        # once.  The host round trip (PCIe/tunnel) costs many times the
+        # decode compute itself; each step's argmax token feeds the
+        # next step ON DEVICE.  Overshoot is safe: a slot that finishes
+        # mid-burst keeps writing into its own (or the null) pages and
+        # the extra tokens are simply not emitted.
+        # Stay responsive to admissions only when one could actually
+        # happen: work waiting, a slot to put it in, AND enough pool
+        # headroom (free + reclaimable cache pages) for the head-of-queue
+        # request's lazy admission (mirrors _admit's own checks) —
+        # otherwise burst; admission is impossible until a sequence
+        # finishes anyway.
+        can_admit = False
+        if any(s is None for s in self._slots):
+            try:
+                head = self._waiting.queue[0]  # type: ignore[attr-defined]
+                n = len(head.prompt_tokens)
+                if head.kind == "prefill_only":
+                    n_pages = -(-n // self.cfg.page_size)
+                else:
+                    n_pages = n // self.cfg.page_size + 1
+                can_admit = (self.allocator.num_free()
+                             + self.allocator.num_resident()) >= n_pages
+            except IndexError:
+                pass
+        burst = 8 if (all_greedy and not can_admit) else 1
+        # lazy allocation's second half: cover the burst's decode writes,
+        # preempting under pool pressure — slots may vanish here
+        self._ensure_capacity(burst)
+        active_slots = [(i, s) for i, s in enumerate(self._slots)
+                        if s is not None]
+        if not active_slots:
+            return True  # everything preempted; _admit resumes them
         B = self.cfg.max_slots
         P = self.max_pages_per_seq
         tokens = np.zeros(B, np.int32)
@@ -493,32 +708,7 @@ class LLMEngine:
             positions[i] = s.num_tokens  # position of the new token
             tables[i, :len(s.pages)] = s.pages
             active[i] = True
-        all_greedy = all(s.request.params.temperature <= 0
-                         for _, s in active_slots)
         if all_greedy:
-            # Burst decode: chain several device-fed greedy steps and fetch
-            # once.  The host round trip (PCIe/tunnel) costs many times the
-            # decode compute itself; each step's argmax token feeds the
-            # next step ON DEVICE.  Overshoot is safe: a slot that finishes
-            # mid-burst keeps writing into its own (or the null) pages and
-            # the extra tokens are simply not emitted.
-            # Stay responsive to admissions only when one could actually
-            # happen: work waiting, a slot to put it in, AND enough free
-            # pages for the head-of-queue request (mirrors _admit's own
-            # checks) — otherwise burst; admission is impossible until a
-            # sequence finishes anyway.
-            can_admit = False
-            if any(s is None for s in self._slots):
-                try:
-                    head = self._waiting.queue[0]  # type: ignore[attr-defined]
-                    need = len(head.prompt_tokens)
-                    if head.kind != "prefill_only":
-                        need += head.params.max_tokens
-                    n_pages = -(-need // self.cfg.page_size)
-                    can_admit = self.allocator.can_allocate(n_pages)
-                except IndexError:
-                    pass
-            burst = 1 if can_admit else 8
             toks_dev = jnp.asarray(tokens)
             pos_dev = jnp.asarray(positions)
             tables_dev = jnp.asarray(tables)
@@ -559,25 +749,31 @@ class LLMEngine:
         s.num_tokens += 1  # last_token's KV is now in the cache
         sp = s.request.params
         if tok in sp.stop_token_ids:
-            self._finish_request(s.request)
-            s.request.out_queue.put(None)
-            self.allocator.free(s.pages)
-            self._slots[i] = None
+            self._release_slot(i, s)
             return
         s.generated.append(tok)
         self._emit(s, tok)
-        if len(s.generated) >= sp.max_tokens:
-            self._finish_request(s.request)
-            s.request.out_queue.put(None)
-            self.allocator.free(s.pages)
-            self._slots[i] = None
+        if s.request.produced >= sp.max_tokens:
+            self._release_slot(i, s)
         else:
             s.last_token = tok
+
+    def _release_slot(self, i: int, s: _Slot) -> None:
+        """Finish a sequence: register its full pages (prompt AND generated
+        KV — a follow-up turn extending this conversation hits them) and
+        release; cached pages stay resident until the pool reclaims them."""
+        self._finish_request(s.request)
+        s.request.out_queue.put(None)
+        seq = s.request.prompt_tokens + s.generated
+        self._register_blocks(seq[:s.num_tokens], s.pages)
+        self.allocator.free(s.pages)
+        self._slots[i] = None
 
     def _emit(self, slot: _Slot, token: int):
         self._stats["tokens_generated"] += 1
         req = slot.request
         req.emitted += 1
+        req.produced += 1  # survives preemption (len(generated) does not)
         if req.first_token_at is None:
             req.first_token_at = time.monotonic()
             self._m["ttft"].observe(req.first_token_at - req.submitted_at)

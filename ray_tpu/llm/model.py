@@ -85,6 +85,64 @@ def prefill(params, tokens, cache_k, cache_v, page_rows, true_len,
     return logits, cache_k, cache_v
 
 
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2, 3))
+def prefill_with_prefix(params, tokens, cache_k, cache_v, page_rows,
+                        true_len, slot_positions, page_table, positions,
+                        cfg: LlamaConfig):
+    """Prefill the SUFFIX of one sequence whose leading pages are already
+    resident (prefix-cache hit).
+
+    tokens: [L] int32 suffix padded to a bucket; positions: [L] absolute
+    positions (prefix_len + 0..L-1); page_rows/slot_positions: [L] write
+    coordinates for the suffix KV; page_table: [P] the sequence's FULL
+    page table (prefix pages + suffix pages, 0-padded); true_len: scalar
+    suffix length.  Attention gathers keys through the page table like the
+    decode step — cached prefix columns come straight from the pool, suffix
+    columns from this call's writes — masked at tpos <= position, so the
+    null page, padded query rows, and future suffix columns all drop out.
+    Returns (logits at the last suffix token [V], cache_k, cache_v).
+    """
+    L = tokens.shape[0]
+    P = page_table.shape[0]
+    page_size = cache_k.shape[2]
+    x = params["embed"].astype(jnp.dtype(cfg.dtype))[tokens]  # [L, D]
+
+    def body(x, layer):
+        p, ck_l, cv_l = layer
+        h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+        q, k, v = _qkv(cfg, p, h)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        # suffix writes go to the sequence's own fresh pages only: matched
+        # prefix pages cover positions < prefix_len and are never written
+        ck_l = ck_l.at[page_rows, slot_positions].set(k)
+        cv_l = cv_l.at[page_rows, slot_positions].set(v)
+        keys = ck_l[page_table].reshape(P * page_size, cfg.n_kv_heads,
+                                        cfg.head_dim)
+        vals = cv_l[page_table].reshape(P * page_size, cfg.n_kv_heads,
+                                        cfg.head_dim)
+        rep = cfg.n_heads // cfg.n_kv_heads
+        keys = jnp.repeat(keys, rep, axis=1)  # [T, H, d]
+        vals = jnp.repeat(vals, rep, axis=1)
+        scores = jnp.einsum("qhd,khd->hqk", q, keys) / (cfg.head_dim ** 0.5)
+        tpos = jnp.arange(P * page_size)[None]  # [1, T]
+        mask = tpos <= positions[:, None]  # [L, T] causal over absolutes
+        scores = jnp.where(mask[None], scores, -1e30)
+        attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        out = jnp.einsum("hqk,khd->qhd", attn.astype(vals.dtype), vals)
+        x = x + out.reshape(L, -1) @ p["attn"]["wo"].astype(x.dtype)
+        h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+        x = x + _mlp(p, h)
+        return x, (ck_l, cv_l)
+
+    x, (cache_k, cache_v) = jax.lax.scan(
+        body, x, (params["layers"], cache_k, cache_v))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last = jnp.take(x, jnp.maximum(true_len - 1, 0), axis=0)
+    logits = last.astype(jnp.float32) @ params["lm_head"]
+    return logits, cache_k, cache_v
+
+
 def _decode_impl(params, tokens, cache_k, cache_v, page_tables, positions,
                  active, cfg: LlamaConfig):
     """One token for EVERY slot (the continuous-batching hot loop).

@@ -8,12 +8,25 @@ so every decode step has one compiled shape regardless of sequence lengths;
 sequences map to pages through an integer page table.  The allocator is a
 trivial host-side free list — allocation happens at admission time, never
 inside the jitted step.
+
+Prefix caching (ISSUE 10) layers two host-side structures on top:
+
+- the allocator grows refcounts and a "cached-resident" set, so a page whose
+  sequence finished can stay resident (its KV intact) until the pool needs
+  it back, and a page shared by several sequences is only truly freed when
+  the last one releases it;
+- `PrefixCache` is a vLLM-style block index: a chain hash over FULL prompt
+  pages maps token-block digests to resident pages, LRU-ordered, so a new
+  request whose prompt shares a page-aligned prefix with earlier traffic
+  skips recomputing (and re-storing) that prefix's KV.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+import hashlib
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Set
 
 import jax
 import jax.numpy as jnp
@@ -42,16 +55,29 @@ def init_cache(cfg: CacheConfig):
 
 
 class PageAllocator:
-    """Host-side free list (reference analogue: vLLM's BlockManager)."""
+    """Host-side free list (reference analogue: vLLM's BlockManager).
+
+    Three page states: FREE (on the free list), IN USE (refcount >= 1),
+    and CACHED-RESIDENT (refcount 0 but registered in a PrefixCache —
+    KV intact, reclaimable on demand).  allocate/free keep their original
+    one-owner semantics when retain/mark_cached are never called, so code
+    (and tests) that predate prefix caching see the old behavior.
+    """
 
     def __init__(self, num_pages: int):
         # page 0 is reserved as the "null" page that padded page-table
         # entries point at; attention masks it out by position.
         self._free: List[int] = list(range(1, num_pages))
+        self._rc: Dict[int, int] = {}
+        self._cached: Set[int] = set()
         self.num_pages = num_pages
 
     def num_free(self) -> int:
         return len(self._free)
+
+    def num_resident(self) -> int:
+        """Cached pages with no live owner (reclaimable without preempting)."""
+        return sum(1 for p in self._cached if self._rc.get(p, 0) <= 0)
 
     def can_allocate(self, n: int) -> bool:
         return len(self._free) >= n
@@ -60,7 +86,173 @@ class PageAllocator:
         if n > len(self._free):
             raise MemoryError(f"needs {n} pages, {len(self._free)} free")
         out, self._free = self._free[:n], self._free[n:]
+        for p in out:
+            self._rc[p] = 1
         return out
 
+    def retain(self, pages: List[int]) -> None:
+        """Add a reference to already-resident pages (prefix-cache hit)."""
+        for p in pages:
+            if p != 0:
+                self._rc[p] = self._rc.get(p, 0) + 1
+
+    def refcount(self, page: int) -> int:
+        return self._rc.get(page, 0)
+
     def free(self, pages: List[int]) -> None:
-        self._free.extend(p for p in pages if p != 0)
+        """Release one reference; a page returns to the free list only when
+        nothing references it AND it is not cached-resident."""
+        for p in pages:
+            if p == 0:
+                continue
+            rc = self._rc.get(p, 1) - 1
+            if rc > 0:
+                self._rc[p] = rc
+                continue
+            self._rc.pop(p, None)
+            if p not in self._cached:
+                self._free.append(p)
+
+    def mark_cached(self, pages: List[int]) -> None:
+        self._cached.update(p for p in pages if p != 0)
+
+    def reclaim(self, page: int) -> None:
+        """Cache eviction: drop residency; back to the free list if idle."""
+        self._cached.discard(page)
+        if self._rc.get(page, 0) <= 0:
+            self._rc.pop(page, None)
+            if page not in self._free:
+                self._free.append(page)
+
+
+@dataclass
+class _Block:
+    digest: bytes
+    page: int
+
+
+class PrefixCache:
+    """Chain-hashed index of full prompt pages resident in the KV pool.
+
+    Digest of block k = blake2b(digest of block k-1 || tokens of block k),
+    so a digest identifies the entire prefix up to and including its page —
+    matching is a walk from the root, never a per-page comparison (vLLM's
+    block hash scheme).  LRU order doubles as the eviction order; eviction
+    is driven by the allocator owner (engine) when the pool runs dry.
+    """
+
+    def __init__(self, page_size: int):
+        self.page_size = page_size
+        self._blocks: "OrderedDict[bytes, _Block]" = OrderedDict()
+        self._by_page: Dict[int, bytes] = {}
+        self.hit_tokens = 0
+        self.lookup_tokens = 0
+        self.evictions = 0
+
+    # ------------------------- hashing -------------------------------
+
+    @staticmethod
+    def _chain(prev: bytes, tokens) -> bytes:
+        h = hashlib.blake2b(prev, digest_size=8)
+        h.update(np.asarray(tokens, np.int32).tobytes())
+        return h.digest()
+
+    @classmethod
+    def digest_for(cls, tokens: List[int], page_size: int) -> Optional[str]:
+        """Digest of the longest cacheable prefix of `tokens` (the P/D
+        residency hint: two processes computing it agree byte-for-byte)."""
+        n = len(tokens)
+        blocks = max(0, (n - 1) // page_size)
+        if blocks == 0:
+            return None
+        d = b""
+        for k in range(blocks):
+            d = cls._chain(d, tokens[k * page_size:(k + 1) * page_size])
+        return d.hex()
+
+    # ------------------------- index ops -----------------------------
+
+    def __len__(self) -> int:
+        return len(self._blocks)
+
+    def match(self, tokens: List[int]) -> List[int]:
+        """Longest chain of cached FULL pages covering a proper prefix.
+
+        Capped at (n-1)//page_size blocks so at least one suffix token is
+        always left to prefill (the logits that seed decode).  Pure lookup
+        apart from LRU refresh — hit/lookup counters are committed by the
+        caller only when the admission actually goes through, so a request
+        that bounces off a full pool doesn't inflate the hit rate each
+        retry.
+        """
+        ps = self.page_size
+        n = len(tokens)
+        pages: List[int] = []
+        d = b""
+        for k in range(max(0, (n - 1) // ps)):
+            d = self._chain(d, tokens[k * ps:(k + 1) * ps])
+            blk = self._blocks.get(d)
+            if blk is None:
+                break
+            self._blocks.move_to_end(d)
+            pages.append(blk.page)
+        return pages
+
+    def note_lookup(self, lookup_tokens: int, hit_tokens: int) -> None:
+        self.lookup_tokens += lookup_tokens
+        self.hit_tokens += hit_tokens
+
+    def insert(self, tokens: List[int], pages: List[int]) -> List[int]:
+        """Register every full page of `tokens` held in `pages`; returns the
+        pages newly added to the index (callers mark those cached-resident).
+        A digest that already maps to some other resident page keeps the
+        existing mapping — identical content, and the old page may be
+        shared by live sequences."""
+        ps = self.page_size
+        full = min(len(tokens) // ps, len(pages))
+        d = b""
+        new_pages: List[int] = []
+        for k in range(full):
+            d = self._chain(d, tokens[k * ps:(k + 1) * ps])
+            blk = self._blocks.get(d)
+            if blk is not None:
+                self._blocks.move_to_end(d)
+                continue
+            page = pages[k]
+            if page == 0 or page in self._by_page:
+                continue
+            self._blocks[d] = _Block(d, page)
+            self._by_page[page] = d
+            new_pages.append(page)
+        return new_pages
+
+    def evict_one(self, refcount: Callable[[int], int]) -> Optional[int]:
+        """Drop the least-recently-used block nobody references; returns its
+        page (caller reclaims it) or None if every block is pinned."""
+        for d, blk in self._blocks.items():
+            if refcount(blk.page) <= 0:
+                del self._blocks[d]
+                del self._by_page[blk.page]
+                self.evictions += 1
+                return blk.page
+        return None
+
+    def digests(self, limit: int = 16) -> List[str]:
+        """Most-recently-used block digests (hex) — the resident-prefix
+        advertisement the request router matches P/D hints against."""
+        out = []
+        for d in reversed(self._blocks):
+            out.append(d.hex())
+            if len(out) >= limit:
+                break
+        return out
+
+    def stats(self) -> dict:
+        return {
+            "blocks": len(self._blocks),
+            "hit_tokens": self.hit_tokens,
+            "lookup_tokens": self.lookup_tokens,
+            "evictions": self.evictions,
+            "hit_rate": round(self.hit_tokens / self.lookup_tokens, 4)
+            if self.lookup_tokens else 0.0,
+        }

@@ -39,11 +39,24 @@ class PrefillServer:
         self._config = llm_config
 
     def prefill(self, prompt: str, params_dict: Optional[dict] = None):
+        from ray_tpu.llm.paged_cache import PrefixCache
+
         sp = SamplingParams(**(params_dict or {}))
         tokens = self._tok.encode(prompt)
         first, kv_k, kv_v, n = self._engine.prefill_extract(tokens, sp)
+        # page-residency hint for the decode hop: the block-chain digest of
+        # the prompt's cacheable prefix.  digest_for is a pure function of
+        # (tokens, page_size), so the decode engine that admitted these
+        # pages advertises the SAME digest in its prefix_digests — the
+        # prefix-aware router matches them instead of re-hashing the prompt.
+        digest = PrefixCache.digest_for(
+            tokens, self._engine.cfg.page_size)
         return {"prompt_tokens": tokens, "first_token": first,
-                "kv_k": kv_k, "kv_v": kv_v, "n_tokens": n}
+                "kv_k": kv_k, "kv_v": kv_v, "n_tokens": n,
+                "prefix_digest": digest}
+
+    def engine_stats(self) -> dict:
+        return self._engine.stats()
 
 
 class DecodeServer:
@@ -82,6 +95,9 @@ class DecodeServer:
                 toks.append(item)
         return {"tokens": toks, "text": self._tok.decode(toks)}
 
+    def engine_stats(self) -> dict:
+        return self._engine.stats()
+
 
 class PDRouter:
     """OpenAI-ish ingress: prompt → prefill deployment → decode deployment
@@ -119,8 +135,14 @@ class PDRouter:
             pre = self._prefill.options(
                 routing_hint=prompt[:64]).prefill.remote(
                     prompt, params).result(timeout_s=300)
-            out = self._decode.decode.remote(pre, params).result(
-                timeout_s=300)
+            # Decode routes on the PAGE-RESIDENCY digest from the prefill
+            # result, not a re-hash of the prompt: a decode replica that
+            # already admitted this prefix advertises the digest in its
+            # stats-plane prefix_digests, and the prefix-aware router
+            # sends the request straight to those warm pages.
+            out = self._decode.options(
+                routing_hint=pre.get("prefix_digest") or prompt[:64]
+            ).decode.remote(pre, params).result(timeout_s=300)
             return {
                 "id": f"cmpl-{uuid.uuid4().hex[:12]}",
                 "object": "text_completion",
@@ -146,11 +168,13 @@ def build_pd_openai_app(llm_config: LLMConfig,
         name=f"Prefill:{llm_config.model_id}",
         num_replicas=num_prefill_replicas,
         ray_actor_options=llm_config.ray_actor_options,
+        request_router_policy="prefix_aware",
     ).bind(llm_config)
     decode = serve.deployment(DecodeServer).options(
         name=f"Decode:{llm_config.model_id}",
         num_replicas=num_decode_replicas,
         ray_actor_options=llm_config.ray_actor_options,
+        request_router_policy="prefix_aware",
     ).bind(llm_config)
     router = serve.deployment(PDRouter).options(
         name="PDRouter").bind(prefill, decode, llm_config.model_id,

@@ -215,6 +215,26 @@ class OpenAIRouter:
         self._server = server_handle
         self._model_id = model_id
 
+    @staticmethod
+    def _hint(body: dict, chat: bool) -> Optional[str]:
+        """Routing hint for the prefix-aware router: the raw prompt text
+        prefix (char-ngram keyed tree — no tokenizer needed here).  Chat
+        requests hint on the concatenated message contents, so multi-turn
+        conversations sharing a history keep landing on the replica whose
+        engine holds their KV pages."""
+        if chat:
+            parts = []
+            for m in body.get("messages", []) or []:
+                parts.append(str(m.get("role", "")))
+                parts.append(str(m.get("content", "")))
+            text = "\x1f".join(parts)
+        else:
+            prompt = body.get("prompt", "")
+            if isinstance(prompt, list):
+                prompt = ",".join(str(t) for t in prompt)
+            text = str(prompt)
+        return text[:512] or None
+
     def handle_http(self, request: dict):
         path = request.get("path", "/")
         body = request.get("body") or {}
@@ -222,18 +242,18 @@ class OpenAIRouter:
             return {"object": "list",
                     "data": [{"id": self._model_id, "object": "model"}]}
         if path.endswith("/chat/completions"):
+            h = self._server.options(routing_hint=self._hint(body, True))
             if body.get("stream"):
                 # the stream marker passes through untouched: the proxy
                 # pulls SSE chunks straight from the LLMServer replica
-                return self._server.chat_stream.remote(body).result(
-                    timeout_s=300)
-            return self._server.chat.remote(body).result(timeout_s=300)
+                return h.chat_stream.remote(body).result(timeout_s=300)
+            return h.chat.remote(body).result(timeout_s=300)
         if path.endswith("/completions"):
+            h = self._server.options(routing_hint=self._hint(body, False))
             if body.get("stream"):
-                return self._server.completions_stream.remote(body).result(
+                return h.completions_stream.remote(body).result(
                     timeout_s=300)
-            return self._server.completions.remote(body).result(
-                timeout_s=300)
+            return h.completions.remote(body).result(timeout_s=300)
         return {"error": f"unknown endpoint {path}"}
 
 
@@ -244,6 +264,9 @@ def build_openai_app(llm_config: LLMConfig) -> serve.Application:
         num_replicas=llm_config.num_replicas,
         ray_actor_options=llm_config.ray_actor_options,
         max_ongoing_requests=llm_config.engine_config.max_slots * 2,
+        # KV-locality routing: keep shared prompt prefixes (system prompts,
+        # multi-turn histories) on the replica holding their warm pages
+        request_router_policy="prefix_aware",
     ).bind(llm_config)
     router = serve.deployment(OpenAIRouter).options(
         name="OpenAIRouter").bind(server, llm_config.model_id)

@@ -776,6 +776,55 @@ def cmd_data(args):
               f"(coordinator applies it within ~1s)")
 
 
+def cmd_serve(args):
+    """Serve routing stats: per-deployment router policy, replica queue
+    depths and engine prefix-cache/paging state, read from the controller's
+    GCS KV snapshots (namespace serve_routing) — works without a driver
+    context, like `rtpu data`."""
+    sock = find_address(args.address)
+
+    def _snapshots():
+        out = []
+        for key in _rpc(sock, "kv_keys",
+                        {"namespace": "serve_routing"}) or []:
+            blob = _rpc(sock, "kv_get", {"namespace": "serve_routing",
+                                         "key": bytes(key)})
+            if blob is None:
+                continue
+            try:
+                out.append(json.loads(bytes(blob).decode()))
+            except (ValueError, UnicodeDecodeError):
+                continue
+        return sorted(out, key=lambda d: (d.get("app", ""),
+                                          d.get("deployment", "")))
+
+    docs = _snapshots()
+    if getattr(args, "json", False):
+        print(json.dumps(docs, indent=2, default=str))
+        return
+    if not docs:
+        print("(no serve deployments — the controller publishes routing "
+              "snapshots once an app is deployed)")
+        return
+    print(f"{'APP':12s} {'DEPLOYMENT':24s} {'POLICY':13s} {'REPLICAS':>8s} "
+          f"{'QUEUE':>5s} {'HIT%':>5s} {'PREEMPT':>7s} {'EVICT':>6s}")
+    for d in docs:
+        reps = d.get("replicas", {}) or {}
+        queue = sum(r.get("queue_len", 0) or 0 for r in reps.values())
+        engines = [r.get("engine") for r in reps.values() if r.get("engine")]
+        rates = [e["prefix_hit_rate"] for e in engines
+                 if e.get("prefix_hit_rate") is not None]
+        preempt = sum(e.get("preempted") or 0 for e in engines)
+        evict = sum(e.get("page_evictions") or 0 for e in engines)
+        print(f"{d.get('app', ''):12s} {d.get('deployment', ''):24s} "
+              f"{d.get('policy', 'pow2'):13s} "
+              f"{d.get('running_replicas', 0)}/"
+              f"{d.get('target_replicas', 0):<6} "
+              f"{queue:5d} "
+              f"{('%.0f' % (max(rates) * 100)) if rates else '-':>5s} "
+              f"{preempt:7d} {evict:6d}")
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="ray_tpu")
     sub = p.add_subparsers(dest="command", required=True)
@@ -912,6 +961,11 @@ def main(argv=None):
     dp.add_argument("--max", type=int, default=None,
                     help="worker-pool ceiling")
     sp.set_defaults(fn=cmd_data)
+    sp = sub.add_parser("serve")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--json", action="store_true",
+                    help="full routing snapshots as JSON")
+    sp.set_defaults(fn=cmd_serve)
     args = p.parse_args(argv)
     args.fn(args)
 

@@ -262,6 +262,62 @@ def main() -> int:
             assert w in text, f"{w!r} missing from /metrics"
         print(f"memory ok (leak {leak_ref.hex()[:16]}... flagged "
               f"[{mine[0]['kind']}] at {mine[0]['site']})")
+
+        # -- routed traffic / request router --------------------------
+        # A 2-replica deployment under the prefix-aware policy: hinted
+        # traffic must increment serve_router_decisions_total on
+        # /metrics, the shared router must report its decisions, and the
+        # controller's stats lane must publish routing snapshots to the
+        # GCS KV (what `rtpu serve` and /api/serve/routing read).
+        from ray_tpu import serve
+        from ray_tpu.serve.request_router import router_snapshots
+
+        @serve.deployment(num_replicas=2,
+                          request_router_policy="prefix_aware")
+        class Echo:
+            def __call__(self, x):
+                return x
+
+        h = serve.run(Echo.bind(), name="obs-smoke-serve",
+                      route_prefix="/obs-smoke", proxy=False)
+        for i in range(24):
+            hint = f"shared-system-prompt-{i % 3}:long-common-prefix"
+            assert h.options(routing_hint=hint).remote(i).result(
+                timeout_s=30) == i
+        snaps = [s for s in router_snapshots()
+                 if s["app"] == "obs-smoke-serve"]
+        assert snaps and snaps[0]["policy"] == "prefix_aware", snaps
+        decisions = snaps[0]["decisions"]
+        assert sum(decisions.values()) >= 24, decisions
+        assert decisions.get("prefix_hit", 0) > 0, decisions
+
+        want = ("serve_router_decisions_total",
+                'policy="prefix_aware"')
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            text = _get(url + "/metrics")
+            if all(w in text for w in want):
+                break
+            time.sleep(0.5)
+        for w in want:
+            assert w in text, f"{w!r} missing from /metrics"
+
+        deadline = time.monotonic() + 10
+        routing = []
+        while time.monotonic() < deadline:
+            routing = [d for d in state.serve_routing_stats()
+                       if d.get("app") == "obs-smoke-serve"]
+            if routing and routing[0].get("replicas"):
+                break
+            time.sleep(0.5)
+        assert routing, "no serve_routing KV snapshot published"
+        assert routing[0]["policy"] == "prefix_aware", routing[0]
+        api_docs = json.loads(_get(url + "/api/serve/routing"))
+        assert any(d.get("app") == "obs-smoke-serve"
+                   for d in api_docs), api_docs
+        serve.delete("obs-smoke-serve")
+        print(f"request router ok (decisions={dict(decisions)}, "
+              f"{len(routing[0]['replicas'])} replicas in KV snapshot)")
         print("obs-smoke: PASS")
         return 0
     finally:

@@ -34,3 +34,7 @@ class DeploymentConfig:
     health_check_period_s: float = 2.0
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
     graceful_shutdown_timeout_s: float = 5.0
+    # which serve/request_router policy handles pick for this deployment
+    # ("pow2" | "prefix_aware"); advertised by the controller alongside
+    # the replica set so handles never need the deployment code to route
+    request_router_policy: str = "pow2"

@@ -66,6 +66,10 @@ class _DeploymentState:
     # health checks
     health_failures: Dict[bytes, int] = field(default_factory=dict)
     last_health: float = 0.0
+    # request-router stats plane (ISSUE 10): latest router_stats() sample
+    # per replica, piggybacked onto get_replicas for handles
+    router_stats: Dict[bytes, Any] = field(default_factory=dict)
+    last_router_poll: float = 0.0
 
 
 @dataclass
@@ -76,6 +80,21 @@ class _AppState:
     http_method: str = "__call__"
     deployments: Dict[str, _DeploymentState] = field(default_factory=dict)
     status: str = "DEPLOYING"
+
+
+def _engine_summary(engine: Optional[dict]) -> Optional[dict]:
+    """Compact view of LLMEngine.stats() for the KV snapshot (full digests
+    stay on the in-band handle path; the KV doc is for humans/CLI)."""
+    if not engine:
+        return None
+    pc = engine.get("prefix_cache") or {}
+    return {"active_slots": engine.get("active_slots"),
+            "free_pages": engine.get("free_pages"),
+            "resident_pages": engine.get("resident_pages"),
+            "waiting": engine.get("waiting"),
+            "preempted": engine.get("preempted"),
+            "page_evictions": engine.get("page_evictions"),
+            "prefix_hit_rate": pc.get("hit_rate")}
 
 
 def _actor_is_dead(handle) -> bool:
@@ -175,11 +194,25 @@ class ServeController:
     # ------------------------- read API -----------------------------------
 
     def get_replicas(self, app_name: str, deployment: str) -> dict:
+        now = time.monotonic()
         with self._lock:
             app = self._apps.get(app_name)
             ds = app.deployments.get(deployment) if app else None
-            return {"replicas": list(ds.replicas) if ds else [],
-                    "version": self._version}
+            if ds is None:
+                return {"replicas": [], "version": self._version}
+            # piggyback the router-stats samples (queue depth, engine
+            # page/prefix-cache stats); age_s lets the handle's router
+            # measure staleness from COLLECTION time, not delivery
+            stats = {rid: {**payload,
+                           "age_s": max(0.0, now - payload.get("_ts", now))}
+                     for rid, payload in ds.router_stats.items()}
+            for payload in stats.values():
+                payload.pop("_ts", None)
+            return {"replicas": list(ds.replicas),
+                    "version": self._version,
+                    "policy": getattr(ds.config, "request_router_policy",
+                                      "pow2") or "pow2",
+                    "stats": stats}
 
     def report_no_replica(self, app_name: str, deployment: str,
                           queued: int = 1) -> str:
@@ -246,6 +279,7 @@ class ServeController:
                         changed |= self._reconcile(ds)
                         changed |= self._probe_and_autoscale(ds)
                         changed |= self._health_check(ds)
+                        self._collect_router_stats(ds)
                     with self._lock:
                         # RUNNING requires the FULL target per deployment
                         # (reference: app is RUNNING when every deployment
@@ -360,6 +394,76 @@ class ServeController:
             self._drain_and_kill(r, grace)
             changed = True
         return changed
+
+    def _collect_router_stats(self, ds: _DeploymentState) -> None:
+        """Poll ReplicaActor.router_stats every ``RTPU_ROUTER_STATS_S``
+        (the heartbeat lane of the request-router subsystem) and publish a
+        JSON snapshot to the GCS KV so the CLI/dashboard/state planes can
+        read routing state from any driver."""
+        import os
+
+        period = float(os.environ.get("RTPU_ROUTER_STATS_S", "0.5"))
+        now = time.monotonic()
+        if now - ds.last_router_poll < period:
+            return
+        ds.last_router_poll = now
+        with self._lock:
+            replicas = list(ds.replicas)
+        if not replicas:
+            with self._lock:
+                ds.router_stats = {}
+            self._publish_router_stats(ds, {})
+            return
+        refs = [r.router_stats.remote() for r in replicas]
+        ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=1.0)
+        samples: Dict[bytes, Any] = {}
+        for r, ref in zip(replicas, refs):
+            if ref not in ready:
+                continue  # saturated replica: keep the previous sample
+            try:
+                payload = ray_tpu.get(ref)
+            except Exception:  # noqa: BLE001 — stats lane must not throw
+                continue
+            payload["_ts"] = time.monotonic()
+            samples[r.actor_id] = payload
+        with self._lock:
+            # retain prior samples for replicas that missed this round so
+            # routers degrade to stale data (then ignore it) rather than
+            # flapping between stats and none
+            merged = {rid: p for rid, p in ds.router_stats.items()
+                      if any(r.actor_id == rid for r in replicas)}
+            merged.update(samples)
+            ds.router_stats = merged
+        self._publish_router_stats(ds, merged)
+
+    def _publish_router_stats(self, ds: _DeploymentState,
+                              samples: Dict[bytes, Any]) -> None:
+        import json
+
+        now = time.monotonic()
+        doc = {
+            "app": ds.app_name,
+            "deployment": ds.name,
+            "policy": getattr(ds.config, "request_router_policy",
+                              "pow2") or "pow2",
+            "target_replicas": ds.target_replicas,
+            "running_replicas": len(ds.replicas),
+            "replicas": {
+                (rid.hex() if isinstance(rid, bytes) else str(rid)): {
+                    "queue_len": p.get("queue_len", 0),
+                    "total": p.get("total", 0),
+                    "age_s": round(max(0.0, now - p.get("_ts", now)), 3),
+                    "engine": _engine_summary(p.get("engine")),
+                }
+                for rid, p in samples.items()},
+        }
+        try:
+            global_worker().rpc("kv_put", {
+                "namespace": "serve_routing",
+                "key": f"{ds.app_name}/{ds.name}".encode(),
+                "value": json.dumps(doc).encode()})
+        except Exception:  # noqa: BLE001 — observability is best-effort
+            pass
 
     def _note_failure(self, ds: _DeploymentState, exc: BaseException):
         # not always called from an except block (e.g. start timeouts), so

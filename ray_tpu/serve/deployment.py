@@ -53,10 +53,13 @@ class Deployment:
                                                    dict]] = None,
                 user_config: Optional[dict] = None,
                 ray_actor_options: Optional[dict] = None,
+                request_router_policy: Optional[str] = None,
                 **_ignored) -> "Deployment":
         import copy
 
         cfg = copy.deepcopy(self.config)
+        if request_router_policy is not None:
+            cfg.request_router_policy = request_router_policy
         if num_replicas is not None:
             cfg.num_replicas = num_replicas
         if max_ongoing_requests is not None:

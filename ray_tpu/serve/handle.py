@@ -1,23 +1,22 @@
-"""DeploymentHandle: the client-side router to a deployment's replicas.
+"""DeploymentHandle: the client-side entry to a deployment's replicas.
 
 Counterpart of the reference's handle + router
 (/root/reference/python/ray/serve/handle.py:340 DeploymentHandle,
-_private/router.py:341, _private/request_router/pow_2_router.py:27
-PowerOfTwoChoicesRequestRouter): a handle keeps a cached replica set
-(refreshed from the controller when its version bumps) and picks, per
-request, the less-loaded of two random replicas — load = this handle's own
-in-flight count per replica, the same queue-len signal the reference probes.
-Handles are plain data (app/deployment names) and can be pickled into other
-replicas for model composition.
+_private/router.py:341): a handle keeps a cached replica set (refreshed
+from the controller when its version bumps) and delegates every placement
+decision to the deployment's process-wide RequestRouter
+(serve/request_router/) — pow-2 by default, prefix-aware for LLM
+deployments.  Routing state (in-flight counts, prefix tree, replica stats)
+lives in the shared router, NOT per handle, so two handles to the same
+deployment agree on placement.  Handles are plain data (app/deployment
+names) and can be pickled into other replicas for model composition.
 """
 
 from __future__ import annotations
 
-import random
 import threading
 import time
-from collections import defaultdict
-from typing import Any, Dict, List, Optional
+from typing import Any, Optional
 
 import ray_tpu
 from ray_tpu.core.object_ref import ObjectRef
@@ -95,11 +94,11 @@ class DeploymentHandle:
     def __init__(self, app_name: str, deployment_name: str):
         self.app_name = app_name
         self.deployment_name = deployment_name
-        self._replicas: List[Any] = []
         self._version = -1
-        self._inflight: Dict[bytes, int] = defaultdict(int)
         self._lock = threading.Lock()
         self._last_refresh = 0.0
+        self._router: Optional[Any] = None  # bound on first refresh (the
+        # policy comes from the controller with the replica set)
 
     # -- replica set maintenance -----------------------------------------
 
@@ -107,55 +106,37 @@ class DeploymentHandle:
         return ray_tpu.get_actor(CONTROLLER_NAME)
 
     def _refresh(self, force: bool = False):
+        from ray_tpu.serve.request_router import get_router
+
         now = time.monotonic()
         with self._lock:
-            if (self._replicas and not force
+            router = self._router
+            if (router is not None and router.replicas() and not force
                     and now - self._last_refresh < 1.0):
                 return
         info = ray_tpu.get(self._controller().get_replicas.remote(
             self.app_name, self.deployment_name))
+        router = get_router(self.app_name, self.deployment_name,
+                            info.get("policy") or "pow2")
+        router.update_replicas(info["replicas"])
+        router.update_stats(info.get("stats") or {})
         with self._lock:
-            self._replicas = info["replicas"]
+            self._router = router
             self._version = info["version"]
             self._last_refresh = now
-            # prune counters for replicas that left the set
-            current = {r.actor_id for r in self._replicas}
-            for rid in list(self._inflight):
-                if rid not in current and self._inflight[rid] <= 0:
-                    del self._inflight[rid]
 
     # -- routing ----------------------------------------------------------
 
     def _choose(self, hint: Optional[str] = None):
-        """Power-of-two-choices on this handle's per-replica in-flight count
-        (reference: pow_2_router.py choose_replicas). With a ``hint``
-        (prompt prefix / multiplexed model id), route consistently to the
-        hint's home replica for cache locality — the reference's
-        prefix-aware / multiplex routers (prefix_aware_router.py:255) —
-        escaping to pow-2 only when that replica is clearly overloaded."""
-        with self._lock:
-            reps = list(self._replicas)
-        if not reps:
+        """Delegate to the deployment's shared RequestRouter (pow-2 or
+        prefix-aware per DeploymentConfig.request_router_policy).  The
+        router object is process-wide — every handle to this deployment
+        routes against the SAME in-flight counts and prefix tree."""
+        router = self._router
+        if router is None:
             raise RuntimeError(
                 f"deployment {self.deployment_name} has no running replicas")
-        if len(reps) == 1:
-            return reps[0]
-        if hint is not None:
-            import zlib
-
-            ordered = sorted(reps, key=lambda r: r.actor_id)
-            # crc32, not hash(): built-in str hashing is salted per process,
-            # which would give each router its own home mapping
-            home = ordered[zlib.crc32(hint.encode()) % len(ordered)]
-            with self._lock:
-                loads = [self._inflight[r.actor_id] for r in reps]
-                # stay home unless clearly hotter than the coolest replica
-                if self._inflight[home.actor_id] <= min(loads) + 4:
-                    return home
-        a, b = random.sample(reps, 2)
-        with self._lock:
-            return a if (self._inflight[a.actor_id]
-                         <= self._inflight[b.actor_id]) else b
+        return router.choose(hint)
 
     def _call(self, method: str, args, kwargs,
               hint: Optional[str] = None) -> DeploymentResponse:
@@ -189,12 +170,11 @@ class DeploymentHandle:
                   for k, v in kwargs.items()}
         rid = replica.actor_id
         state = {"rid": rid}
-        with self._lock:
-            self._inflight[rid] += 1
+        router = self._router
+        router.on_send(rid)
 
         def done():
-            with self._lock:
-                self._inflight[state["rid"]] -= 1
+            router.on_done(state["rid"])
 
         def retry():
             # Failover must WAIT for the controller to notice the death and
@@ -212,9 +192,7 @@ class DeploymentHandle:
                 if rep is not None and rep.actor_id != state["rid"]:
                     # move the in-flight accounting to the new replica so
                     # pow-2 routing sees the failed-over load
-                    with self._lock:
-                        self._inflight[state["rid"]] -= 1
-                        self._inflight[rep.actor_id] += 1
+                    router.move(state["rid"], rep.actor_id)
                     state["rid"] = rep.actor_id
                     return rep.handle_request.remote(method, args, kwargs)
                 if time.monotonic() > deadline:

@@ -271,6 +271,24 @@ class ReplicaActor:
     def stats(self) -> Dict[str, Any]:
         return {"ongoing": self._ongoing, "total": self._total}
 
+    def router_stats(self) -> Dict[str, Any]:
+        """Stats sample for the request-router plane (ISSUE 10): queue
+        depth always; engine page-occupancy/prefix-cache stats when the
+        user callable exposes engine_stats() (LLMServer and the P/D
+        deployments do).  Collected by the controller's heartbeat lane and
+        piggybacked onto get_replicas for handles."""
+        with self._lock:
+            self._reap_idle_streams_locked()
+            out: Dict[str, Any] = {"queue_len": self._ongoing,
+                                   "total": self._total}
+        fn = getattr(self._user, "engine_stats", None)
+        if callable(fn):
+            try:
+                out["engine"] = fn()
+            except Exception:  # noqa: BLE001 — stats must never break lane
+                pass
+        return out
+
     def check_health(self) -> str:
         fn = getattr(self._user, "check_health", None)
         if fn is not None:

@@ -1,0 +1,25 @@
+"""Pluggable request routers for serve deployments (ISSUE 10).
+
+Counterpart of the reference's `serve/_private/request_router/` package
+(pow_2_router.py PowerOfTwoChoicesRequestRouter, the LLM
+prefix_aware_router.py): a per-(app, deployment) router object shared by
+every handle in the process — routing state (in-flight counts, the prefix
+tree, replica stats from the controller's heartbeat lane) lives HERE, so
+two handles to the same deployment agree on placement.
+
+Policies are selected per deployment via
+``DeploymentConfig.request_router_policy`` ("pow2" | "prefix_aware");
+the controller advertises the policy alongside the replica set, so a
+handle never needs the deployment code to route correctly.
+"""
+
+from ray_tpu.serve.request_router.base import (ReplicaStats, RequestRouter,
+                                               get_router, router_snapshots)
+from ray_tpu.serve.request_router.pow2 import Pow2Router
+from ray_tpu.serve.request_router.prefix_aware import (PrefixAwareRouter,
+                                                       PrefixTree)
+
+__all__ = [
+    "ReplicaStats", "RequestRouter", "Pow2Router", "PrefixAwareRouter",
+    "PrefixTree", "get_router", "router_snapshots",
+]

@@ -1,0 +1,249 @@
+"""RequestRouter base: shared routing state + the replica-stats plane.
+
+The router is the process-wide authority for one deployment's routing:
+handles delegate choose/on_send/on_done to it instead of keeping private
+in-flight maps (the old `handle.py:_choose` gave every handle its own home
+mapping — two handles to the same deployment could disagree on placement).
+
+Load signal is two-source: the router's own in-flight counts (instant,
+but blind to other processes) and the replica stats the controller
+piggybacks onto get_replicas (queue depth, engine page occupancy,
+prefix-cache hit rate, resident-prefix digests — collected over the
+heartbeat lane from `ReplicaActor.router_stats`).  Reported stats older
+than ``RTPU_ROUTER_STALE_S`` are ignored: a stale queue depth is worse
+than none, because it pins traffic to a replica that drained seconds ago.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+_METRICS = None
+_metrics_lock = threading.Lock()
+
+
+def _router_metrics():
+    global _METRICS
+    with _metrics_lock:
+        if _METRICS is None:
+            from ray_tpu.util.metrics import Counter, Gauge
+
+            _METRICS = {
+                "decisions": Counter(
+                    "serve_router_decisions_total",
+                    "Routing decisions by policy and outcome",
+                    tag_keys=("policy", "outcome")),
+                "imbalance": Gauge(
+                    "serve_router_queue_imbalance",
+                    "Max - min replica load seen at decision time",
+                    tag_keys=("app", "deployment")),
+                "hit_rate": Gauge(
+                    "serve_prefix_cache_hit_rate",
+                    "Best engine prefix-cache hit rate reported by a "
+                    "deployment's replicas", tag_keys=("app", "deployment")),
+            }
+        return _METRICS
+
+
+@dataclass
+class ReplicaStats:
+    """One replica's piggybacked stats sample."""
+
+    queue_len: int = 0
+    total: int = 0
+    engine: Optional[dict] = None  # LLMEngine.stats() when the user
+    # callable exposes engine_stats() — page occupancy, prefix hit rate,
+    # resident-prefix digests
+    ts: float = field(default_factory=time.monotonic)
+
+    @property
+    def digests(self) -> List[str]:
+        if not self.engine:
+            return []
+        return list(self.engine.get("prefix_digests") or [])
+
+
+class RequestRouter:
+    """Base router: replica set + shared load accounting.  Subclasses
+    implement choose() (reference: request_router.py RequestRouter /
+    pow_2_router.py)."""
+
+    policy = "base"
+
+    def __init__(self, app_name: str, deployment_name: str):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self._lock = threading.Lock()
+        self._replicas: List[Any] = []
+        self._inflight: Dict[bytes, int] = defaultdict(int)
+        self._stats: Dict[bytes, ReplicaStats] = {}
+        self._stale_s = float(os.environ.get("RTPU_ROUTER_STALE_S", "5.0"))
+        self._m = _router_metrics()
+        self._mtags = {"app": app_name, "deployment": deployment_name}
+        self._decisions: Dict[str, int] = defaultdict(int)
+        self._gauges_at = 0.0
+
+    # -------------------- replica set / stats plane --------------------
+
+    def update_replicas(self, replicas: List[Any]) -> None:
+        with self._lock:
+            self._replicas = list(replicas)
+            current = {r.actor_id for r in self._replicas}
+            for rid in list(self._inflight):
+                if rid not in current and self._inflight[rid] <= 0:
+                    del self._inflight[rid]
+            for rid in list(self._stats):
+                if rid not in current:
+                    del self._stats[rid]
+
+    def replicas(self) -> List[Any]:
+        with self._lock:
+            return list(self._replicas)
+
+    def update_stats(self, stats: Dict[bytes, dict]) -> None:
+        """Absorb the controller's piggybacked samples; ``age_s`` (time the
+        sample sat controller-side) backdates the local timestamp so
+        staleness is measured from collection, not from delivery."""
+        now = time.monotonic()
+        with self._lock:
+            best_rate = None
+            for rid, payload in (stats or {}).items():
+                self._stats[rid] = ReplicaStats(
+                    queue_len=int(payload.get("queue_len", 0)),
+                    total=int(payload.get("total", 0)),
+                    engine=payload.get("engine"),
+                    ts=now - float(payload.get("age_s", 0.0)))
+                pc = (payload.get("engine") or {}).get("prefix_cache")
+                if pc and pc.get("lookup_tokens"):
+                    rate = pc.get("hit_rate", 0.0)
+                    best_rate = rate if best_rate is None \
+                        else max(best_rate, rate)
+            if best_rate is not None:
+                self._m["hit_rate"].set(best_rate, tags=self._mtags)
+
+    def stats_for(self, rid: bytes) -> Optional[ReplicaStats]:
+        with self._lock:
+            st = self._stats.get(rid)
+        if st is None or time.monotonic() - st.ts > self._stale_s:
+            return None
+        return st
+
+    # -------------------- load accounting ------------------------------
+
+    def load(self, rid: bytes) -> int:
+        """max(own in-flight, freshly reported queue depth): the local
+        count reacts instantly to this process's sends; the report covers
+        load from OTHER processes' handles."""
+        with self._lock:
+            local = self._inflight[rid]
+            st = self._stats.get(rid)
+        if st is not None and time.monotonic() - st.ts <= self._stale_s:
+            return max(local, st.queue_len)
+        return local
+
+    def on_send(self, rid: bytes) -> None:
+        with self._lock:
+            self._inflight[rid] += 1
+
+    def on_done(self, rid: bytes) -> None:
+        with self._lock:
+            self._inflight[rid] -= 1
+
+    def move(self, old_rid: bytes, new_rid: bytes) -> None:
+        """Failover moved a request: shift its in-flight accounting."""
+        with self._lock:
+            self._inflight[old_rid] -= 1
+            self._inflight[new_rid] += 1
+
+    # -------------------- decisions ------------------------------------
+
+    def choose(self, hint: Optional[str] = None):
+        raise NotImplementedError
+
+    def _require_replicas(self) -> List[Any]:
+        reps = self.replicas()
+        if not reps:
+            raise RuntimeError(
+                f"deployment {self.deployment_name} has no running replicas")
+        return reps
+
+    def _record(self, outcome: str, reps: Optional[List[Any]] = None):
+        self._m["decisions"].inc(
+            tags={"policy": self.policy, "outcome": outcome})
+        with self._lock:
+            self._decisions[outcome] += 1
+        if reps and len(reps) > 1:
+            now = time.monotonic()
+            if now - self._gauges_at >= 0.5:
+                self._gauges_at = now
+                loads = [self.load(r.actor_id) for r in reps]
+                self._m["imbalance"].set(
+                    max(loads) - min(loads), tags=self._mtags)
+
+    def snapshot(self) -> dict:
+        """Observability view (CLI / dashboard / tests)."""
+        with self._lock:
+            reps = list(self._replicas)
+            decisions = dict(self._decisions)
+            inflight = {rid.hex() if isinstance(rid, bytes) else str(rid): n
+                        for rid, n in self._inflight.items() if n}
+        return {
+            "app": self.app_name,
+            "deployment": self.deployment_name,
+            "policy": self.policy,
+            "replicas": len(reps),
+            "decisions": decisions,
+            "inflight": inflight,
+            "loads": {(r.actor_id.hex() if isinstance(r.actor_id, bytes)
+                       else str(r.actor_id)): self.load(r.actor_id)
+                      for r in reps},
+        }
+
+
+# -------------------- process-wide registry -----------------------------
+
+_REGISTRY: Dict[Tuple[str, str], RequestRouter] = {}
+_REG_LOCK = threading.Lock()
+
+
+def _make(policy: str, app_name: str, deployment_name: str) -> RequestRouter:
+    if policy == "prefix_aware":
+        from ray_tpu.serve.request_router.prefix_aware import \
+            PrefixAwareRouter
+
+        return PrefixAwareRouter(app_name, deployment_name)
+    from ray_tpu.serve.request_router.pow2 import Pow2Router
+
+    return Pow2Router(app_name, deployment_name)
+
+
+def get_router(app_name: str, deployment_name: str,
+               policy: str = "pow2") -> RequestRouter:
+    """The process-wide router for (app, deployment) — every handle gets
+    the SAME object, which is the multi-handle-agreement fix.  A policy
+    change (redeploy) swaps the router class but carries the in-flight
+    accounting and stats over, so responses settled after the swap still
+    decrement the right counters."""
+    key = (app_name, deployment_name)
+    with _REG_LOCK:
+        router = _REGISTRY.get(key)
+        if router is None or router.policy != policy:
+            fresh = _make(policy, app_name, deployment_name)
+            if router is not None:
+                fresh._inflight = router._inflight
+                fresh._stats = router._stats
+                fresh._replicas = router._replicas
+            _REGISTRY[key] = fresh
+            router = fresh
+        return router
+
+
+def router_snapshots() -> List[dict]:
+    with _REG_LOCK:
+        routers = list(_REGISTRY.values())
+    return [r.snapshot() for r in routers]

@@ -734,3 +734,24 @@ def list_data_jobs() -> List[Dict[str, Any]]:
         except (ValueError, UnicodeDecodeError):
             continue
     return sorted(out, key=lambda j: j.get("name", ""))
+
+
+def serve_routing_stats() -> List[Dict[str, Any]]:
+    """Per-deployment request-routing snapshots (policy, replica queue
+    depths, engine page/prefix-cache stats) published by the Serve
+    controller's stats lane to the GCS KV (namespace serve_routing) —
+    readable from any driver, like list_data_jobs."""
+    import json as _json
+
+    out: List[Dict[str, Any]] = []
+    for key in _rpc("kv_keys", {"namespace": "serve_routing"}) or []:
+        blob = _rpc("kv_get", {"namespace": "serve_routing",
+                               "key": bytes(key)})
+        if blob is None:
+            continue
+        try:
+            out.append(_json.loads(bytes(blob).decode()))
+        except (ValueError, UnicodeDecodeError):
+            continue
+    return sorted(out, key=lambda d: (d.get("app", ""),
+                                      d.get("deployment", "")))

@@ -127,7 +127,9 @@ def test_multiplexed_lru(ray_cluster):
 def test_prefix_affinity_routing(ray_cluster):
     import ray_tpu.serve as serve
 
-    @serve.deployment(num_replicas=2)
+    # hint stickiness moved from the old per-handle hash into the
+    # prefix_aware router policy; the default pow2 ignores hints
+    @serve.deployment(num_replicas=2, request_router_policy="prefix_aware")
     class Echo:
         def __init__(self):
             import os

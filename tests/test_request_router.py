@@ -1,0 +1,312 @@
+"""Request-router subsystem tests (serve/request_router/).
+
+Unit coverage: pow-2 load preference, the prefix tree (insert / deepest
+match / LRU eviction), imbalance fallback, digest-hit routing, stats
+staleness, and the process-wide registry (multi-handle agreement).  The
+integration test at the bottom drives two real LLM engines through both
+policies and asserts prefix-aware routing earns a strictly higher
+prefix-cache hit rate than pow-2 on shared-prefix traffic.
+"""
+
+import random
+
+import pytest
+
+from ray_tpu.serve.request_router import (
+    Pow2Router,
+    PrefixAwareRouter,
+    PrefixTree,
+    get_router,
+)
+from ray_tpu.serve.request_router.base import _REGISTRY
+
+
+class FakeReplica:
+    def __init__(self, rid: bytes):
+        self.actor_id = rid
+
+    def __repr__(self):
+        return f"FakeReplica({self.actor_id!r})"
+
+
+@pytest.fixture(autouse=True)
+def _clear_registry():
+    _REGISTRY.clear()
+    yield
+    _REGISTRY.clear()
+
+
+# ---------------------------------------------------------------- pow-2
+
+
+def test_pow2_prefers_shorter_queue():
+    random.seed(0)
+    router = Pow2Router("app", "d")
+    r1, r2 = FakeReplica(b"r1"), FakeReplica(b"r2")
+    router.update_replicas([r1, r2])
+    for _ in range(3):
+        router.on_send(r1.actor_id)
+    # with two replicas the sample is always {r1, r2}; the pick must be
+    # the unloaded one every time
+    for _ in range(20):
+        assert router.choose() is r2
+
+
+def test_pow2_single_replica_short_circuits():
+    router = Pow2Router("app", "d")
+    r1 = FakeReplica(b"r1")
+    router.update_replicas([r1])
+    assert router.choose() is r1
+    assert router._decisions["single"] == 1
+
+
+def test_router_raises_without_replicas():
+    router = Pow2Router("app", "d")
+    with pytest.raises(RuntimeError, match="no running replicas"):
+        router.choose()
+
+
+# ---------------------------------------------------------- prefix tree
+
+
+def test_prefix_tree_insert_and_deepest_match():
+    tree = PrefixTree(block=4, cap=64)
+    tree.insert("aaaabbbbcccc", b"r1")
+    tree.insert("aaaabbbb", b"r2")  # shares the first two levels
+    live = {b"r1", b"r2"}
+    # full hint: r1 owns the deepest (3-block) node
+    rid, depth = tree.match("aaaabbbbcccc", live)
+    assert (rid, depth) == (b"r1", 3)
+    # 2-block hint: r2 inserted later, so it is the most recent there
+    rid, depth = tree.match("aaaabbbb", live)
+    assert (rid, depth) == (b"r2", 2)
+    # no match at all
+    assert tree.match("zzzz", live) == (None, 0)
+    # dead replicas never match
+    rid, _ = tree.match("aaaabbbbcccc", {b"r2"})
+    assert rid == b"r2"
+
+
+def test_prefix_tree_lru_eviction():
+    tree = PrefixTree(block=4, cap=3)
+    tree.insert("aaaabbbbcccc", b"r1")  # 3 nodes, at cap
+    assert len(tree) == 3
+    tree.insert("zzzz", b"r2")  # evicts the coldest node ("aaaa")
+    assert len(tree) == 3
+    assert tree.evictions == 1
+    # the walk stops at the evicted depth-1 node (trie semantics: a cut
+    # path no longer matches), so the hint now misses...
+    assert tree.match("aaaabbbbcccc", {b"r1", b"r2"}) == (None, 0)
+    assert tree.match("zzzz", {b"r2"}) == (b"r2", 1)
+    # ...and re-inserting it restores the match while evicting the
+    # coldest remaining nodes
+    tree.insert("aaaabbbbcccc", b"r1")
+    assert len(tree) == 3
+    assert tree.match("aaaabbbbcccc", {b"r1"}) == (b"r1", 3)
+    assert tree.match("zzzz", {b"r2"}) == (None, 0)
+
+
+def test_prefix_tree_forget_replica():
+    tree = PrefixTree(block=4, cap=16)
+    tree.insert("aaaa", b"r1")
+    tree.forget(b"r1")
+    assert tree.match("aaaa", {b"r1"}) == (None, 0)
+
+
+# --------------------------------------------------- prefix-aware router
+
+
+def _aware(reps):
+    router = PrefixAwareRouter("app", "d")
+    router.update_replicas(reps)
+    return router
+
+
+def test_prefix_affinity_sticks():
+    random.seed(1)
+    r1, r2 = FakeReplica(b"r1"), FakeReplica(b"r2")
+    router = _aware([r1, r2])
+    hint = "system-prompt-alpha:" + "x" * 64
+    first = router.choose(hint)
+    # every subsequent request with the hint lands on the same replica
+    for _ in range(20):
+        assert router.choose(hint) is first
+    assert router._decisions["prefix_hit"] >= 20
+
+
+def test_imbalance_falls_back_to_pow2():
+    random.seed(2)
+    r1, r2 = FakeReplica(b"r1"), FakeReplica(b"r2")
+    router = _aware([r1, r2])
+    router.imbalance = 4.0
+    hint = "shared-prefix:" + "y" * 64
+    home = router.choose(hint)
+    other = r2 if home is r1 else r1
+    # overload the home replica past min + imbalance
+    for _ in range(6):
+        router.on_send(home.actor_id)
+    assert router.choose(hint) is other
+    assert router._decisions["fallback_imbalanced"] >= 1
+    # the fallback re-homed the prefix: once load drains, traffic stays
+    # on the new home rather than bouncing back
+    for _ in range(6):
+        router.on_done(home.actor_id)
+    assert router.choose(hint) is other
+
+
+def test_digest_hit_routes_to_page_holder():
+    random.seed(3)
+    r1, r2 = FakeReplica(b"r1"), FakeReplica(b"r2")
+    router = _aware([r1, r2])
+    digest = "deadbeefcafef00d"
+    router.update_stats({r2.actor_id: {
+        "queue_len": 0,
+        "engine": {"prefix_digests": [digest]}}})
+    for _ in range(5):
+        assert router.choose(digest) is r2
+    assert router._decisions["digest_hit"] == 5
+
+
+def test_departed_replica_forgotten():
+    random.seed(4)
+    r1, r2 = FakeReplica(b"r1"), FakeReplica(b"r2")
+    router = _aware([r1, r2])
+    hint = "sticky:" + "z" * 64
+    home = router.choose(hint)
+    survivor = r2 if home is r1 else r1
+    router.update_replicas([survivor])
+    assert router.choose(hint) is survivor
+
+
+# ------------------------------------------------------- stats staleness
+
+
+def test_stale_stats_ignored():
+    router = Pow2Router("app", "d")
+    r1 = FakeReplica(b"r1")
+    router.update_replicas([r1])
+    router.update_stats({r1.actor_id: {"queue_len": 50, "age_s": 0.0}})
+    assert router.load(r1.actor_id) == 50
+    # a sample backdated past RTPU_ROUTER_STALE_S contributes nothing
+    router.update_stats({r1.actor_id: {"queue_len": 50, "age_s": 999.0}})
+    assert router.stats_for(r1.actor_id) is None
+    assert router.load(r1.actor_id) == 0
+
+
+def test_load_is_max_of_local_and_reported():
+    router = Pow2Router("app", "d")
+    r1 = FakeReplica(b"r1")
+    router.update_replicas([r1])
+    router.update_stats({r1.actor_id: {"queue_len": 2, "age_s": 0.0}})
+    for _ in range(5):
+        router.on_send(r1.actor_id)
+    assert router.load(r1.actor_id) == 5  # local dominates
+    for _ in range(4):
+        router.on_done(r1.actor_id)
+    assert router.load(r1.actor_id) == 2  # report dominates
+
+
+# ------------------------------------------- registry / handle agreement
+
+
+def test_get_router_shared_across_handles():
+    a = get_router("app", "dep", "pow2")
+    b = get_router("app", "dep", "pow2")
+    assert a is b
+    # routing state is shared: a send through one handle's router is
+    # visible to the other (the old per-handle home-map divergence)
+    a.on_send(b"r1")
+    assert b._inflight[b"r1"] == 1
+    assert get_router("app", "other", "pow2") is not a
+
+
+def test_policy_swap_carries_inflight():
+    a = get_router("app", "dep", "pow2")
+    a.on_send(b"r1")
+    b = get_router("app", "dep", "prefix_aware")
+    assert b is not a
+    assert isinstance(b, PrefixAwareRouter)
+    assert b._inflight[b"r1"] == 1  # settled responses still decrement
+    assert get_router("app", "dep", "prefix_aware") is b
+
+
+# ------------------------------------------------------------ snapshots
+
+
+def test_snapshot_shape():
+    random.seed(5)
+    r1, r2 = FakeReplica(b"r1"), FakeReplica(b"r2")
+    router = _aware([r1, r2])
+    router.choose("hinted:" + "w" * 40)
+    snap = router.snapshot()
+    assert snap["policy"] == "prefix_aware"
+    assert snap["replicas"] == 2
+    assert sum(snap["decisions"].values()) == 1
+    assert "prefix_tree" in snap and snap["prefix_tree"]["nodes"] >= 1
+
+
+# ------------------------------------------------ engine integration
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    jax = pytest.importorskip("jax")
+    from ray_tpu.models import llama
+
+    cfg = llama.LlamaConfig(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=256, dtype="float32", remat=False)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    return params, cfg
+
+
+def _run_policy(tiny_model, router_cls, seed):
+    """Two real engines behind a router; shared-prefix traffic; returns
+    the aggregate prefix-cache hit rate across both engines."""
+    from ray_tpu.llm.engine import EngineConfig, LLMEngine, SamplingParams
+
+    params, cfg = tiny_model
+    engines = {}
+    reps = []
+    for name in (b"e1", b"e2"):
+        eng = LLMEngine(params, cfg, EngineConfig(
+            max_slots=4, num_pages=64, page_size=8, max_seq_len=256,
+            prefill_buckets=(16, 32, 64)))
+        engines[name] = eng
+        reps.append(FakeReplica(name))
+    router = router_cls("app", f"bench-{router_cls.__name__}-{seed}")
+    router.update_replicas(reps)
+    random.seed(seed)
+    rng = random.Random(seed)
+    groups = [[1 + g, 2 + g, 3 + g, 4 + g] * 6 for g in range(3)]
+    try:
+        for i in range(30):
+            g = i % 3
+            prompt = groups[g] + [rng.randrange(1, 128) for _ in range(4)]
+            hint = f"group-{g}:" + "p" * 48
+            rep = router.choose(hint)
+            router.on_send(rep.actor_id)
+            engines[rep.actor_id].generate(
+                prompt, SamplingParams(max_tokens=4))
+            router.on_done(rep.actor_id)
+            router.update_stats({
+                rid: {"queue_len": 0, "age_s": 0.0,
+                      "engine": e.stats()}
+                for rid, e in engines.items()})
+        hits = sum(e.stats()["prefix_cache"]["hit_tokens"]
+                   for e in engines.values())
+        lookups = sum(e.stats()["prefix_cache"]["lookup_tokens"]
+                      for e in engines.values())
+        return hits / max(lookups, 1)
+    finally:
+        for e in engines.values():
+            e.stop()
+
+
+def test_prefix_aware_beats_pow2_hit_rate(tiny_model):
+    aware = _run_policy(tiny_model, PrefixAwareRouter, seed=11)
+    pow2 = _run_policy(tiny_model, Pow2Router, seed=11)
+    # same traffic, same engines: KV-locality routing must convert more
+    # lookups into warm-page hits than blind load balancing
+    assert aware > pow2, (aware, pow2)
+    assert aware >= 0.5, aware  # sticky homes make most prefixes warm
