@@ -28,8 +28,32 @@ sanitize:
 	@! grep -rq "runtime error\|AddressSanitizer" $(SANDIR) \
 	    && echo "sanitize: clean (no ASan/UBSan reports)"
 
-test:
+# Static analysis (`rtpu check`): cross-language drift between the C++
+# daemons and their Python peers, lock-order / blocking-under-mutex
+# analysis, hot-path purity lint, metrics naming lint.  Stdlib-only, no
+# jax import, no cluster — ~1s, so it fronts the default test flow and
+# drift fails fast.
+check:
+	python -m ray_tpu._private.staticcheck
+
+test: check
 	python -m pytest tests/ -q
+
+# Store daemon under ThreadSanitizer: rebuild shm_store with
+# RTPU_SANITIZE=thread (its own cache namespace, like -asan) and drive
+# the store dataplane + crash-recovery chaos tests against it — the
+# striped-pull and restart paths are the race-sensitive surfaces.  Only
+# the standalone daemon binary is instrumented; no LD_PRELOAD needed.
+TSANDIR := /tmp/rtpu_tsan
+
+sanitize-store:
+	rm -rf $(TSANDIR) && mkdir -p $(TSANDIR)
+	RTPU_SANITIZE=thread \
+	TSAN_OPTIONS=log_path=$(TSANDIR)/tsan:history_size=7 \
+	python -m pytest tests/test_store_dataplane.py \
+	    tests/test_store_recovery.py -q 2>&1 | tee $(TSANDIR)/pytest.log
+	@! grep -rq "WARNING: ThreadSanitizer" $(TSANDIR) \
+	    && echo "sanitize-store: clean (no TSan reports)"
 
 # Observability end-to-end: boot a cluster, run a traced nested
 # workload, assert the trace assembles cluster-wide and the dashboard
@@ -66,4 +90,4 @@ bench-serve:
 bench-scale:
 	JAX_PLATFORMS=cpu python -m ray_tpu._private.scale_bench
 
-.PHONY: sanitize test obs-smoke bench-store bench-data bench-serve bench-scale
+.PHONY: sanitize sanitize-store check test obs-smoke bench-store bench-data bench-serve bench-scale

@@ -62,9 +62,13 @@ INLINE_MAX = int(os.environ.get("RTPU_INLINE_MAX", 100 * 1024))
 # frame layer (the C++ threads bypass Python chaos by construction).
 # ---------------------------------------------------------------------------
 
-FRAME_CALL = 0x01
-FRAME_REPLY = 0x02
-FRAME_CALL_PICKLED = 0x03
+# Frame-kind bytes live in wire_constants (the single Python anchor the
+# drift pass compares against core_worker.cc's reply matcher).
+from ray_tpu._private.wire_constants import (  # noqa: F401
+    FRAME_CALL,
+    FRAME_CALL_PICKLED,
+    FRAME_REPLY,
+)
 
 REPLY_OK = 1  # flags bit0: executed without raising
 REPLY_IN_STORE = 2  # flags bit1: result in the shm store, payload empty
@@ -95,7 +99,10 @@ def _report_native_lane_disabled(reason: str):
     try:
         from ray_tpu.util.metrics import Gauge
 
-        Gauge("ray_tpu_native_lane_disabled",
+        # Bare family name: the dashboard renderer prefixes every pushed
+        # family with ray_tpu_, so this renders as
+        # ray_tpu_native_lane_disabled (see README).
+        Gauge("native_lane_disabled",
               description="1 when this process runs with the native C++ "
                           "transport off (chaos injection or "
                           "RTPU_NATIVE_TRANSPORT=0) and dispatch rides "

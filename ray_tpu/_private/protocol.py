@@ -22,6 +22,8 @@ import socket
 import struct
 import threading
 
+from ray_tpu._private.wire_constants import MAX_FRAME
+
 _LEN = struct.Struct("<I")
 
 
@@ -159,7 +161,7 @@ class Connection:
             raise ConnectionResetError("rpc chaos: injected send failure")
         self.send_bytes(data)
 
-    def recv_frame(self, max_len: int = 1 << 28) -> bytes | None:
+    def recv_frame(self, max_len: int = MAX_FRAME) -> bytes | None:
         """Receive one raw frame WITH chaos injection; None on EOF.
 
         The wire-codec counterpart of recv(): nothing is unpickled — the

@@ -1,0 +1,103 @@
+"""``rtpu check``: jax-free static analysis for the ray_tpu tree.
+
+Four passes (see each module's docstring):
+
+- ``drift``    — cross-language protocol constants + env-flag registry
+- ``locks``    — C++ lock-order graph / blocking-under-mutex + Python
+                 blocking-under-lock
+- ``purity``   — hot-path host syncs and nondeterminism in jitted code
+- ``metrics``  — Prometheus family naming / registration / HELP-TYPE
+
+Findings are ``Violation``s with file:line; intentional ones are
+suppressed by ``allowlist.py`` entries, each of which must carry a
+written reason.  Run via ``rtpu check``, ``make check`` or
+``python -m ray_tpu._private.staticcheck``.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ray_tpu._private.staticcheck import (
+    drift,
+    locks,
+    metrics_lint,
+    purity,
+)
+from ray_tpu._private.staticcheck.allowlist import ALLOWLIST
+from ray_tpu._private.staticcheck.common import (
+    Allow,
+    Report,
+    Violation,
+    apply_allowlist,
+    repo_root,
+    validate_allowlist,
+)
+
+__all__ = ["PASSES", "Allow", "Report", "Violation", "run", "main"]
+
+PASSES = {
+    "drift": drift.check,
+    "locks": locks.check,
+    "purity": purity.check,
+    "metrics": metrics_lint.check,
+}
+
+
+def run(root: str | None = None, passes: list[str] | None = None,
+        allows: list[Allow] | None = None) -> Report:
+    root = root or repo_root()
+    allows = ALLOWLIST if allows is None else allows
+    violations: list[Violation] = []
+    for name in (passes or list(PASSES)):
+        violations.extend(PASSES[name](root))
+    report = apply_allowlist(violations, allows)
+    for err in validate_allowlist(allows):
+        report.violations.append(
+            Violation("allowlist/missing-reason",
+                      "ray_tpu/_private/staticcheck/allowlist.py", 1, err))
+    return report
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="rtpu check", description=__doc__.split("\n")[0])
+    parser.add_argument("--root", default=None,
+                        help="tree to check (default: this repo)")
+    parser.add_argument("--pass", dest="passes", action="append",
+                        choices=sorted(PASSES),
+                        help="run only this pass (repeatable)")
+    parser.add_argument("--no-allowlist", action="store_true",
+                        help="show findings the allowlist suppresses")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output")
+    args = parser.parse_args(argv)
+
+    t0 = time.monotonic()
+    report = run(root=args.root, passes=args.passes,
+                 allows=[] if args.no_allowlist else None)
+    dt = time.monotonic() - t0
+
+    if args.json:
+        import json
+
+        print(json.dumps({
+            "violations": [v.__dict__ for v in report.violations],
+            "suppressed": [{**v.__dict__, "reason": a.reason}
+                           for v, a in report.suppressed],
+            "elapsed_s": round(dt, 3),
+        }, indent=2))
+        return 0 if report.ok else 1
+
+    for v in report.violations:
+        print(v.format())
+    for a in report.unused_allows:
+        print(f"note: unused allowlist entry [{a.rule}] {a.path} "
+              f"({a.reason})")
+    n_pass = len(args.passes) if args.passes else len(PASSES)
+    print(f"rtpu check: {len(report.violations)} violation(s), "
+          f"{len(report.suppressed)} allowlisted, {n_pass} pass(es) "
+          f"in {dt:.2f}s")
+    return 0 if report.ok else 1

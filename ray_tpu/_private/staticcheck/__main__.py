@@ -1,0 +1,3 @@
+from ray_tpu._private.staticcheck import main
+
+raise SystemExit(main())

@@ -1,0 +1,50 @@
+"""Reviewed findings that stay in the tree on purpose.
+
+Every entry MUST carry a reason string explaining why the finding is
+acceptable — ``rtpu check`` fails on an entry with an empty reason, and
+prints a note for entries that no longer match anything (so stale
+suppressions get pruned instead of accreting).
+"""
+
+from __future__ import annotations
+
+from ray_tpu._private.staticcheck.common import Allow
+
+ALLOWLIST: list[Allow] = [
+    # -- locks ---------------------------------------------------------
+    Allow("locks/blocking-under-mutex", "ray_tpu/native/core_worker.cc",
+          "send_all() while holding send_mu",
+          reason="send_mu exists precisely to serialize frame writers on "
+                 "one connection fd; holding it across send_all is the "
+                 "design (one mutex per connection, contenders are other "
+                 "submitters on the same channel, and a hand-off queue "
+                 "would add a copy plus a thread)."),
+    Allow("locks/blocking-under-mutex", "ray_tpu/native/shm_store.cc",
+          "while holding mu_",
+          reason="spill/restore disk IO runs under the store mutex on "
+                 "purpose (documented at SpillLocked): eviction and "
+                 "restore are the slow path, and serializing them keeps "
+                 "spill/create/restore races trivially correct — extent "
+                 "reuse must be atomic with the spill that frees it."),
+    # -- purity --------------------------------------------------------
+    Allow("purity/host-sync-unbracketed", "ray_tpu/train/gbdt.py",
+          "np.asarray",
+          reason="CPU-only dataset assembly from Python row dicts at "
+                 "training setup; there are no device arrays in the GBDT "
+                 "path, so this is a plain host copy, not a sync."),
+    Allow("purity/host-sync-unbracketed", "ray_tpu/llm/batch.py",
+          "np.asarray",
+          reason="host-side token-list padding over Python lists before "
+                 "device upload; nothing device-resident is involved."),
+    Allow("purity/host-sync-unbracketed", "ray_tpu/llm/engine.py",
+          "np.asarray",
+          reason="the engine samples on host by design: pulling logits "
+                 "(and KV pages during migration) to numpy is its single "
+                 "designed device sync per decode step, accounted by the "
+                 "engine's own step timing rather than a GoodputTracker "
+                 "bracket (serving, not training)."),
+    Allow("purity/host-sync-unbracketed", "ray_tpu/llm/paged_cache.py",
+          "np.asarray",
+          reason="hashes host-side token lists (Python ints) to build "
+                 "prefix-cache keys; a host copy, not a device sync."),
+]

@@ -1,0 +1,143 @@
+"""Pass 4: Prometheus metrics / span naming discipline.
+
+Statically scans every ``Counter(...)`` / ``Gauge(...)`` /
+``Histogram(...)`` construction in the tree and the dashboard renderer:
+
+- family names are valid Prometheus identifiers (lowercase snake) and
+  do not pre-bake the ``ray_tpu_`` prefix (the renderer applies it
+  idempotently; double-prefixed source names mask collisions);
+- every family carries a non-empty description — that string IS the
+  ``# HELP`` line the dashboard emits;
+- one family is registered at exactly one construction site (two sites
+  with one name either double-count or fight over kind/help);
+- every family the renderer hardcodes (``fam("…")``) carries the
+  ``ray_tpu_`` prefix, and the renderer both emits ``# HELP``/``# TYPE``
+  and applies the prefix to pushed families.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ray_tpu._private.staticcheck.common import (
+    Violation,
+    read_source,
+    walk_sources,
+)
+
+_METRIC_CTORS = {"Counter", "Gauge", "Histogram"}
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def _ctor_kind(func: ast.expr) -> str | None:
+    if isinstance(func, ast.Name) and func.id in _METRIC_CTORS:
+        return func.id
+    if isinstance(func, ast.Attribute) and func.attr in _METRIC_CTORS:
+        return func.attr
+    return None
+
+
+def _literal_str(node: ast.expr | None) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _fstring_prefix(node: ast.expr) -> str | None:
+    """First literal chunk of an f-string, or the whole literal."""
+    lit = _literal_str(node)
+    if lit is not None:
+        return lit
+    if isinstance(node, ast.JoinedStr) and node.values:
+        return _literal_str(node.values[0])
+    return None
+
+
+def _scan_registrations(root: str, violations: list[Violation]):
+    sites: dict[str, list[tuple[str, int, str]]] = {}
+    for rel, src in walk_sources(root, (".py",)):
+        if rel.endswith("util/metrics.py") or "/staticcheck/" in rel:
+            continue  # the class definitions / this checker itself
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _ctor_kind(node.func)
+            if kind is None:
+                continue
+            name_node = node.args[0] if node.args else None
+            name = _literal_str(name_node)
+            if name is None:
+                continue  # dynamic name: out of static reach
+            desc = _literal_str(
+                node.args[1] if len(node.args) > 1 else
+                next((k.value for k in node.keywords
+                      if k.arg == "description"), None))
+            if not _NAME_RE.match(name):
+                violations.append(Violation(
+                    "metrics/invalid-name", rel, node.lineno,
+                    f"{kind} family {name!r} is not a lowercase snake_case "
+                    "Prometheus name"))
+            if name.startswith("ray_tpu_"):
+                violations.append(Violation(
+                    "metrics/prebaked-prefix", rel, node.lineno,
+                    f"{kind} family {name!r} hardcodes the ray_tpu_ prefix; "
+                    "register the bare name — the dashboard renderer "
+                    "prefixes every pushed family"))
+            if not (desc or "").strip():
+                violations.append(Violation(
+                    "metrics/missing-help", rel, node.lineno,
+                    f"{kind} family {name!r} has no description (its # HELP "
+                    "line would be empty)"))
+            sites.setdefault(name, []).append((rel, node.lineno, kind))
+    for name, where in sorted(sites.items()):
+        if len(where) > 1:
+            locs = ", ".join(f"{r}:{ln}" for r, ln, _ in where)
+            rel, line, _ = where[0]
+            violations.append(Violation(
+                "metrics/duplicate-family", rel, line,
+                f"family {name!r} is constructed at {len(where)} sites "
+                f"({locs}); register it once and share the instance"))
+
+
+def _scan_renderer(root: str, violations: list[Violation]):
+    rendered_any = False
+    for rel, src in walk_sources(root, (".py",), subdir="ray_tpu/dashboard"):
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        has_renderer = "_render_prometheus" in src
+        if has_renderer:
+            rendered_any = True
+            if "# HELP" not in src or "# TYPE" not in src:
+                violations.append(Violation(
+                    "metrics/renderer-missing-help-type", rel, 1,
+                    "_render_prometheus does not emit # HELP/# TYPE "
+                    "headers"))
+            if 'startswith("ray_tpu_")' not in src:
+                violations.append(Violation(
+                    "metrics/renderer-prefix-missing", rel, 1,
+                    "_render_prometheus does not apply the ray_tpu_ prefix "
+                    "to pushed families"))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                    and node.func.id == "fam" and node.args:
+                prefix = _fstring_prefix(node.args[0])
+                if prefix is not None and not prefix.startswith("ray_tpu_"):
+                    violations.append(Violation(
+                        "metrics/unprefixed-family", rel, node.lineno,
+                        f"renderer emits family starting {prefix!r} without "
+                        "the ray_tpu_ prefix"))
+    return rendered_any
+
+
+def check(root: str) -> list[Violation]:
+    violations: list[Violation] = []
+    _scan_registrations(root, violations)
+    _scan_renderer(root, violations)
+    return violations

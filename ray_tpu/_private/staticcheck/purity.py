@@ -1,0 +1,173 @@
+"""Pass 3: hot-path purity over ``train/``, ``parallel/`` and ``llm/``.
+
+Two rule families:
+
+- Inside a *jitted* function (decorated with ``jax.jit`` /
+  ``partial(jax.jit, …)``, or passed by name to a ``jax.jit(...)`` call
+  in the same module) nothing may read the wall clock or host RNG state
+  — a traced ``time.time()`` bakes one trace-time constant into the
+  compiled step — and nothing may force a host sync (``.item()``,
+  ``np.asarray``, ``block_until_ready``), which would fail or silently
+  fall back under tracing.
+
+- Outside jit, host syncs on the hot path must sit inside a
+  GoodputTracker bracket (``with gp.step() as st`` / ``with
+  st.phase(...)``) so the stall is attributed to a step phase instead
+  of vanishing into untimed wall clock.  Host-side code with a reason
+  to sync (e.g. sampling on CPU) is allowlisted per file.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu._private.staticcheck.common import Violation, walk_sources
+
+_HOT_SUBDIRS = ("ray_tpu/train", "ray_tpu/parallel", "ray_tpu/llm")
+
+_WALLCLOCK = {"time", "perf_counter", "monotonic", "time_ns",
+              "perf_counter_ns", "monotonic_ns"}
+_HOST_RNG = {"random", "randint", "randrange", "choice", "shuffle",
+             "uniform", "sample", "normal", "default_rng", "urandom",
+             "uuid4", "getrandbits"}
+_RNG_MODULES = {"random", "os", "uuid"}
+_BRACKET_ATTRS = {"step", "phase", "compile_bracket"}
+
+
+def _dotted(node: ast.expr) -> str:
+    """'np.random.default_rng' for nested attributes, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _numpy_aliases(tree: ast.Module) -> set[str]:
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    out.add(a.asname or "numpy")
+    return out
+
+
+def _jitted_names(tree: ast.Module) -> set[str]:
+    """Function names that end up compiled: decorated with *jit* or
+    passed by name to a jit(...) call anywhere in the module."""
+    names: set[str] = set()
+
+    def is_jit_expr(node: ast.expr) -> bool:
+        d = _dotted(node)
+        if d.endswith(".jit") or d == "jit":
+            return True
+        if isinstance(node, ast.Call):
+            # partial(jax.jit, ...) or jax.jit with kwargs
+            if is_jit_expr(node.func):
+                return True
+            return any(is_jit_expr(a) for a in node.args)
+        return False
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(is_jit_expr(d) for d in node.decorator_list):
+                names.add(node.name)
+        elif isinstance(node, ast.Call) and is_jit_expr(node.func) \
+                and node.args and isinstance(node.args[0], ast.Name):
+            names.add(node.args[0].id)
+    return names
+
+
+class _PurityVisitor(ast.NodeVisitor):
+    def __init__(self, rel: str, jitted: set[str], np_aliases: set[str],
+                 violations: list[Violation]):
+        self.rel = rel
+        self.jitted = jitted
+        self.np = np_aliases
+        self.violations = violations
+        self.jit_depth = 0
+        self.bracket_depth = 0
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        entered = node.name in self.jitted
+        if entered:
+            self.jit_depth += 1
+        self.generic_visit(node)
+        if entered:
+            self.jit_depth -= 1
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_With(self, node: ast.With):
+        bracket = any(
+            isinstance(i.context_expr, ast.Call)
+            and isinstance(i.context_expr.func, ast.Attribute)
+            and i.context_expr.func.attr in _BRACKET_ATTRS
+            for i in node.items)
+        if bracket:
+            self.bracket_depth += 1
+        self.generic_visit(node)
+        if bracket:
+            self.bracket_depth -= 1
+
+    def visit_Call(self, node: ast.Call):
+        dotted = _dotted(node.func)
+        head = dotted.split(".")[0] if dotted else ""
+        tail = dotted.split(".")[-1] if dotted else ""
+        in_jit = self.jit_depth > 0
+
+        if in_jit:
+            if head == "time" and tail in _WALLCLOCK:
+                self._emit("purity/wallclock-in-jit", node,
+                           f"{dotted}() inside a jitted step function "
+                           "(traces to a compile-time constant)")
+            elif tail in _HOST_RNG and (
+                    (head in self.np and ".random." in f".{dotted}.")
+                    or head in _RNG_MODULES):
+                self._emit("purity/rng-in-jit", node,
+                           f"{dotted}() inside a jitted step function "
+                           "(host RNG is nondeterministic under tracing; "
+                           "thread a jax.random key instead)")
+
+        # Host syncs: banned inside jit, bracket-required outside.
+        sync = None
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "item" and not node.args:
+            sync = ".item()"
+        elif head in self.np and tail == "asarray":
+            sync = f"{dotted}()"
+        elif tail == "block_until_ready":
+            sync = f"{dotted or 'block_until_ready'}()"
+        if sync:
+            if in_jit:
+                self._emit("purity/host-sync-in-jit", node,
+                           f"{sync} inside a jitted step function")
+            elif not self.bracket_depth:
+                self._emit("purity/host-sync-unbracketed", node,
+                           f"{sync} outside a GoodputTracker step/phase "
+                           "bracket (stall is unattributed)")
+        self.generic_visit(node)
+
+    def _emit(self, rule: str, node: ast.AST, msg: str):
+        self.violations.append(
+            Violation(rule, self.rel, getattr(node, "lineno", 1), msg))
+
+
+def check(root: str) -> list[Violation]:
+    violations: list[Violation] = []
+    for sub in _HOT_SUBDIRS:
+        for rel, src in walk_sources(root, (".py",), subdir=sub):
+            try:
+                tree = ast.parse(src)
+            except SyntaxError as e:
+                violations.append(Violation(
+                    "purity/parse-error", rel, e.lineno or 1, str(e)))
+                continue
+            visitor = _PurityVisitor(rel, _jitted_names(tree),
+                                     _numpy_aliases(tree), violations)
+            visitor.visit(tree)
+    return violations

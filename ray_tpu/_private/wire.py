@@ -37,14 +37,15 @@ import builtins
 import struct
 from typing import Any, Callable
 
-WIRE_VERSION = 1
-HELLO = b"RTPUWIRE" + bytes([WIRE_VERSION])
-HELLO_OK = b"RTPUWIRE-OK" + bytes([WIRE_VERSION])
-
-# Decode hard limits: a frame that claims more than this is rejected before
-# any allocation happens (defense against length-bomb frames).
-MAX_DEPTH = 32
-MAX_ITEMS = 1 << 22  # 4M elements in one collection
+# Protocol constants live in wire_constants (the single Python anchor the
+# drift pass compares against native/wire.h); re-exported here for callers.
+from ray_tpu._private.wire_constants import (  # noqa: F401
+    HELLO,
+    HELLO_OK,
+    MAX_DEPTH,
+    MAX_ITEMS,
+    WIRE_VERSION,
+)
 
 
 class WireError(Exception):

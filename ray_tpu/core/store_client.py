@@ -15,7 +15,6 @@ import json
 import mmap
 import os
 import socket
-import struct
 import subprocess
 import threading
 import time
@@ -23,24 +22,32 @@ import time
 from ray_tpu.exceptions import StoreDiedError
 from ray_tpu.native.build import binary_path
 
-ID_LEN = 20
-_REQ = struct.Struct("<B20sQQ")
-_RESP = struct.Struct("<BQQ")
+# Store protocol constants live in _private/wire_constants (the single
+# Python anchor the drift pass compares against shm_store.cc).
+from ray_tpu._private.wire_constants import (  # noqa: F401
+    ST_ERR,
+    ST_EVICTED,
+    ST_EXISTS,
+    ST_NOT_FOUND,
+    ST_NOT_SEALED,
+    ST_OK,
+    ST_OOM,
+    ST_TIMEOUT,
+    ST_VIEW,
+)
+from ray_tpu._private import wire_constants as _wc
 
-ST_OK = 0
-ST_NOT_FOUND = 1
-ST_EXISTS = 2
-ST_OOM = 3
-ST_TIMEOUT = 4
-ST_NOT_SEALED = 5
-ST_ERR = 6
-ST_EVICTED = 7
-ST_VIEW = 8  # GET_INLINE: too big to inline; pin kept, (offset, size) back
+ID_LEN = _wc.OBJECT_ID_LEN
+_REQ = _wc.STORE_REQ
+_RESP = _wc.STORE_RESP
 
-_OP_CREATE, _OP_SEAL, _OP_GET, _OP_RELEASE = 1, 2, 3, 4
-_OP_DELETE, _OP_CONTAINS, _OP_STATS, _OP_ABORT = 5, 6, 7, 8
-_OP_PUT, _OP_GET_INLINE, _OP_PULL, _OP_PUSH = 9, 10, 11, 12
-_OP_AUDIT = 13
+_OP_CREATE, _OP_SEAL = _wc.OP_CREATE, _wc.OP_SEAL
+_OP_GET, _OP_RELEASE = _wc.OP_GET, _wc.OP_RELEASE
+_OP_DELETE, _OP_CONTAINS = _wc.OP_DELETE, _wc.OP_CONTAINS
+_OP_STATS, _OP_ABORT = _wc.OP_STATS, _wc.OP_ABORT
+_OP_PUT, _OP_GET_INLINE = _wc.OP_PUT, _wc.OP_GET_INLINE
+_OP_PULL, _OP_PUSH = _wc.OP_PULL, _wc.OP_PUSH
+_OP_AUDIT = _wc.OP_AUDIT
 
 # Objects at or below this come back as inline bytes from GET_INLINE (one
 # round trip, daemon-side copy, no pin/RELEASE); bigger ones come back as

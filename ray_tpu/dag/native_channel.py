@@ -13,6 +13,10 @@ import os
 from typing import Optional
 
 from ray_tpu._private.serialization import deserialize, serialized_size, write_payload
+# The C side stamps CHANNEL_MAGIC ("RTPUCHA") into the segment header
+# last, so mc_open rejects half-initialized segments; the drift pass
+# (`rtpu check`) pins mutable_channel.cc's kMagic to this anchor.
+from ray_tpu._private.wire_constants import CHANNEL_MAGIC
 
 
 class NativeChannelClosed(Exception):
@@ -65,7 +69,10 @@ class NativeChannel:
                     break
                 _time.sleep(0.005)
         if not handle:
-            raise OSError(f"could not create/open native channel {name}")
+            raise OSError(
+                f"could not create/open native channel {name} (header "
+                f"magic {CHANNEL_MAGIC:#x} never appeared: creator died "
+                "mid-init or the segment is foreign)")
         self._handle = handle
         self._buf = ctypes.create_string_buffer(1 << 16)
 

@@ -55,13 +55,29 @@ def _source_hash(sources: list[str]) -> str:
     return h.hexdigest()[:16]
 
 
-# RTPU_SANITIZE=1 builds every native component with ASan+UBSan (separate
-# cache namespace, so sanitized and fast binaries coexist).  Used by
-# `make sanitize` — see Makefile — to run the native test files against
-# instrumented builds.
-_SANITIZE = os.environ.get("RTPU_SANITIZE", "0") == "1"
-_SAN_FLAGS = ["-fsanitize=address,undefined", "-fno-omit-frame-pointer",
-              "-g", "-O1"]
+# RTPU_SANITIZE selects an instrumented build (separate cache namespace,
+# so sanitized and fast binaries coexist):
+#   address (or the legacy "1") -> ASan+UBSan   (`make sanitize`)
+#   thread                      -> TSan         (`make sanitize-store`)
+def _sanitize_mode() -> str:
+    raw = os.environ.get("RTPU_SANITIZE", "").strip().lower()
+    if raw in ("", "0", "false", "off"):
+        return ""
+    if raw in ("1", "address", "asan"):
+        return "asan"
+    if raw in ("thread", "tsan"):
+        return "tsan"
+    raise ValueError(
+        f"RTPU_SANITIZE={raw!r}: expected 'address' (or legacy '1') "
+        "or 'thread'")
+
+
+_SANITIZE = _sanitize_mode()
+_SAN_FLAGS = {
+    "asan": ["-fsanitize=address,undefined", "-fno-omit-frame-pointer",
+             "-g", "-O1"],
+    "tsan": ["-fsanitize=thread", "-fno-omit-frame-pointer", "-g", "-O1"],
+}
 
 
 def binary_path(name: str) -> str:
@@ -70,17 +86,21 @@ def binary_path(name: str) -> str:
     # headers participate in the cache key but not the compile line
     tag = _source_hash(spec["sources"] + spec.get("headers", []))
     if _SANITIZE:
-        tag += "-asan"
+        tag += f"-{_SANITIZE}"
     out = os.path.join(_BUILD_DIR,
                        f"{name}-{tag}{spec.get('suffix', '')}")
     if _SANITIZE and spec.get("suffix") == ".so" \
-            and "asan" not in os.environ.get("LD_PRELOAD", ""):
-        # Loading an ASan-linked DSO into an uninstrumented interpreter
-        # aborts the process with a cryptic "ASan runtime does not come
-        # first" — fail actionably instead.
+            and _SANITIZE not in os.environ.get("LD_PRELOAD", ""):
+        # Loading a sanitizer-linked DSO into an uninstrumented
+        # interpreter aborts the process with a cryptic "runtime does
+        # not come first" — fail actionably instead.  Standalone daemon
+        # binaries (shm_store, gcs_server) need no preload: the runtime
+        # links into the executable itself.
+        lib = "libasan/libubsan" if _SANITIZE == "asan" else "libtsan"
         raise RuntimeError(
-            "RTPU_SANITIZE=1 requires libasan/libubsan in LD_PRELOAD; "
-            "use `make sanitize`")
+            f"RTPU_SANITIZE={_SANITIZE} requires {lib} in LD_PRELOAD to "
+            "load instrumented extension modules; use `make sanitize` / "
+            "`make sanitize-store`")
     if os.path.exists(out):
         return out
     os.makedirs(_BUILD_DIR, exist_ok=True)
@@ -88,7 +108,8 @@ def binary_path(name: str) -> str:
     tmp = out + f".tmp.{os.getpid()}"
     flags = list(spec["flags"])
     if _SANITIZE:
-        flags = [f for f in flags if not f.startswith("-O")] + _SAN_FLAGS
+        flags = ([f for f in flags if not f.startswith("-O")]
+                 + _SAN_FLAGS[_SANITIZE])
     if spec.get("python_ext"):
         import sysconfig
 

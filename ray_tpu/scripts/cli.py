@@ -825,6 +825,24 @@ def cmd_serve(args):
               f"{preempt:7d} {evict:6d}")
 
 
+def cmd_check(args):
+    """Static analysis (`rtpu check`): cross-language drift, lock-order,
+    hot-path purity and metrics-naming passes.  No jax import, no
+    cluster — safe to run anywhere in well under ten seconds."""
+    from ray_tpu._private import staticcheck
+
+    forward = []
+    if args.root:
+        forward += ["--root", args.root]
+    for name in args.passes or []:
+        forward += ["--pass", name]
+    if args.json:
+        forward.append("--json")
+    if args.no_allowlist:
+        forward.append("--no-allowlist")
+    raise SystemExit(staticcheck.main(forward))
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="ray_tpu")
     sub = p.add_subparsers(dest="command", required=True)
@@ -966,6 +984,17 @@ def main(argv=None):
     sp.add_argument("--json", action="store_true",
                     help="full routing snapshots as JSON")
     sp.set_defaults(fn=cmd_serve)
+    sp = sub.add_parser("check")
+    sp.add_argument("--root", default=None,
+                    help="tree to analyze (default: this repo)")
+    sp.add_argument("--pass", dest="passes", action="append",
+                    choices=("drift", "locks", "purity", "metrics"),
+                    help="run only this pass (repeatable)")
+    sp.add_argument("--no-allowlist", action="store_true",
+                    help="show findings the allowlist suppresses")
+    sp.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    sp.set_defaults(fn=cmd_check)
     args = p.parse_args(argv)
     args.fn(args)
 

@@ -1,0 +1,14 @@
+"""Fixture anchor: the store-plane constants at their true values."""
+
+import struct
+
+OBJECT_ID_LEN = 20
+STORE_REQ = struct.Struct("<B20sQQ")
+STORE_RESP = struct.Struct("<BQQ")
+
+ST_OK = 0
+ST_NOT_FOUND = 1
+
+OP_CREATE = 1
+OP_SEAL = 2
+OP_GET = 3

@@ -1,0 +1,17 @@
+// Fixture: OP_SEAL drifted (99, Python anchor says 2) and the request
+// frame shrank (kReqLen 29 vs the 37 bytes STORE_REQ packs).
+#include <cstdint>
+#include <cstddef>
+
+namespace {
+
+constexpr uint8_t OP_CREATE = 1, OP_SEAL = 99, OP_GET = 3;
+constexpr uint8_t ST_OK = 0, ST_NOT_FOUND = 1;
+
+constexpr size_t kIdLen = 20;
+constexpr size_t kReqLen = 1 + kIdLen + 8;  // dropped an arg word
+constexpr size_t kRespLen = 1 + 8 + 8;
+
+}  // namespace
+
+int main() { return OP_CREATE + kReqLen + kRespLen + ST_OK; }

@@ -1,0 +1,29 @@
+"""Fixture: a jitted step function that reads the wall clock (traces to
+a compile-time constant) and forces a host sync, plus an unbracketed
+host sync outside jit."""
+
+import time
+from functools import partial
+
+import jax
+import numpy as np
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def step(params, batch):
+    started = time.time()  # seeded violation: wallclock-in-jit
+    loss = np.asarray(batch)  # seeded violation: host-sync-in-jit
+    return params, (loss, started)
+
+
+def make_step(fn):
+    def step_fn(state):
+        return fn(state)
+
+    return jax.jit(step_fn)
+
+
+def train_loop(state):
+    metrics = state.pop()
+    jax.block_until_ready(metrics)  # seeded violation: unbracketed sync
+    return state

@@ -1,0 +1,28 @@
+// Fixture: classic AB/BA lock-order inversion plus a blocking write
+// while holding a mutex.
+#include <mutex>
+#include <unistd.h>
+
+namespace {
+
+std::mutex g_table_mu;
+std::mutex g_io_mu;
+
+void UpdateThenLog(int fd) {
+  std::lock_guard<std::mutex> a(g_table_mu);
+  std::lock_guard<std::mutex> b(g_io_mu);
+  write(fd, "x", 1);
+}
+
+void LogThenUpdate() {
+  std::lock_guard<std::mutex> b(g_io_mu);
+  std::lock_guard<std::mutex> a(g_table_mu);
+}
+
+}  // namespace
+
+int main() {
+  UpdateThenLog(1);
+  LogThenUpdate();
+  return 0;
+}

@@ -1,0 +1,23 @@
+"""Fixture: a dashboard renderer that hardcodes an unprefixed family."""
+
+
+def _render_prometheus(per_node):
+    fams = {}
+
+    def fam(name, kind, help_):
+        return fams.setdefault(name, {"kind": kind, "help": help_,
+                                      "samples": []})
+
+    for node in per_node:
+        f = fam("node_cpu_percent", "gauge", "CPU percent")  # unprefixed
+        f["samples"].append(node.get("cpu", 0.0))
+        for m in node.get("metrics", []):
+            name = m["name"]
+            if not name.startswith("ray_tpu_"):
+                name = "ray_tpu_" + name
+            fam(name, m["kind"], m.get("description") or "")
+    lines = []
+    for name, f in fams.items():
+        lines.append(f"# HELP {name} {f['help']}")
+        lines.append(f"# TYPE {name} {f['kind']}")
+    return "\n".join(lines)

@@ -2,65 +2,43 @@
 
 The registry (reference: the RAY_CONFIG X-macro table,
 src/ray/common/ray_config_def.h) is only useful if it can't drift — in
-EITHER direction: the completeness test greps the source tree (Python
-environ/os.getenv reads AND C++ getenv calls) and fails on any
-RTPU_*/RAY_TPU_* variable not in the table; the liveness test fails on
-any registered flag no source file actually reads, so the table can't
-accrete dead knobs.
+EITHER direction: every env read (Python environ/os.getenv AND C++
+getenv) must name a registered flag, and every registered flag must be
+read somewhere, so the table can't accrete dead knobs.  That lint now
+lives in the static-analysis suite (ray_tpu/_private/staticcheck/
+drift.py) so `rtpu check` and this test share one implementation; the
+two tests below are thin wrappers that invoke the pass.
 """
 
 import os
-import re
 import subprocess
 import sys
 
 from ray_tpu._private import flags
-
-_ROOT = os.path.join(os.path.dirname(__file__), "..", "ray_tpu")
-
-# Python: os.environ.get / .setdefault / [] / os.getenv
-_PY_READ = re.compile(
-    r"(?:environ(?:\.get\(|\.setdefault\(|\[)|os\.getenv\()"
-    r"\s*\"((?:RTPU|RAY_TPU)_[A-Z0-9_]+)\"")
-# C++: getenv("RTPU_...") in the native store/raylet/GCS sources
-_CC_READ = re.compile(r"getenv\(\s*\"((?:RTPU|RAY_TPU)_[A-Z0-9_]+)\"")
+from ray_tpu._private.staticcheck import drift
+from ray_tpu._private.staticcheck.common import repo_root
 
 
-def _sources(exts):
-    for dirpath, _, files in os.walk(_ROOT):
-        if "__pycache__" in dirpath:
-            continue
-        for f in files:
-            if f.endswith(exts):
-                path = os.path.join(dirpath, f)
-                yield path, open(path, errors="replace").read()
+def _flag_violations(rule):
+    return [v for v in drift.check(repo_root()) if v.rule == rule]
 
 
 def test_every_env_read_is_registered():
-    found = set()
-    for _, src in _sources((".py",)):
-        found |= set(_PY_READ.findall(src))
-    for _, src in _sources((".cc", ".h")):
-        found |= set(_CC_READ.findall(src))
-    unregistered = found - set(flags.FLAGS)
-    assert not unregistered, (
-        f"env vars read but not in the flag registry: {sorted(unregistered)}"
-        " — add them to _private/flags.py FLAGS")
+    found = _flag_violations("drift/flag-unregistered")
+    assert not found, (
+        "env vars read but not in the flag registry — add them to "
+        "_private/flags.py FLAGS:\n"
+        + "\n".join(v.format() for v in found))
 
 
 def test_every_registered_flag_is_read():
     """Reverse direction: a flag nobody reads is dead weight (or a typo'd
-    registration shadowing the real name).  A read is any quoted use of
-    the name outside the registry itself — flags.get("NAME"), an environ
-    access, or a C++ getenv."""
-    corpus = "\n".join(
-        src for path, src in _sources((".py", ".cc", ".h"))
-        if os.path.basename(path) != "flags.py")
-    unread = [name for name in flags.FLAGS
-              if f'"{name}"' not in corpus and f"'{name}'" not in corpus]
-    assert not unread, (
-        f"flags registered but never read anywhere: {sorted(unread)}"
-        " — remove them from _private/flags.py or wire them up")
+    registration shadowing the real name)."""
+    found = _flag_violations("drift/flag-dead")
+    assert not found, (
+        "flags registered but never read anywhere — remove them from "
+        "_private/flags.py or wire them up:\n"
+        + "\n".join(v.format() for v in found))
 
 
 def test_typed_reads(monkeypatch):

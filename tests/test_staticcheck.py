@@ -1,0 +1,138 @@
+"""The checker checks itself: each pass is proven by a seeded-violation
+fixture tree (tests/fixtures/staticcheck/*) that the pass must flag, the
+real tree must be clean modulo the reasoned allowlist, and the whole
+suite must stay jax-free and fast (it fronts `make test`)."""
+
+import os
+import subprocess
+import sys
+import time
+
+from ray_tpu._private import staticcheck
+from ray_tpu._private.staticcheck import drift, locks, metrics_lint, purity
+from ray_tpu._private.staticcheck.common import (
+    Allow,
+    Violation,
+    apply_allowlist,
+    repo_root,
+    validate_allowlist,
+)
+
+_FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "staticcheck")
+
+
+def _fixture(name):
+    return os.path.join(_FIXTURES, name)
+
+
+def _rules(violations):
+    return {v.rule for v in violations}
+
+
+# --- seeded-violation fixtures: each pass catches its plant ---------------
+
+def test_drift_catches_drifted_opcode_and_layout():
+    found = drift.check(_fixture("drifted"))
+    assert "drift/opcode" in _rules(found), found
+    assert "drift/layout" in _rules(found), found
+    opcode = next(v for v in found if v.rule == "drift/opcode")
+    assert opcode.path == "ray_tpu/native/shm_store.cc"
+    assert "OP_SEAL = 99" in opcode.message
+    assert opcode.line > 1  # points at the constexpr, not the file
+    layout = next(v for v in found if v.rule == "drift/layout")
+    assert "kReqLen = 29" in layout.message
+    # the undrifted constants stay silent
+    assert not any("OP_CREATE" in v.message for v in found)
+
+
+def test_locks_catches_order_inversion_and_blocking_write():
+    found = locks.check(_fixture("inversion"))
+    assert "locks/order-inversion" in _rules(found), found
+    inv = next(v for v in found if v.rule == "locks/order-inversion")
+    assert inv.path == "ray_tpu/native/inversion.cc"
+    assert "g_table_mu" in inv.message and "g_io_mu" in inv.message
+    blocking = [v for v in found if v.rule == "locks/blocking-under-mutex"]
+    assert blocking and "write()" in blocking[0].message
+
+
+def test_purity_catches_wallclock_and_syncs_in_jit():
+    found = purity.check(_fixture("impure"))
+    rules = _rules(found)
+    assert "purity/wallclock-in-jit" in rules, found
+    assert "purity/host-sync-in-jit" in rules, found
+    assert "purity/host-sync-unbracketed" in rules, found
+    wall = next(v for v in found if v.rule == "purity/wallclock-in-jit")
+    assert wall.path == "ray_tpu/train/step_fixture.py"
+    assert "time.time()" in wall.message
+
+
+def test_metrics_catches_unprefixed_renderer_family():
+    found = metrics_lint.check(_fixture("unprefixed_metric"))
+    assert "metrics/unprefixed-family" in _rules(found), found
+    v = next(v for v in found if v.rule == "metrics/unprefixed-family")
+    assert "node_cpu_percent" in v.message
+
+
+def test_each_fixture_needs_its_own_pass():
+    """The cross-product is silent: a fixture only trips the pass that
+    owns its rule family, so a finding proves that specific pass."""
+    assert not locks.check(_fixture("drifted"))
+    assert not drift.check(_fixture("inversion"))
+    assert not metrics_lint.check(_fixture("impure"))
+    assert not purity.check(_fixture("unprefixed_metric"))
+
+
+# --- the real tree ---------------------------------------------------------
+
+def test_real_tree_is_clean_modulo_allowlist():
+    report = staticcheck.run()
+    assert report.ok, "\n".join(v.format() for v in report.violations)
+    # suppressions exist (the reviewed findings) and none are stale
+    assert report.suppressed, "allowlist should be exercised by the tree"
+    assert not report.unused_allows, [
+        (a.rule, a.path) for a in report.unused_allows]
+
+
+def test_allowlist_entries_all_carry_reasons():
+    from ray_tpu._private.staticcheck.allowlist import ALLOWLIST
+
+    assert not validate_allowlist(ALLOWLIST)
+    for entry in ALLOWLIST:
+        assert len(entry.reason.strip()) > 20, (
+            f"{entry.rule} on {entry.path}: reason too thin to review")
+
+
+def test_allowlist_matching_and_reason_enforcement():
+    v = Violation("locks/blocking-under-mutex", "ray_tpu/native/x.cc", 7,
+                  "F: blocking call send() while holding mu")
+    hit = Allow("locks/*", "ray_tpu/native/*.cc", "send()", reason="why")
+    miss = Allow("drift/*", "*", "", reason="why")
+    report = apply_allowlist([v], [miss, hit])
+    assert not report.violations
+    assert report.suppressed == [(v, hit)]
+    assert report.unused_allows == [miss]
+    assert validate_allowlist([Allow("x", "y", "", reason="  ")])
+
+
+def test_check_is_fast_and_jax_free():
+    """`make check` fronts `make test`: it must not import jax and must
+    finish well inside the 10s budget."""
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import sys\n"
+         "from ray_tpu._private import staticcheck\n"
+         "report = staticcheck.run()\n"
+         "assert 'jax' not in sys.modules, 'staticcheck imported jax'\n"
+         "sys.exit(0 if report.ok else 1)"],
+        capture_output=True, text=True, cwd=repo_root(), timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert time.monotonic() - t0 < 10, "rtpu check exceeded the 10s budget"
+
+
+def test_cli_check_exits_zero_on_clean_tree():
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "check"],
+        capture_output=True, text=True, cwd=repo_root(), timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 violation(s)" in proc.stdout
