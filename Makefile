@@ -30,11 +30,17 @@ sanitize:
 
 # Static analysis (`rtpu check`): cross-language drift between the C++
 # daemons and their Python peers, lock-order / blocking-under-mutex
-# analysis, hot-path purity lint, metrics naming lint.  Stdlib-only, no
-# jax import, no cluster — ~1s, so it fronts the default test flow and
-# drift fails fast.
+# analysis, hot-path purity lint, metrics naming lint, sharding-layout
+# consistency (shard) and wire-protocol reachability (proto).
+# Stdlib-only, no jax import, no cluster — a few seconds, so it fronts
+# the default test flow and drift fails fast.
 check:
 	python -m ray_tpu._private.staticcheck
+
+# Just the two layout/protocol passes — the tight loop while editing
+# sharding rules or wire_constants (sub-second).
+check-fast:
+	python -m ray_tpu._private.staticcheck shard,proto
 
 test: check
 	python -m pytest tests/ -q
@@ -90,4 +96,4 @@ bench-serve:
 bench-scale:
 	JAX_PLATFORMS=cpu python -m ray_tpu._private.scale_bench
 
-.PHONY: sanitize sanitize-store check test obs-smoke bench-store bench-data bench-serve bench-scale
+.PHONY: sanitize sanitize-store check check-fast test obs-smoke bench-store bench-data bench-serve bench-scale

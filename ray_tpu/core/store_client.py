@@ -41,6 +41,21 @@ ID_LEN = _wc.OBJECT_ID_LEN
 _REQ = _wc.STORE_REQ
 _RESP = _wc.STORE_RESP
 
+# Readable names for daemon statuses in error messages: ST_ERR and
+# friends arrive as raw ints, and "status=6" in a raised error is
+# useless at 3am.
+_STATUS_NAMES = {
+    ST_OK: "ST_OK", ST_NOT_FOUND: "ST_NOT_FOUND", ST_EXISTS: "ST_EXISTS",
+    ST_OOM: "ST_OOM", ST_TIMEOUT: "ST_TIMEOUT",
+    ST_NOT_SEALED: "ST_NOT_SEALED", ST_ERR: "ST_ERR",
+    ST_EVICTED: "ST_EVICTED", ST_VIEW: "ST_VIEW",
+}
+
+
+def _status_name(status: int) -> str:
+    return _STATUS_NAMES.get(status, f"status {status}")
+
+
 _OP_CREATE, _OP_SEAL = _wc.OP_CREATE, _wc.OP_SEAL
 _OP_GET, _OP_RELEASE = _wc.OP_GET, _wc.OP_RELEASE
 _OP_DELETE, _OP_CONTAINS = _wc.OP_DELETE, _wc.OP_CONTAINS
@@ -528,13 +543,13 @@ class StoreClient:
         if status == ST_EXISTS:
             raise FileExistsError(f"object {oid.hex()} already exists")
         if status != ST_OK:
-            raise RuntimeError(f"create failed: status={status}")
+            raise RuntimeError(f"create failed: {_status_name(status)}")
         return memoryview(self._mm)[offset : offset + size]
 
     def seal(self, oid: bytes):
         status, _, _ = self._call(_OP_SEAL, oid)
         if status != ST_OK:
-            raise RuntimeError(f"seal failed: status={status}")
+            raise RuntimeError(f"seal failed: {_status_name(status)}")
 
     @staticmethod
     def _byte_parts(parts) -> list:
@@ -596,7 +611,7 @@ class StoreClient:
             status, _, _ = self._call_once(_OP_SEAL, oid)
             if status != ST_OK:
                 raise ConnectionError(
-                    f"store restarted mid-put (seal status={status})")
+                    f"store restarted mid-put (seal {_status_name(status)})")
             return ST_OK
 
         return self._with_retry(attempt, "put")
@@ -670,7 +685,7 @@ class StoreClient:
         if status == ST_EXISTS:
             raise FileExistsError(f"object {oid.hex()} already exists")
         if status != ST_OK:
-            raise RuntimeError(f"put failed: status={status}")
+            raise RuntimeError(f"put failed: {_status_name(status)}")
         try:
             m = _metrics()
             m["put_lat"].observe(time.perf_counter() - t0)
@@ -807,7 +822,7 @@ class StoreClient:
             m["get_bytes"].inc(size)
             return memoryview(self._mm)[inline : inline + size]
         if status != ST_OK:
-            raise RuntimeError(f"get failed: status={status}")
+            raise RuntimeError(f"get failed: {_status_name(status)}")
         if inline:
             m = _metrics()
             m["get_lat"].observe(time.perf_counter() - t0)
@@ -830,7 +845,7 @@ class StoreClient:
             raise ObjectEvictedError(
                 f"object {oid.hex()[:12]} was evicted from the store")
         if status != ST_OK:
-            raise RuntimeError(f"get failed: status={status}")
+            raise RuntimeError(f"get failed: {_status_name(status)}")
         m = _metrics()
         m["get_lat"].observe(time.perf_counter() - t0)
         m["get_bytes"].inc(size)
@@ -880,7 +895,7 @@ class StoreClient:
                 status, length, _ = _RESP.unpack(
                     self._recv_exact(sock, _RESP.size))
                 if status != ST_OK:
-                    raise RuntimeError(f"audit failed: status={status}")
+                    raise RuntimeError(f"audit failed: {_status_name(status)}")
                 payload = self._recv_exact(sock, length)
             except BaseException:
                 sock.close()

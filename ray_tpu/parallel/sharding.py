@@ -32,22 +32,41 @@ DEFAULT_RULES: dict[str, Union[str, tuple, None]] = {
     "expert_mlp": "tp",
     "stage": "pp",
     "norm": None,
+    "layers": None,        # stacked-layer scan dim: lax.scan carries it,
+                           # sharding it would split the scan carry
 }
+
+# Spec-entry spelling for intentional replication, alongside plain None.
+REPLICATED = "replicated"
 
 
 def logical_spec(*names: Optional[str]) -> tuple:
-    """A logical partition spec: tuple of logical axis names (None = repl)."""
+    """A logical partition spec: tuple of logical axis names (None or
+    ``"replicated"`` = replicated on purpose)."""
     return tuple(names)
 
 
 def to_partition_spec(logical: tuple, rules: Optional[dict] = None) -> P:
+    """Map a logical spec through a rules table to a ``PartitionSpec``.
+
+    An axis name absent from the rules raises: silently replicating a
+    typo'd name costs memory and comm without any error, which is the
+    worst possible failure mode for a layout knob.  Spell intentional
+    replication ``None`` or ``"replicated"`` in the spec, or add a
+    ``name: None`` rule.
+    """
     rules = DEFAULT_RULES if rules is None else rules
     axes = []
     for name in logical:
-        if name is None:
+        if name is None or name == REPLICATED:
             axes.append(None)
+        elif name in rules:
+            axes.append(rules[name])
         else:
-            axes.append(rules.get(name))
+            raise ValueError(
+                f"unknown logical axis {name!r}: not in the sharding rules "
+                f"(known: {sorted(rules)}). Use None or 'replicated' for "
+                "intentional replication, or add a rule for it.")
     return P(*axes)
 
 

@@ -827,11 +827,14 @@ def cmd_serve(args):
 
 def cmd_check(args):
     """Static analysis (`rtpu check`): cross-language drift, lock-order,
-    hot-path purity and metrics-naming passes.  No jax import, no
-    cluster — safe to run anywhere in well under ten seconds."""
+    hot-path purity, metrics-naming, sharding-layout and wire-protocol
+    passes.  No jax import, no cluster — safe to run anywhere in well
+    under ten seconds."""
     from ray_tpu._private import staticcheck
 
     forward = []
+    if args.passes_csv:
+        forward.append(args.passes_csv)
     if args.root:
         forward += ["--root", args.root]
     for name in args.passes or []:
@@ -985,10 +988,14 @@ def main(argv=None):
                     help="full routing snapshots as JSON")
     sp.set_defaults(fn=cmd_serve)
     sp = sub.add_parser("check")
+    sp.add_argument("passes_csv", nargs="?", default=None,
+                    metavar="PASSES",
+                    help="comma-separated passes (e.g. 'shard,proto')")
     sp.add_argument("--root", default=None,
                     help="tree to analyze (default: this repo)")
     sp.add_argument("--pass", dest="passes", action="append",
-                    choices=("drift", "locks", "purity", "metrics"),
+                    choices=("drift", "locks", "purity", "metrics",
+                             "shard", "proto"),
                     help="run only this pass (repeatable)")
     sp.add_argument("--no-allowlist", action="store_true",
                     help="show findings the allowlist suppresses")

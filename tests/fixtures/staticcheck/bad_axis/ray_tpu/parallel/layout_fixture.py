@@ -1,0 +1,5 @@
+"""Fixture: a PartitionSpec naming a mesh axis that doesn't exist."""
+
+from jax.sharding import PartitionSpec as P
+
+X_SPEC = P("dp", "tpu")  # "tpu" is a typo for "tp" — not in AXIS_ORDER

@@ -1,0 +1,5 @@
+"""Fixture model: uses only the "batch" logical axis."""
+
+from ray_tpu.parallel.sharding import logical_spec
+
+X_SPEC = logical_spec("batch")

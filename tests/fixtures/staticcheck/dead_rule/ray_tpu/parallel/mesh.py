@@ -1,0 +1,3 @@
+"""Fixture mesh: two axes only."""
+
+AXIS_ORDER = ("dp", "tp")

@@ -12,6 +12,21 @@ constexpr size_t kIdLen = 20;
 constexpr size_t kReqLen = 1 + kIdLen + 8;  // dropped an arg word
 constexpr size_t kRespLen = 1 + 8 + 8;
 
+// Fully wired dispatch (every opcode has a case, every status a
+// producer) so this tree trips ONLY the drift pass, not protocheck.
+uint8_t Dispatch(uint8_t op) {
+  uint8_t st = ST_OK;
+  switch (op) {
+    case OP_CREATE:
+    case OP_SEAL:
+      break;
+    case OP_GET:
+      st = ST_NOT_FOUND;
+      break;
+  }
+  return st;
+}
+
 }  // namespace
 
-int main() { return OP_CREATE + kReqLen + kRespLen + ST_OK; }
+int main() { return Dispatch(OP_CREATE) + kReqLen + kRespLen; }

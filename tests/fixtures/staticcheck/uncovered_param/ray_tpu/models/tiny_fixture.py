@@ -1,0 +1,7 @@
+"""Fixture model: one spec uses an axis the rules don't know, so the
+parameter silently maps to fully-replicated."""
+
+from ray_tpu.parallel.sharding import logical_spec
+
+X_SPEC = logical_spec("batch")
+W_SPEC = logical_spec("widgets", None)  # "widgets" unknown to the rules

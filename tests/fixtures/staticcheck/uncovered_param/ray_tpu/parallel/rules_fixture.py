@@ -1,0 +1,5 @@
+"""Fixture rules: covers "batch" only."""
+
+FIXTURE_RULES = {
+    "batch": "dp",
+}

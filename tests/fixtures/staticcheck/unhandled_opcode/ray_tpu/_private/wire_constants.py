@@ -1,0 +1,8 @@
+"""Fixture anchor: OP_FROB is declared but never dispatched or called,
+and ST_WEIRD is never produced or handled."""
+
+OP_PING = 1
+OP_FROB = 2
+
+ST_FINE = 0
+ST_WEIRD = 7

@@ -1,0 +1,10 @@
+"""Fixture client: sends OP_PING and handles ST_FINE; nothing ever
+sends OP_FROB or handles ST_WEIRD."""
+
+from ray_tpu._private.wire_constants import OP_PING, ST_FINE
+
+
+def ping(sock) -> bool:
+    sock.send(bytes([OP_PING]))
+    status = sock.recv(1)[0]
+    return status == ST_FINE

@@ -1,0 +1,21 @@
+// Fixture daemon: dispatches OP_PING only; OP_FROB falls on the floor
+// and ST_WEIRD has no producer.
+#include <cstdint>
+
+namespace {
+
+constexpr uint8_t OP_PING = 1, OP_FROB = 2;
+constexpr uint8_t ST_FINE = 0, ST_WEIRD = 7;
+
+uint8_t Dispatch(uint8_t op) {
+  uint8_t st = ST_FINE;
+  switch (op) {
+    case OP_PING:
+      break;
+  }
+  return st;
+}
+
+}  // namespace
+
+int main() { return Dispatch(OP_PING); }

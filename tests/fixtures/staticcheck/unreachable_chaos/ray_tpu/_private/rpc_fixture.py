@@ -1,0 +1,14 @@
+"""Fixture: the chaos flag disables the lane it claims to test instead
+of injecting failure into it."""
+
+import os
+
+_native_failed = False
+
+
+def native_lane():
+    global _native_failed
+    if os.environ.get("RTPU_TESTING_RPC_FAILURE"):
+        _native_failed = True
+        return None
+    return object()

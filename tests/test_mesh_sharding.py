@@ -47,8 +47,18 @@ def test_logical_to_partition_spec():
     assert to_partition_spec(logical_spec(None, "heads")) == P(None, "tp")
 
 
-def test_unknown_logical_name_replicates():
-    assert to_partition_spec(logical_spec("nonexistent")) == P(None)
+def test_unknown_logical_name_raises():
+    """A typo'd logical axis must fail loudly: silently replicating it
+    (the old rules.get behavior) costs memory without any error."""
+    with pytest.raises(ValueError, match="nonexistent"):
+        to_partition_spec(logical_spec("nonexistent"))
+
+
+def test_intentional_replication_spellings():
+    assert to_partition_spec(logical_spec(None, "replicated")) == P(None,
+                                                                    None)
+    # a `name: None` rule is the third spelling (e.g. "layers")
+    assert to_partition_spec(logical_spec("layers")) == P(None)
 
 
 def test_custom_rules_override():

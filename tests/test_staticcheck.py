@@ -9,7 +9,14 @@ import sys
 import time
 
 from ray_tpu._private import staticcheck
-from ray_tpu._private.staticcheck import drift, locks, metrics_lint, purity
+from ray_tpu._private.staticcheck import (
+    drift,
+    locks,
+    metrics_lint,
+    protocheck,
+    purity,
+    shardcheck,
+)
 from ray_tpu._private.staticcheck.common import (
     Allow,
     Violation,
@@ -73,13 +80,86 @@ def test_metrics_catches_unprefixed_renderer_family():
     assert "node_cpu_percent" in v.message
 
 
+def test_shard_catches_unknown_mesh_axis():
+    found = shardcheck.check(_fixture("bad_axis"))
+    assert _rules(found) == {"shard/unknown-mesh-axis"}, found
+    v = found[0]
+    assert v.path == "ray_tpu/parallel/layout_fixture.py"
+    assert "'tpu'" in v.message and "AXIS_ORDER" in v.message
+
+
+def test_shard_catches_dead_rule():
+    found = shardcheck.check(_fixture("dead_rule"))
+    assert _rules(found) == {"shard/dead-logical-axis"}, found
+    v = found[0]
+    assert v.path == "ray_tpu/parallel/rules_fixture.py"
+    assert "'heads'" in v.message and "FIXTURE_RULES" in v.message
+    # the used rule stays silent
+    assert not any("'batch'" in x.message for x in found)
+
+
+def test_shard_catches_uncovered_param():
+    found = shardcheck.check(_fixture("uncovered_param"))
+    rules = _rules(found)
+    assert "shard/unknown-logical-axis" in rules, found
+    assert "shard/uncovered-param" in rules, found
+    assert all(v.path == "ray_tpu/models/tiny_fixture.py" for v in found)
+    uncovered = next(v for v in found if v.rule == "shard/uncovered-param")
+    assert "'widgets'" in uncovered.message
+    assert "FULLY replicated" in uncovered.message
+
+
+def test_proto_catches_unhandled_opcode_and_status():
+    found = protocheck.check(_fixture("unhandled_opcode"))
+    rules = _rules(found)
+    assert rules == {"proto/opcode-undispatched", "proto/opcode-uncalled",
+                     "proto/status-unproduced", "proto/status-unhandled"}, \
+        found
+    # the wired-up names stay silent
+    assert not any("OP_PING" in v.message or "ST_FINE" in v.message
+                   for v in found)
+    assert all("OP_FROB" in v.message or "ST_WEIRD" in v.message
+               for v in found)
+    assert all(v.path == "ray_tpu/_private/wire_constants.py"
+               for v in found)
+
+
+def test_proto_catches_unreachable_chaos_flag():
+    found = protocheck.check(_fixture("unreachable_chaos"))
+    rules = _rules(found)
+    assert "proto/chaos-lane-off" in rules, found
+    off = next(v for v in found if v.rule == "proto/chaos-lane-off")
+    assert off.path == "ray_tpu/_private/rpc_fixture.py"
+    assert "RTPU_TESTING_RPC_FAILURE" in off.message
+    assert "OFF" in off.message
+
+
+_ALL_FIXTURES = ("drifted", "inversion", "impure", "unprefixed_metric",
+                 "bad_axis", "dead_rule", "uncovered_param",
+                 "unhandled_opcode", "unreachable_chaos")
+_OWNER = {
+    "drifted": drift, "inversion": locks, "impure": purity,
+    "unprefixed_metric": metrics_lint,
+    "bad_axis": shardcheck, "dead_rule": shardcheck,
+    "uncovered_param": shardcheck,
+    "unhandled_opcode": protocheck, "unreachable_chaos": protocheck,
+}
+
+
 def test_each_fixture_needs_its_own_pass():
     """The cross-product is silent: a fixture only trips the pass that
     owns its rule family, so a finding proves that specific pass."""
-    assert not locks.check(_fixture("drifted"))
-    assert not drift.check(_fixture("inversion"))
-    assert not metrics_lint.check(_fixture("impure"))
-    assert not purity.check(_fixture("unprefixed_metric"))
+    for name in _ALL_FIXTURES:
+        owner = _OWNER[name]
+        for mod in (drift, locks, purity, metrics_lint, shardcheck,
+                    protocheck):
+            found = mod.check(_fixture(name))
+            if mod is owner:
+                assert found, f"{name} must trip {mod.__name__}"
+            else:
+                assert not found, (
+                    f"{name} leaked into {mod.__name__}: "
+                    + "\n".join(v.format() for v in found))
 
 
 # --- the real tree ---------------------------------------------------------
@@ -136,3 +216,46 @@ def test_cli_check_exits_zero_on_clean_tree():
         capture_output=True, text=True, cwd=repo_root(), timeout=60)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "0 violation(s)" in proc.stdout
+    assert "6 pass(es)" in proc.stdout
+
+
+def test_run_registers_six_passes():
+    assert set(staticcheck.PASSES) == {
+        "drift", "locks", "purity", "metrics", "shard", "proto"}
+
+
+def test_cli_pass_selection_csv():
+    """`rtpu check shard,proto` runs exactly the named passes."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "check",
+         "shard,proto"],
+        capture_output=True, text=True, cwd=repo_root(), timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "2 pass(es)" in proc.stdout
+    bad = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "check", "nope"],
+        capture_output=True, text=True, cwd=repo_root(), timeout=60)
+    assert bad.returncode != 0
+    assert "unknown pass" in bad.stderr
+
+
+def test_cli_json_findings_shape():
+    """--json emits machine-readable findings the layout search and CI
+    can consume: pass, file, line, message, allowlisted (+reason)."""
+    import json
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "check",
+         "shard,proto", "--json"],
+        capture_output=True, text=True, cwd=repo_root(), timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is True
+    assert set(doc["passes"]) == {"shard", "proto"}
+    assert doc["findings"], "the reviewed shard/proto findings must appear"
+    for f in doc["findings"]:
+        assert set(f) >= {"pass", "rule", "file", "line", "message",
+                          "allowlisted"}
+        assert f["pass"] in ("shard", "proto")
+        assert f["allowlisted"] is True  # tree is clean modulo allowlist
+        assert len(f["reason"]) > 20
