@@ -217,3 +217,16 @@ def decode_step_greedy(params, tokens, cache_k, cache_v, page_tables,
         params, tokens, cache_k, cache_v, page_tables, positions, active,
         cfg)
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache_k, cache_v
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def copy_page(cache_k, cache_v, src, dst):
+    """Copy-on-write boundary page: duplicate one KV page across all
+    layers (a [n_layers, page_size, n_kv, head_dim] gather/scatter, not a
+    whole-cache copy thanks to donation).  The whole page is copied even
+    when only the first `cow_len` slots are valid — the suffix prefill /
+    decode overwrites every slot past the divergence point before any
+    attention reads it, the same invariant that makes null-page garbage
+    safe."""
+    return (cache_k.at[:, dst].set(cache_k[:, src]),
+            cache_v.at[:, dst].set(cache_v[:, src]))

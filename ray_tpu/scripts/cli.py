@@ -807,7 +807,8 @@ def cmd_serve(args):
               "snapshots once an app is deployed)")
         return
     print(f"{'APP':12s} {'DEPLOYMENT':24s} {'POLICY':13s} {'REPLICAS':>8s} "
-          f"{'QUEUE':>5s} {'HIT%':>5s} {'PREEMPT':>7s} {'EVICT':>6s}")
+          f"{'QUEUE':>5s} {'HIT%':>5s} {'PREEMPT':>7s} {'EVICT':>6s} "
+          f"{'SAVED':>8s} {'COW':>5s}")
     for d in docs:
         reps = d.get("replicas", {}) or {}
         queue = sum(r.get("queue_len", 0) or 0 for r in reps.values())
@@ -816,13 +817,15 @@ def cmd_serve(args):
                  if e.get("prefix_hit_rate") is not None]
         preempt = sum(e.get("preempted") or 0 for e in engines)
         evict = sum(e.get("page_evictions") or 0 for e in engines)
+        saved = sum(e.get("prefill_tokens_saved") or 0 for e in engines)
+        cow = sum(e.get("cow_copies") or 0 for e in engines)
         print(f"{d.get('app', ''):12s} {d.get('deployment', ''):24s} "
               f"{d.get('policy', 'pow2'):13s} "
               f"{d.get('running_replicas', 0)}/"
               f"{d.get('target_replicas', 0):<6} "
               f"{queue:5d} "
               f"{('%.0f' % (max(rates) * 100)) if rates else '-':>5s} "
-              f"{preempt:7d} {evict:6d}")
+              f"{preempt:7d} {evict:6d} {saved:8d} {cow:5d}")
 
 
 def cmd_check(args):

@@ -94,7 +94,11 @@ def _engine_summary(engine: Optional[dict]) -> Optional[dict]:
             "waiting": engine.get("waiting"),
             "preempted": engine.get("preempted"),
             "page_evictions": engine.get("page_evictions"),
-            "prefix_hit_rate": pc.get("hit_rate")}
+            "prefix_hit_rate": pc.get("hit_rate"),
+            "prefill_tokens_saved": engine.get("prefill_tokens_saved"),
+            "cow_copies": engine.get("cow_copies"),
+            "evictions_cold_family": pc.get("evictions_cold_family"),
+            "evictions_hot_root_forced": pc.get("evictions_hot_root_forced")}
 
 
 def _actor_is_dead(handle) -> bool:

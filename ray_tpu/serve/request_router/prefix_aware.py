@@ -100,6 +100,11 @@ class PrefixTree:
         for node in self._nodes.values():
             node.pop(rid, None)
 
+    def count_for(self, rid: bytes) -> int:
+        """Tree nodes homed on `rid` — a proxy for how much resident
+        prefix working set has been assigned to that replica."""
+        return sum(1 for node in self._nodes.values() if rid in node)
+
 
 class PrefixAwareRouter(RequestRouter):
     policy = "prefix_aware"
@@ -118,14 +123,38 @@ class PrefixAwareRouter(RequestRouter):
         for rid in gone:
             self.tree.forget(rid)
 
-    def _overloaded(self, rid: bytes, reps: List) -> bool:
-        # absolute gate at light load, relative (2x the least-loaded)
-        # under saturation: when every replica is deep in queue, small
-        # load gaps are scheduling noise, and abandoning a warm home
-        # costs more than the gap
-        loads = [self.load(r.actor_id) for r in reps]
-        lo = min(loads)
-        return self.load(rid) > max(lo + self.imbalance, lo * 2.0)
+    def _overloaded(self, rid: bytes, reps: List) -> Optional[str]:
+        """None when `rid` is an acceptable affinity home, else why not.
+
+        "stale": rid's stats sample has aged out (RTPU_ROUTER_STALE_S)
+        while some OTHER replica reports fresh ones — a silently-deep
+        queue counts as loaded, because load() falls back to this
+        process's own in-flight count and admitting onto a queue whose
+        depth we can't see is exactly how the mid-ladder TTFT cliff
+        formed.  When NO replica has fresh stats (controller warmup,
+        single-process tests) the gate stays open: local counts are the
+        only signal anywhere and they are already in load().
+
+        "imbalanced": the home is loaded more than RTPU_ROUTER_IMBALANCE
+        past the least-loaded replica.  The shed is load-only — see
+        choose(): it spills the REQUEST without migrating the prefix
+        home, so a transient queue spike costs one cold prefill instead
+        of rebuilding the family's pages on the spill replica.
+        """
+        now = time.monotonic()
+        with self._lock:
+            st = self._stats.get(rid)
+            fresh_elsewhere = any(
+                r.actor_id != rid
+                and (s := self._stats.get(r.actor_id)) is not None
+                and now - s.ts <= self._stale_s
+                for r in reps)
+        if fresh_elsewhere and (st is None or now - st.ts > self._stale_s):
+            return "stale"
+        lo = min(self.load(r.actor_id) for r in reps)
+        if self.load(rid) > lo + self.imbalance:
+            return "imbalanced"
+        return None
 
     def choose(self, hint: Optional[str] = None):
         reps = self._require_replicas()
@@ -142,7 +171,7 @@ class PrefixAwareRouter(RequestRouter):
             for r in reps:
                 st = self.stats_for(r.actor_id)
                 if st is not None and hint in st.digests:
-                    if not self._overloaded(r.actor_id, reps):
+                    if self._overloaded(r.actor_id, reps) is None:
                         self.tree.insert(hint, r.actor_id)
                         self._record("digest_hit", reps)
                         return r
@@ -150,18 +179,33 @@ class PrefixAwareRouter(RequestRouter):
             # 2. the approximate prefix tree
             rid, depth = self.tree.match(hint, set(by_id))
             if rid is not None:
-                if not self._overloaded(rid, reps):
+                reason = self._overloaded(rid, reps)
+                if reason is None:
                     self.tree.insert(hint, rid)
                     self._record("prefix_hit", reps)
                     return by_id[rid]
-                outcome = "fallback_imbalanced"
+                outcome = f"fallback_{reason}"
             else:
                 outcome = "prefix_miss"
         # pow-2 fallback; remember where the prefix landed so the NEXT
-        # request sharing it follows (this is how homes form)
+        # request sharing it follows (this is how homes form).  EXCEPT on
+        # an imbalance shed: a transient queue spike spills requests to
+        # the other replica but must NOT migrate the prefix home —
+        # re-homing on every spike rebuilds the family's pages on the
+        # spill replica and evicts part of its resident set, shredding
+        # the very locality the policy exists to keep.  ("stale" still
+        # re-homes: a queue we can't observe may be arbitrarily deep.)
         a, b = random.sample(reps, 2)
         pick = a if self.load(a.actor_id) <= self.load(b.actor_id) else b
-        if hint:
+        if outcome == "prefix_miss":
+            # an UNHOMED prefix is new working set, not just one request:
+            # place it on the replica with the smallest homed-prefix
+            # footprint (tree-node count), load-tiebroken.  First-touch
+            # pow-2 homing splits prefix families ~binomially, and the
+            # heavy half thrashes its page pool forever after.
+            pick = min(reps, key=lambda r: (
+                self.tree.count_for(r.actor_id), self.load(r.actor_id)))
+        if hint and outcome != "fallback_imbalanced":
             self.tree.insert(hint, pick.actor_id)
         self._record(outcome, reps)
         return pick

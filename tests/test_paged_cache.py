@@ -1,0 +1,232 @@
+"""Paged-cache unit tests: family-aware eviction, COW partial-block
+matching, the digest-advertisement cap, and the allocator page-state
+invariant under a randomized admit/decode/preempt/evict storm
+(RTPU_DEBUG_ALLOCATOR asserts it after every op).
+
+Pure host-side structures — no jax, no engine — so these run in
+milliseconds and pin the eviction-policy semantics the serving bench
+depends on.
+"""
+
+import random
+
+import pytest
+
+from ray_tpu.llm.paged_cache import PageAllocator, PrefixCache
+
+
+def _insert_chain(alloc, cache, tokens):
+    """Simulate a finished sequence: allocate, register, release — its
+    full pages end CACHED-RESIDENT.  Returns the pages."""
+    n_pages = len(tokens) // cache.page_size
+    pages = alloc.allocate(n_pages)
+    alloc.mark_cached(cache.insert(tokens, pages))
+    alloc.free(pages)
+    return pages
+
+
+@pytest.fixture(autouse=True)
+def _debug_allocator(monkeypatch):
+    monkeypatch.setenv("RTPU_DEBUG_ALLOCATOR", "1")
+
+
+# ------------------------------------------------- family-aware eviction
+
+
+def test_evicts_cold_family_before_hot():
+    alloc = PageAllocator(32)
+    cache = PrefixCache(4)
+    hot = list(range(1, 13))       # 3 blocks
+    cold = list(range(50, 62))     # 3 blocks, different family
+    hot_pages = _insert_chain(alloc, cache, hot)
+    cold_pages = _insert_chain(alloc, cache, cold)
+    # heat the hot family: match() records family reuse
+    cache.match(hot + [99])
+    # ALL of the cold family drains before any hot block goes
+    for _ in range(3):
+        page, klass = cache.evict_one(alloc.refcount)
+        assert klass == "cold_family"
+        assert page in cold_pages
+        alloc.reclaim(page)
+    page, _ = cache.evict_one(alloc.refcount)
+    assert page in hot_pages
+
+
+def test_eviction_is_leaf_first_within_a_family():
+    alloc = PageAllocator(32)
+    cache = PrefixCache(4)
+    pages = _insert_chain(alloc, cache, list(range(1, 13)))  # one chain
+    # the chain must be cut from the tip: block 2, then 1, then the root —
+    # never a block whose child is still resident
+    for expect in reversed(pages):
+        page, klass = cache.evict_one(alloc.refcount)
+        assert (page, klass) == (expect, "cold_family")
+        alloc.reclaim(page)
+    assert cache.evict_one(alloc.refcount) is None
+
+
+def test_hot_root_forced_when_leaves_are_pinned():
+    alloc = PageAllocator(32)
+    cache = PrefixCache(4)
+    pages = _insert_chain(alloc, cache, list(range(1, 13)))
+    # a live sequence pins the leaf (refcount > 0): leaf-first finds no
+    # candidate, so the chain is cut at an interior block and the
+    # eviction is classified as forced
+    alloc.retain([pages[-1]])
+    page, klass = cache.evict_one(alloc.refcount)
+    assert klass == "hot_root_forced"
+    assert page in pages[:-1]
+    alloc.reclaim(page)
+    st = cache.stats()
+    assert st["evictions_hot_root_forced"] == 1
+    alloc.free([pages[-1]])
+
+
+def test_never_hit_family_is_coldest():
+    alloc = PageAllocator(32)
+    cache = PrefixCache(4)
+    a = _insert_chain(alloc, cache, list(range(1, 9)))
+    cache.match(list(range(1, 9)) + [99])  # family A has one hit
+    b = _insert_chain(alloc, cache, list(range(60, 68)))  # never hit
+    # B was inserted LAST (warmer in pure LRU terms) but has never been
+    # hit — family heat must rank it colder than A
+    page, _ = cache.evict_one(alloc.refcount)
+    assert page in b
+    alloc.reclaim(page)
+    del a
+
+
+def test_junk_tails_drain_before_any_family_spine():
+    alloc = PageAllocator(32)
+    cache = PrefixCache(4)
+    a_base = list(range(1, 9))
+    b_base = list(range(51, 59))
+    a1 = _insert_chain(alloc, cache, a_base + [11, 12, 13, 14])
+    a2 = _insert_chain(alloc, cache, a_base + [21, 22, 23, 24])
+    b1 = _insert_chain(alloc, cache, b_base + [61, 62, 63, 64])
+    b2 = _insert_chain(alloc, cache, b_base + [71, 72, 73, 74])
+    cache.match(a_base + [99])  # family A is hot, B never hit
+    junk = {a1[2], a2[2], b1[2], b2[2]}
+    # all four never-reused tails drain first — B's (coldest) before
+    # A's — and neither family's shared spine goes while junk remains
+    got = []
+    for _ in range(4):
+        page, klass = cache.evict_one(alloc.refcount)
+        assert klass == "cold_family"
+        got.append(page)
+        alloc.reclaim(page)
+    assert set(got) == junk
+    assert set(got[:2]) == {b1[2], b2[2]}
+    # only now is a spine block cut, from the coldest family (B)
+    page, _ = cache.evict_one(alloc.refcount)
+    assert page == b1[1]
+    alloc.reclaim(page)
+
+
+# ------------------------------------------------------- COW boundary
+
+
+def test_match_cow_finds_partial_block():
+    alloc = PageAllocator(32)
+    cache = PrefixCache(4)
+    toks = list(range(1, 13))
+    pages = _insert_chain(alloc, cache, toks)
+    # diverge INSIDE block 2 after sharing its first 2 tokens
+    pages_m, src, m = cache.match_cow(toks[:8] + [9, 10, 77, 78, 79])
+    assert pages_m == pages[:2]
+    assert src == pages[2]
+    assert m == 2
+    assert cache.stats()["cow_hits"] == 1
+
+
+def test_match_cow_leaves_one_suffix_token():
+    alloc = PageAllocator(32)
+    cache = PrefixCache(4)
+    toks = list(range(1, 13))
+    _insert_chain(alloc, cache, toks)
+    # prompt identical to a cached chain: the boundary share is capped so
+    # at least one token remains to prefill (it seeds decode's logits)
+    pages_m, src, m = cache.match_cow(toks)
+    assert len(pages_m) == 2
+    assert src is not None and m == 3  # 3 of block 2's 4 tokens
+
+
+def test_peek_does_not_refresh_lru():
+    alloc = PageAllocator(32)
+    cache = PrefixCache(4)
+    a = _insert_chain(alloc, cache, list(range(1, 9)))
+    b = _insert_chain(alloc, cache, list(range(60, 68)))
+    before = cache.digests(limit=64)
+    got = cache.peek_match_tokens(list(range(1, 9)) + [99])
+    assert got == 8  # 1 full block + 3 boundary tokens... see below
+    assert cache.digests(limit=64) == before  # no reordering
+    del a, b
+
+
+def test_peek_match_tokens_counts_partial():
+    alloc = PageAllocator(32)
+    cache = PrefixCache(4)
+    _insert_chain(alloc, cache, list(range(1, 13)))
+    # 2 full blocks + 2 boundary tokens, no LRU/heat side effects
+    n = cache.peek_match_tokens([1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 77, 78])
+    assert n == 10
+    assert cache.stats()["cow_hits"] == 0
+
+
+# ------------------------------------------------- digest advertisement
+
+
+def test_digest_cap_from_flag(monkeypatch):
+    monkeypatch.setenv("RTPU_PREFIX_DIGESTS", "2")
+    alloc = PageAllocator(64)
+    cache = PrefixCache(4)
+    _insert_chain(alloc, cache, list(range(1, 25)))  # 6 blocks
+    assert cache.digest_limit == 2
+    assert len(cache.digests()) == 2
+    assert len(cache.digests(limit=64)) == 6  # explicit override wins
+    assert cache.stats()["digest_limit"] == 2
+
+
+# --------------------------------------------- allocator invariant storm
+
+
+def test_allocator_invariant_storm():
+    """Randomized admit/finish/preempt/hit/evict storm with the debug
+    partition invariant asserted inside EVERY allocator op: every page is
+    exactly one of {free, refcounted, cached-resident} at all times, and
+    a full drain returns the pool to pristine — the refcount-leak class
+    ordinary tests can't see."""
+    rng = random.Random(7)
+    alloc = PageAllocator(32)
+    cache = PrefixCache(4)
+    live = []  # page lists held by simulated running sequences
+    for _ in range(3000):
+        op = rng.randrange(5)
+        if op == 0 and alloc.num_free() >= 3:  # admit fresh
+            live.append(alloc.allocate(rng.randrange(1, 4)))
+        elif op == 1 and live:  # finish: register full pages, release
+            pages = live.pop(rng.randrange(len(live)))
+            toks = [rng.randrange(6) for _ in range(len(pages) * 4)]
+            alloc.mark_cached(cache.insert(toks, pages))
+            alloc.free(pages)
+        elif op == 2 and live:  # abort/preempt without caching
+            alloc.free(live.pop(rng.randrange(len(live))))
+        elif op == 3:  # admission prefix hit: pin matched pages
+            toks = [rng.randrange(6) for _ in range(13)]
+            matched = cache.match(toks)
+            if matched:
+                alloc.retain(matched)
+                live.append(matched)
+        else:  # pool pressure: evict one cached block
+            hit = cache.evict_one(alloc.refcount)
+            if hit is not None:
+                alloc.reclaim(hit[0])
+    for pages in live:
+        alloc.free(pages)
+    while True:
+        hit = cache.evict_one(alloc.refcount)
+        if hit is None:
+            break
+        alloc.reclaim(hit[0])
+    assert alloc.num_free() == 31  # every page home again (0 is null)
+    assert alloc.num_resident() == 0

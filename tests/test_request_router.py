@@ -147,11 +147,26 @@ def test_imbalance_falls_back_to_pow2():
         router.on_send(home.actor_id)
     assert router.choose(hint) is other
     assert router._decisions["fallback_imbalanced"] >= 1
-    # the fallback re-homed the prefix: once load drains, traffic stays
-    # on the new home rather than bouncing back
+    # the shed did NOT migrate the prefix home: a transient spike spills
+    # requests but the family's pages live on `home`, and once the spike
+    # drains traffic returns to them instead of rebuilding on `other`
     for _ in range(6):
         router.on_done(home.actor_id)
-    assert router.choose(hint) is other
+    assert router.choose(hint) is home
+
+
+def test_new_prefixes_home_to_smallest_footprint():
+    """First-touch homing balances the resident working set: unhomed
+    prefixes go to the replica with the fewest homed tree nodes, so N
+    prefix families split N/2-N/2 instead of binomially."""
+    random.seed(4)
+    r1, r2 = FakeReplica(b"r1"), FakeReplica(b"r2")
+    router = _aware([r1, r2])
+    homes = {b"r1": 0, b"r2": 0}
+    for i in range(10):
+        rep = router.choose(f"family-{i:02d}:" + "z" * 48)
+        homes[rep.actor_id] += 1
+    assert homes[b"r1"] == homes[b"r2"] == 5
 
 
 def test_digest_hit_routes_to_page_holder():
@@ -204,6 +219,50 @@ def test_load_is_max_of_local_and_reported():
     for _ in range(4):
         router.on_done(r1.actor_id)
     assert router.load(r1.actor_id) == 2  # report dominates
+
+
+def test_stale_home_stats_count_as_loaded():
+    """Overload-gate boundary (the mid-rung TTFT cliff): when the home
+    replica's stats sample ages out while ANOTHER replica reports fresh
+    ones, the gate must treat the silent replica as loaded — its queue
+    depth is exactly what we can no longer see."""
+    random.seed(6)
+    r1, r2 = FakeReplica(b"r1"), FakeReplica(b"r2")
+    router = _aware([r1, r2])
+    hint = "stale-gate:" + "s" * 64
+    home = router.choose(hint)
+    other = r2 if home is r1 else r1
+    # both fresh: affinity holds
+    router.update_stats({
+        home.actor_id: {"queue_len": 0, "age_s": 0.0},
+        other.actor_id: {"queue_len": 0, "age_s": 0.0}})
+    assert router.choose(hint) is home
+    assert router._overloaded(home.actor_id, [r1, r2]) is None
+    # the home's sample ages past RTPU_ROUTER_STALE_S, the other stays
+    # fresh: the affinity match is abandoned (and pow-2 sees the home's
+    # one in-flight request, so the re-home is deterministic)
+    router.update_stats({
+        home.actor_id: {"queue_len": 0, "age_s": 999.0},
+        other.actor_id: {"queue_len": 0, "age_s": 0.0}})
+    assert router._overloaded(home.actor_id, [r1, r2]) == "stale"
+    router.on_send(home.actor_id)
+    assert router.choose(hint) is other
+    assert router._decisions["fallback_stale"] >= 1
+
+
+def test_stale_gate_stays_open_without_any_fresh_stats():
+    """When NO replica has fresh stats (controller warmup, or a handle
+    that never receives the piggyback) the stale gate must NOT trip —
+    local in-flight counts are the only signal and they already feed
+    load().  Regression guard for single-process routing."""
+    random.seed(7)
+    r1, r2 = FakeReplica(b"r1"), FakeReplica(b"r2")
+    router = _aware([r1, r2])
+    hint = "no-stats:" + "n" * 64
+    home = router.choose(hint)
+    assert router._overloaded(home.actor_id, [r1, r2]) is None
+    for _ in range(10):
+        assert router.choose(hint) is home
 
 
 # ------------------------------------------- registry / handle agreement
@@ -310,3 +369,66 @@ def test_prefix_aware_beats_pow2_hit_rate(tiny_model):
     # lookups into warm-page hits than blind load balancing
     assert aware > pow2, (aware, pow2)
     assert aware >= 0.5, aware  # sticky homes make most prefixes warm
+
+
+# ------------------------------------- cache/COW byte-identical decode
+
+
+def _drain(req):
+    out = []
+    while True:
+        item = req.out_queue.get(timeout=300)
+        if item is None:
+            return out
+        if isinstance(item, Exception):
+            raise item
+        out.append(item)
+
+
+def _family_decode(tiny_model, monkeypatch, cache_on):
+    """Greedy-decode a family of prefix-sharing prompts twice: first
+    sequentially (full-page hits + COW boundary copies), then
+    concurrently against a pool too small for all of them (forced
+    preemption + resume).  Returns (sequential outputs, concurrent
+    outputs, engine stats)."""
+    monkeypatch.setenv("RTPU_PREFIX_CACHE", "1" if cache_on else "0")
+    monkeypatch.setenv("RTPU_DEBUG_ALLOCATOR", "1")
+    from ray_tpu.llm.engine import EngineConfig, LLMEngine, SamplingParams
+
+    params, cfg = tiny_model
+    eng = LLMEngine(params, cfg, EngineConfig(
+        max_slots=4, num_pages=24, page_size=8, max_seq_len=128,
+        prefill_buckets=(8, 16, 32, 64)))
+    fam = [3, 1, 4, 1, 5] * 4  # 20 shared tokens: 2 full pages + 4 in a
+    #                            partial boundary block (the COW case)
+    prompts = [fam + [20 + i, 30 + i, 40 + i] for i in range(6)]
+    try:
+        seq = [eng.generate(p, SamplingParams(max_tokens=8))
+               for p in prompts]
+        # 4 concurrent slots x 8 pages each (2 of them shared family
+        # pages) vs 23 allocatable: decode growth must preempt and
+        # resume mid-stream
+        reqs = [eng.submit(p, SamplingParams(max_tokens=40))
+                for p in prompts]
+        conc = [_drain(r) for r in reqs]
+        return seq, conc, eng.stats()
+    finally:
+        eng.stop()
+
+
+def test_cache_cow_decode_byte_identical(tiny_model, monkeypatch):
+    """Prefix cache + COW + family eviction + preemption resume must be
+    invisible in the output stream: greedy decode with the cache on is
+    byte-identical, token for token, to decode with the cache off —
+    including sequences resumed after a forced preemption."""
+    on_seq, on_conc, st = _family_decode(tiny_model, monkeypatch, True)
+    off_seq, off_conc, st_off = _family_decode(tiny_model, monkeypatch,
+                                               False)
+    assert on_seq == off_seq
+    assert on_conc == off_conc
+    # the run actually exercised what it claims to: COW copies fired and
+    # the concurrent phase preempted at least one sequence
+    assert st["cow_copies"] > 0
+    assert st["preempted"] > 0
+    assert st["prefill_tokens_saved"] > 0
+    assert st_off["prefix_cache"] is None
