@@ -47,9 +47,10 @@ test: check
 
 # Store daemon under ThreadSanitizer: rebuild shm_store with
 # RTPU_SANITIZE=thread (its own cache namespace, like -asan) and drive
-# the store dataplane + crash-recovery chaos tests against it — the
-# striped-pull and restart paths are the race-sensitive surfaces.  Only
-# the standalone daemon binary is instrumented; no LD_PRELOAD needed.
+# the store dataplane + crash-recovery + KV-tier chaos tests against it
+# — the striped-pull, restart, and KV seal/pull paths are the race-
+# sensitive surfaces.  Only the standalone daemon binary is
+# instrumented; no LD_PRELOAD needed.
 TSANDIR := /tmp/rtpu_tsan
 
 sanitize-store:
@@ -57,7 +58,8 @@ sanitize-store:
 	RTPU_SANITIZE=thread \
 	TSAN_OPTIONS=log_path=$(TSANDIR)/tsan:history_size=7 \
 	python -m pytest tests/test_store_dataplane.py \
-	    tests/test_store_recovery.py -q 2>&1 | tee $(TSANDIR)/pytest.log
+	    tests/test_store_recovery.py tests/test_kv_tier.py -q \
+	    2>&1 | tee $(TSANDIR)/pytest.log
 	@! grep -rq "WARNING: ThreadSanitizer" $(TSANDIR) \
 	    && echo "sanitize-store: clean (no TSan reports)"
 

@@ -26,6 +26,16 @@ rung saw the load wall (nonzero page evictions under both policies)
 AND that prefix-aware routing beat pow-2 on req/s by >= 10% with p90
 TTFT no worse and prefill_tokens_saved > 0.
 
+The KILL RUNG (ISSUE 16) drills mid-burst replica death: two engines
+share a store-backed KV tier, one is killed at ~45% completion, the
+router purges the corpse, and in-flight requests fail over to the
+survivor.  Run once with the tier on and once off, it measures requests
+completed (must be all of them, zero errors), extra prefill tokens paid
+after the kill, and time for the cluster prefix hit rate to recover to
+80% of its pre-kill value.  Acceptance: the tier-on cell recovers
+within 5s, pulls at least one spine, and pays measurably fewer extra
+prefill tokens than the tier-off baseline.
+
 Run: ``make bench-serve`` or ``python -m ray_tpu._private.serve_bench``
 (from the repo root).  Prints one JSON line: ``{"serve_bench": {...}}``.
 """
@@ -85,7 +95,7 @@ def _family_prefix(fam: int):
     return p[:_PREFIX_TOKENS]
 
 
-def _build_requests(n: int, seed: int):
+def _build_requests(n: int, seed: int, families: int = _FAMILIES):
     """Bursty hot-family traffic: bursts of 1-4 requests from one family;
     ~20% of traffic goes to a hot head that drifts across the family
     space as the run progresses (diurnal ramp), the rest spreads evenly
@@ -97,14 +107,14 @@ def _build_requests(n: int, seed: int):
     out = []
     while len(out) < n:
         phase = len(out) / max(n - 1, 1)
-        head = int(phase * 4) % _FAMILIES  # the hot family drifts
+        head = int(phase * 4) % families  # the hot family drifts
         if rng.random() < 0.1:  # hot head: ~1.5x the average family —
             #  hot enough to exercise family heat, not so hot that one
             #  engine structurally owns an outsized share under affinity
             fam = head
         else:  # the rest spreads evenly — every family stays live, so
             #    residency is decided by WHERE requests land, not by skew
-            fam = (head + 1 + rng.randrange(_FAMILIES - 1)) % _FAMILIES
+            fam = (head + 1 + rng.randrange(families - 1)) % families
         prefix = _family_prefix(fam)
         hint = f"family-{fam:02d}:" + "q" * 48
         for _ in range(min(rng.randrange(1, 5), n - len(out))):
@@ -240,6 +250,210 @@ def _run_cell(model, router_cls, n_requests: int, concurrency: int,
     }
 
 
+def _run_kill_cell(model, tier_on: bool, n_requests: int, concurrency: int,
+                   seed: int, families: int = 6, kill_frac: float = 0.45):
+    """Mid-burst replica-kill cell (ISSUE 16): two engines behind the
+    prefix-aware router; at ``kill_frac`` completion e1 dies, the router
+    purges it, and every remaining request lands on the survivor.  The
+    families set (6 x 28 pages) fits a LONE engine's pool, so post-kill
+    hit rate is decided purely by how the survivor acquires the dead
+    engine's families: pulled from the store tier (tier_on) or
+    recomputed by cold prefills (tier_off)."""
+    import queue as queue_mod
+
+    from ray_tpu.llm.engine import EngineConfig, LLMEngine, SamplingParams
+    from ray_tpu.llm.kv_tier import InProcessStore, KVTier, LocalDirectory
+    from ray_tpu.serve.request_router import PrefixAwareRouter
+
+    params, cfg = model
+    store, dirx = InProcessStore(), LocalDirectory()
+    engines = {}
+    for rid in (b"e1", b"e2"):
+        tier = KVTier(store, dirx, seal_min_hits=1) if tier_on else None
+        eng = LLMEngine(params, cfg, EngineConfig(
+            max_slots=_MAX_SLOTS, num_pages=_NUM_PAGES,
+            page_size=_PAGE_SIZE, max_seq_len=256,
+            prefill_buckets=_BUCKETS), kv_tier=tier)
+        eng.start()
+        engines[rid] = eng
+    router = PrefixAwareRouter(
+        "bench", f"kill-tier-{'on' if tier_on else 'off'}")
+    router.update_replicas([_FakeReplica(rid) for rid in engines])
+    requests = _build_requests(n_requests, seed, families=families)
+
+    dead = set()  # rid; membership checked lock-free (GIL-atomic)
+    next_i = [0]
+    completed = [0]
+    failovers = [0]
+    ilock = threading.Lock()
+    rlock = threading.Lock()
+    errors = []
+    done = threading.Event()
+    kill_at = int(n_requests * kill_frac)
+    t_kill = [None]
+    kill_snap = [None]  # survivor's prefix_cache stats at kill time
+    pre_rate = [None]
+    samples = []  # (t, cluster hit_tokens, cluster lookup_tokens)
+
+    def live_pc():
+        h = look = 0
+        for rid, e in engines.items():
+            if rid in dead:
+                continue
+            pc = e.stats()["prefix_cache"] or {}
+            h += pc.get("hit_tokens", 0)
+            look += pc.get("lookup_tokens", 0)
+        return h, look
+
+    def sampler():
+        while not done.wait(0.05):
+            h, look = live_pc()
+            with rlock:
+                samples.append((time.monotonic(), h, look))
+
+    def stats_pump():
+        while not done.wait(0.2):
+            try:
+                router.update_stats({
+                    rid: {"queue_len": (st := e.stats())["waiting"]
+                          + st["active_slots"],
+                          "age_s": 0.0, "engine": st}
+                    for rid, e in engines.items() if rid not in dead})
+            except Exception:  # noqa: BLE001 — pump must not die mid-bench
+                pass
+
+    def killer():
+        while not done.is_set():
+            with rlock:
+                if completed[0] >= kill_at:
+                    break
+            time.sleep(0.005)
+        if done.is_set():
+            return  # the run finished before the kill point
+        now = time.monotonic()
+        with rlock:
+            win = [s for s in samples if now - s[0] <= 2.0] or samples[-2:]
+        if len(win) >= 2 and win[-1][2] > win[0][2]:
+            pre_rate[0] = ((win[-1][1] - win[0][1])
+                           / (win[-1][2] - win[0][2]))
+        kill_snap[0] = dict(engines[b"e2"].stats()["prefix_cache"] or {})
+        # the kill: mark dead FIRST so blocked workers abandon e1's
+        # queues immediately, then tear down and purge the corpse
+        dead.add(b"e1")
+        t_kill[0] = time.monotonic()
+        engines[b"e1"].stop()
+        router.purge_dead([b"e1"])
+        router.update_replicas([_FakeReplica(b"e2")])
+
+    def worker():
+        while True:
+            with ilock:
+                i = next_i[0]
+                if i >= len(requests):
+                    return
+                next_i[0] += 1
+            hint, toks = requests[i]
+            deadline = time.monotonic() + 300
+            ok = False
+            while not ok:
+                rep = router.choose(hint)
+                if rep.actor_id in dead:  # raced the purge
+                    time.sleep(0.01)
+                    continue
+                router.on_send(rep.actor_id)
+                try:
+                    req = engines[rep.actor_id].submit(
+                        toks, SamplingParams(max_tokens=_MAX_TOKENS))
+                    while True:
+                        try:
+                            item = req.out_queue.get(timeout=0.25)
+                        except queue_mod.Empty:
+                            if rep.actor_id in dead:
+                                # replica died under this request:
+                                # abandon and resubmit on a survivor
+                                with rlock:
+                                    failovers[0] += 1
+                                break
+                            if time.monotonic() > deadline:
+                                raise RuntimeError("request wedged")
+                            continue
+                        if item is None:
+                            ok = True
+                            break
+                        if isinstance(item, Exception):
+                            raise item
+                except Exception as e:  # noqa: BLE001
+                    with rlock:
+                        errors.append(f"{type(e).__name__}: {e}")
+                    break
+                finally:
+                    router.on_done(rep.actor_id)
+            if ok:
+                with rlock:
+                    completed[0] += 1
+
+    aux = [threading.Thread(target=f, daemon=True)
+           for f in (sampler, stats_pump, killer)]
+    for t in aux:
+        t.start()
+    t_start = time.monotonic()
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    wall = time.monotonic() - t_start
+    done.set()
+    for t in aux:
+        t.join(timeout=2)
+
+    # recovery: first post-kill instant where the survivor's rolling
+    # (~0.5s window) hit rate is back to 80% of the pre-kill cluster rate
+    recovery_s = None
+    if t_kill[0] is not None and pre_rate[0]:
+        post = [s for s in samples if s[0] > t_kill[0]]
+        for j in range(1, len(post)):
+            t1, h1, l1 = post[j]
+            k = j - 1
+            while k > 0 and t1 - post[k - 1][0] <= 0.5:
+                k -= 1
+            t0, h0, l0 = post[k]
+            if l1 > l0 and (h1 - h0) / (l1 - l0) >= 0.8 * pre_rate[0]:
+                recovery_s = t1 - t_kill[0]
+                break
+
+    surv = engines[b"e2"].stats()
+    surv_pc = surv["prefix_cache"] or {}
+    extra = None
+    if kill_snap[0] is not None:
+        d_look = (surv_pc.get("lookup_tokens", 0)
+                  - kill_snap[0].get("lookup_tokens", 0))
+        d_hit = (surv_pc.get("hit_tokens", 0)
+                 - kill_snap[0].get("hit_tokens", 0))
+        extra = d_look - d_hit  # tokens the survivor had to prefill cold
+    kv = {k: sum(e.stats()[k] for e in engines.values())
+          for k in ("kv_seals", "kv_pulls", "kv_pull_pages",
+                    "kv_pull_fallbacks")}
+    for e in engines.values():
+        e.stop()
+    return {
+        "tier": "on" if tier_on else "off",
+        "requests_completed": completed[0],
+        "errors": len(errors),
+        "first_error": errors[0] if errors else None,
+        "failovers": failovers[0],
+        "wall_s": round(wall, 2),
+        "kill_at_request": kill_at,
+        "pre_kill_hit_rate":
+            round(pre_rate[0], 3) if pre_rate[0] else None,
+        "recovery_s": round(recovery_s, 2) if recovery_s else None,
+        "extra_prefill_tokens_post_kill": extra,
+        "survivor_hit_rate": surv_pc.get("hit_rate"),
+        **kv,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--ladder", default="4:128,16:256,32:1024",
@@ -300,6 +514,29 @@ def main(argv=None) -> int:
     warm.generate(prefix[:8] + [107] * 226,
                   SamplingParams(max_tokens=_MAX_TOKENS))   # suffix 226 -> 240
     warm.stop()
+    # KV-tier roundtrip: seal on one engine, pull on a fresh one, so the
+    # kill rung's first failover pull doesn't pay the _inject_kv_pages
+    # compile and distort time-to-recovery
+    from ray_tpu.llm.kv_tier import InProcessStore, KVTier, LocalDirectory
+    wstore, wdir = InProcessStore(), LocalDirectory()
+    warm = LLMEngine(params, cfg, EngineConfig(
+        max_slots=_MAX_SLOTS, num_pages=_NUM_PAGES, page_size=_PAGE_SIZE,
+        max_seq_len=256, prefill_buckets=_BUCKETS),
+        kv_tier=KVTier(wstore, wdir, seal_min_hits=1))
+    warm.generate(prefix + [99] * _TAIL_TOKENS,
+                  SamplingParams(max_tokens=_MAX_TOKENS))
+    warm.generate(prefix + [101] * _TAIL_TOKENS,
+                  SamplingParams(max_tokens=_MAX_TOKENS))  # hit -> seal
+    warm.stop()
+    warm = LLMEngine(params, cfg, EngineConfig(
+        max_slots=_MAX_SLOTS, num_pages=_NUM_PAGES, page_size=_PAGE_SIZE,
+        max_seq_len=256, prefill_buckets=_BUCKETS),
+        kv_tier=KVTier(wstore, wdir, seal_min_hits=1))
+    warm.generate(prefix + [103] * _TAIL_TOKENS,
+                  SamplingParams(max_tokens=_MAX_TOKENS))  # admission pull
+    if warm.stats()["kv_pulls"] < 1:
+        print("warmup: WARNING tier pull did not trigger", file=sys.stderr)
+    warm.stop()
 
     rows = []
     for concurrency, n_requests in ladder:
@@ -320,6 +557,22 @@ def main(argv=None) -> int:
                   f"evict {row[name]['page_evictions']}", file=sys.stderr)
         rows.append(row)
 
+    kill = {"concurrency": 8, "requests": 192, "families": 6,
+            "kill_frac": 0.45}
+    for name, flag in (("tier_off", False), ("tier_on", True)):
+        print(f"running: kill rung {name}", file=sys.stderr)
+        cell = _run_kill_cell(model, flag, kill["requests"],
+                              kill["concurrency"], args.seed,
+                              families=kill["families"],
+                              kill_frac=kill["kill_frac"])
+        kill[name] = cell
+        print(f"  {name:9s} completed {cell['requests_completed']}"
+              f"/{kill['requests']}  errors {cell['errors']}  "
+              f"failovers {cell['failovers']}  "
+              f"recovery {cell['recovery_s']}s  "
+              f"extra prefill {cell['extra_prefill_tokens_post_kill']} tok  "
+              f"pulls {cell['kv_pulls']}", file=sys.stderr)
+
     top = rows[-1]
     results = {
         "engines": 2,
@@ -330,6 +583,7 @@ def main(argv=None) -> int:
         "max_tokens": _MAX_TOKENS,
         "families": _FAMILIES,
         "ladder": rows,
+        "kill_rung": kill,
         "acceptance": {
             "top_rung_requests": top["requests"],
             "nonzero_page_evictions":
@@ -348,6 +602,26 @@ def main(argv=None) -> int:
                 <= top["pow2"]["ttft_p90_ms"],
             "prefill_tokens_saved_positive":
                 top["prefix_aware"]["prefill_tokens_saved"] > 0,
+            # ISSUE 16 kill rung: a mid-burst replica kill never errors
+            # or wedges a request, the tier-on cell recovers 80% of the
+            # pre-kill hit rate within 5s of failover by PULLING spines,
+            # and failed-over traffic pays measurably fewer extra
+            # prefill tokens than the tier-off baseline
+            "kill_zero_errors_or_wedges": all(
+                kill[c]["errors"] == 0
+                and kill[c]["requests_completed"] == kill["requests"]
+                for c in ("tier_on", "tier_off")),
+            "kill_recovery_within_5s":
+                kill["tier_on"]["recovery_s"] is not None
+                and kill["tier_on"]["recovery_s"] <= 5.0,
+            "kill_tier_pays_fewer_extra_prefill_tokens":
+                kill["tier_on"]["extra_prefill_tokens_post_kill"]
+                is not None
+                and kill["tier_off"]["extra_prefill_tokens_post_kill"]
+                is not None
+                and kill["tier_on"]["extra_prefill_tokens_post_kill"]
+                < kill["tier_off"]["extra_prefill_tokens_post_kill"],
+            "kill_kv_pulls_positive": kill["tier_on"]["kv_pulls"] > 0,
         },
     }
     ok = all(bool(v) for k, v in results["acceptance"].items()

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.llm import model as lm
+from ray_tpu.llm.kv_tier import KVPullError
 from ray_tpu.llm.paged_cache import (CacheConfig, PageAllocator, PrefixCache,
                                      init_cache)
 from ray_tpu.models.llama import LlamaConfig
@@ -93,6 +94,20 @@ def _engine_metrics():
                 "cow_copies": Counter(
                     "llm_cow_page_copies_total", "Copy-on-write boundary "
                     "page duplications (partial-block prefix reuse)"),
+                "kv_seals": Counter(
+                    "llm_kv_seals_total", "Hot family spines sealed into "
+                    "the store-backed KV tier"),
+                "kv_pulls": Counter(
+                    "llm_kv_pulls_total", "Family spines pulled from the "
+                    "KV tier and hydrated into the page pool"),
+                "kv_pull_pages": Counter(
+                    "llm_kv_pull_pages_total", "KV pages hydrated from "
+                    "tier pulls (cold prefill compute avoided)"),
+                "kv_pull_fallbacks": Counter(
+                    "llm_kv_pull_fallbacks_total", "KV tier pulls that "
+                    "fell back to cold prefill, by typed failure reason "
+                    "(miss/evicted/store_died/truncated/corrupt/no_pages)",
+                    tag_keys=("reason",)),
                 "prefix_resident": Gauge(
                     "llm_prefix_resident_pages", "Cached-resident KV "
                     "pages with no live owner"),
@@ -181,7 +196,7 @@ class LLMEngine:
     """Single-process engine; wrap in an actor for serving (server.py)."""
 
     def __init__(self, params, model_cfg: LlamaConfig,
-                 cfg: Optional[EngineConfig] = None):
+                 cfg: Optional[EngineConfig] = None, kv_tier=None):
         self.cfg = cfg or EngineConfig()
         self.model_cfg = model_cfg
         self.params = params
@@ -202,6 +217,14 @@ class LLMEngine:
             not in ("0", "false") else None)
         self.max_pages_per_seq = -(-self.cfg.max_seq_len
                                    // self.cfg.page_size)
+        # Store-backed KV tier (ISSUE 16): hot family spines seal into
+        # the shm store and failure/spill paths pull them back instead
+        # of cold-prefilling.  All tier I/O (seal extraction, pull
+        # hydration) runs on the scheduler thread — the single-writer
+        # contract below covers it; kv_prehydrate() crosses threads only
+        # through the thread-safe _hydrate_q.
+        self.kv_tier = kv_tier
+        self._hydrate_q: queue_mod.Queue = queue_mod.Queue()
         self._waiting: queue_mod.Queue = queue_mod.Queue()
         # Single-writer design: _slots, the allocator, and _stats are
         # mutated ONLY by the scheduler thread (_loop); other threads
@@ -215,7 +238,9 @@ class LLMEngine:
         self._stats = {"prefills": 0, "decode_steps": 0,
                        "tokens_generated": 0, "preempted": 0,
                        "admitted": 0, "page_evictions": 0,
-                       "prefill_tokens_saved": 0, "cow_copies": 0}
+                       "prefill_tokens_saved": 0, "cow_copies": 0,
+                       "kv_seals": 0, "kv_pulls": 0, "kv_pull_pages": 0,
+                       "kv_pull_fallbacks": 0}
         # Hit-aware admission (ISSUE 14): under pool pressure prefer the
         # waiting request whose prefix is resident, but never once the
         # head of the queue has waited longer than this cap (seconds) —
@@ -349,7 +374,22 @@ class LLMEngine:
                 if xs else None
 
         pc = self.prefix_cache
+        # per-family heat rows (root digest hex + hits + resident blocks):
+        # the controller's KV replication policy ranks families across
+        # replicas from these.  family_stats iterates a dict the scheduler
+        # thread mutates — retry like _pctile.
+        kv_families: List[dict] = []
+        if pc is not None:
+            for _ in range(4):
+                try:
+                    kv_families = pc.family_stats()[:8]
+                    break
+                except RuntimeError:
+                    continue
         return {**self._stats, "active_slots": active,
+                "kv_families": kv_families,
+                "kv_tier": (self.kv_tier.stats()
+                            if self.kv_tier is not None else None),
                 "free_pages": self.allocator.num_free(),
                 "waiting": self._waiting.qsize(),
                 # prefix-cache plane (ISSUE 10): hit/miss + resident pages
@@ -369,6 +409,7 @@ class LLMEngine:
     def _loop(self):
         while not self._stop.is_set():
             try:
+                hydrated = self._drain_hydrations()
                 admitted = self._admit()
                 stepped = self._decode_all()
             except Exception as e:  # noqa: BLE001 — a dead scheduler
@@ -395,7 +436,7 @@ class LLMEngine:
             if now - self._gauges_at >= 0.25:
                 self._gauges_at = now
                 self._refresh_gauges()
-            if not admitted and not stepped:
+            if not admitted and not stepped and not hydrated:
                 time.sleep(0.002)
 
     def _refresh_gauges(self):
@@ -495,6 +536,10 @@ class LLMEngine:
                     req.out_queue.put(("prefill_done", last, kv_k, kv_v))
                     req.out_queue.put(None)
                     self._register_blocks(req.prompt_tokens, pages)
+                    # P/D tier handoff: seal regardless of family heat —
+                    # the sealed spine IS the page transfer the decode
+                    # engine pulls (pd_disagg ships only the digest)
+                    self._maybe_seal(req.prompt_tokens, force=True)
                 except Exception as e:  # noqa: BLE001
                     req.out_queue.put(e)
                     req.out_queue.put(None)
@@ -512,6 +557,13 @@ class LLMEngine:
             cow_src: Optional[int] = None
             cow_len = 0
             if self.prefix_cache is not None and req.kind == "normal":
+                # KV tier pull (ISSUE 16): if this prompt's family has a
+                # deeper spine sealed in the store than is locally
+                # resident (imbalance shed, P/D tier handoff, failover
+                # from a killed replica), hydrate it FIRST so match_cow
+                # below finds warm pages instead of cold-prefilling.
+                if self.kv_tier is not None:
+                    self._maybe_tier_pull(req.prompt_tokens)
                 matched, cow_src, cow_len = \
                     self.prefix_cache.match_cow(req.prompt_tokens)
             need_total = n // self.cfg.page_size + 1
@@ -693,6 +745,162 @@ class LLMEngine:
             return
         cached = self.prefix_cache.insert(tokens, pages)
         self.allocator.mark_cached(cached)
+        self._maybe_seal(tokens)
+
+    # ------------------------- KV tier (ISSUE 16) --------------------------
+
+    def kv_prehydrate(self, roots: List[str]) -> None:
+        """Ask the engine to pull these family spines from the KV tier
+        (controller replication fan-out / warm restart).  Thread-safe:
+        roots queue through _hydrate_q and the scheduler thread performs
+        the actual pool mutation in _drain_hydrations."""
+        self.start()
+        for r in roots or ():
+            self._hydrate_q.put(str(r))
+
+    def _tier_expect(self) -> dict:
+        return {"page_size": self.cfg.page_size,
+                "layers": self.model_cfg.n_layers,
+                "kv_heads": self.model_cfg.n_kv_heads,
+                "head_dim": self.model_cfg.head_dim,
+                "dtype": str(np.dtype(self.cache_k.dtype))}
+
+    def _kv_fallback(self, reason: str) -> None:
+        self._stats["kv_pull_fallbacks"] += 1
+        self._m["kv_pull_fallbacks"].inc(tags={"reason": reason})
+
+    def _extract_pages(self, pages: List[int]):
+        """Host copies of the given pages' KV (seal extraction).  Runs on
+        the scheduler thread; registered full pages are append-only (COW
+        duplicates into fresh pages, suffix prefill writes positions past
+        the registered prefix), so the read is not torn."""
+        idx = np.asarray(pages)
+        return (np.asarray(self.cache_k[:, idx]),
+                np.asarray(self.cache_v[:, idx]))
+
+    def _maybe_seal(self, tokens: List[int], force: bool = False) -> None:
+        tier, pc = self.kv_tier, self.prefix_cache
+        if tier is None or pc is None:
+            return
+        if tier.maybe_seal(pc, self._extract_pages, tokens, force=force):
+            self._stats["kv_seals"] += 1
+            self._m["kv_seals"].inc()
+
+    def _maybe_tier_pull(self, tokens: List[int]) -> None:
+        """Admission-path pull: hydrate this prompt's family spine from
+        the tier when the store holds more of it than the local pool.
+        Every failure is a typed fallback to cold prefill, never an
+        admission error."""
+        tier, pc = self.kv_tier, self.prefix_cache
+        ps = self.cfg.page_size
+        cap = (len(tokens) - 1) // ps  # ≥1 suffix token stays to prefill
+        if cap <= 0:
+            return
+        root_hex = pc.root_digest_for(tokens, ps)
+        rec = tier.lookup_for_pull(root_hex)
+        if rec is None:
+            return  # never sealed: plain cold traffic, not a fallback
+        local = pc.peek_match_tokens(tokens) // ps
+        if min(int(rec.get("blocks", 0)), cap) <= local:
+            return  # the pool already covers what the blob would add
+        try:
+            spine, kv_k, kv_v = tier.pull(root_hex, rec=rec,
+                                          expect=self._tier_expect())
+        except KVPullError as e:
+            self._kv_fallback(e.reason)
+            return
+        n = self._hydrate_spine(spine, kv_k, kv_v, limit_tokens=tokens)
+        if n > 0:
+            self._stats["kv_pulls"] += 1
+            self._stats["kv_pull_pages"] += n
+            self._m["kv_pulls"].inc()
+            self._m["kv_pull_pages"].inc(n)
+
+    def _drain_hydrations(self) -> bool:
+        """Scheduler-thread half of kv_prehydrate: pull queued family
+        roots and hydrate their full spines."""
+        tier, pc = self.kv_tier, self.prefix_cache
+        did = False
+        while tier is not None and pc is not None:
+            try:
+                root_hex = self._hydrate_q.get_nowait()
+            except queue_mod.Empty:
+                break
+            rec = tier.lookup(root_hex)
+            if rec is None:
+                continue  # nothing sealed under that root (yet)
+            try:
+                spine, kv_k, kv_v = tier.pull(root_hex, rec=rec,
+                                              expect=self._tier_expect())
+            except KVPullError as e:
+                self._kv_fallback(e.reason)
+                continue
+            n = self._hydrate_spine(spine, kv_k, kv_v)
+            if n > 0:
+                did = True
+                self._stats["kv_pulls"] += 1
+                self._stats["kv_pull_pages"] += n
+                self._m["kv_pulls"].inc()
+                self._m["kv_pull_pages"].inc(n)
+        return did
+
+    def _hydrate_spine(self, spine: List[int], kv_k, kv_v,
+                       limit_tokens: Optional[List[int]] = None) -> int:
+        """Scatter a pulled spine's missing blocks into fresh pages and
+        register them cached-resident; returns pages hydrated (0 = all
+        resident / nothing usable).  With ``limit_tokens`` (admission
+        path) only the blocks that are a true prefix of that prompt are
+        hydrated, capped so ≥1 suffix token remains to prefill."""
+        pc = self.prefix_cache
+        ps = self.cfg.page_size
+        nblk = int(kv_k.shape[1])
+        m = min(nblk, self.max_pages_per_seq)
+        if limit_tokens is not None:
+            cap = min(m, (len(limit_tokens) - 1) // ps)
+            m = 0
+            while (m < cap and list(spine[m * ps:(m + 1) * ps])
+                   == [int(t) for t in limit_tokens[m * ps:(m + 1) * ps]]):
+                m += 1
+        if m <= 0:
+            return 0
+        probe = list(spine[:m * ps]) + [0]  # sentinel suffix token: _walk
+        # caps at (n-1)//ps, so this matches exactly the m spine blocks
+        resident = pc.match(probe)
+        k_res = len(resident)
+        if k_res >= m:
+            return 0
+        need = m - k_res
+        # pin the resident prefix BEFORE reserving — eviction inside
+        # _reserve must not reclaim the chain we're extending
+        self.allocator.retain(resident)
+        if not self._reserve(need):
+            self.allocator.free(resident)
+            self._kv_fallback("no_pages")
+            return 0
+        fresh = self.allocator.allocate(need)
+        P = self.max_pages_per_seq
+        idx = np.zeros(P, np.int32)
+        idx[:need] = fresh
+        sel_k = np.ascontiguousarray(kv_k[:, k_res:m])
+        sel_v = np.ascontiguousarray(kv_v[:, k_res:m])
+        if need < P:
+            pad = ((0, 0), (0, P - need), (0, 0), (0, 0), (0, 0))
+            sel_k = np.pad(sel_k, pad)
+            sel_v = np.pad(sel_v, pad)
+        # same donated jitted scatter (and compiled shape) as decode_kv
+        # admission: padded rows land in the null page 0
+        self.cache_k, self.cache_v = _inject_kv_pages(
+            self.cache_k, self.cache_v, jnp.asarray(idx),
+            jnp.asarray(sel_k, self.cache_k.dtype),
+            jnp.asarray(sel_v, self.cache_v.dtype))
+        cached = pc.insert(list(spine[:m * ps]), resident + fresh)
+        self.allocator.mark_cached(cached)
+        # release both the fresh allocation and the resident pins: every
+        # spine page ends cached-resident, exactly like a finished
+        # sequence's pages — the next match_cow retains them as a hit
+        self.allocator.free(fresh)
+        self.allocator.free(resident)
+        return need
 
     def _preempt(self, i: int, s: _Slot) -> None:
         """Evict a running sequence (vLLM's recompute preemption): accepted

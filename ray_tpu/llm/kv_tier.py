@@ -1,0 +1,501 @@
+"""Store-backed KV page tier: prefix families as shm-store objects.
+
+Robustness layer beneath the page pool (ISSUE 16, ROADMAP item 2): the
+KV pages of a hot prefix family are a *process attribute* — a replica
+death vaporizes them, an imbalance shed decodes cold, and a restarted
+replica starts from zero hits.  Following the Ray object-store argument
+(durability comes from making state an addressable, replicable object),
+this module seals each hot family's shared SPINE — the chain of was_hit
+blocks from the family root, i.e. exactly the pages later requests
+reuse — into the node's shm object store, digest-addressed by the
+family's root block digest (`PrefixCache.digest_for` chain hash, so two
+processes agree on the address byte-for-byte).
+
+Four failure/spill paths then become page *pulls* instead of cold
+prefills: an imbalance shed re-hydrates the family's spine before
+decoding, the P/D handoff ships a digest instead of host KV arrays, a
+restarted replica warms its hottest families from the store, and a
+replica kill fails over with survivors pulling the corpse's families.
+Every pull degrades gracefully: a typed `KVPullError` (store miss,
+daemon death, truncated/corrupt blob) falls back to cold prefill with a
+``llm_kv_pull_fallbacks_total{reason}`` counter — never a wedged
+request.
+
+Layering: the tier knows stores and directories; the ENGINE owns all
+page-pool mutation (hydration runs on its scheduler thread, preserving
+the single-writer contract) and all metrics.  In a ray_tpu worker the
+backend is the node's shm store plus the striped ``XFER_PULL_RANGE``
+transfer plane (``note_sealed`` registers this node as a holder; a
+local miss asks the scheduler to pull the stripes from a holder), and
+the directory rides the GCS kv table — so spines survive engine death
+and cross nodes without ever transiting Python pickling.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+_FALSY = ("", "0", "false", "no", "off")
+_MAGIC = b"KVT1"
+_OID_SALT = b"rtpu-kv:"
+
+# How long a directory miss is cached before the engine's admission path
+# asks again (keeps a per-cold-request directory RPC off the hot path).
+_NEG_TTL_S = 2.0
+
+
+class KVPullError(Exception):
+    """A tier pull failed in a typed, fallback-able way.
+
+    ``reason`` feeds ``llm_kv_pull_fallbacks_total{reason}``:
+      miss       — directory record exists but the store has no bytes
+                   (evicted blob, daemon restart lost the segment)
+      evicted    — the store reported the object explicitly evicted
+      store_died — the store daemon is unreachable past the retry budget
+      truncated  — blob shorter than its header promises (torn stripe)
+      corrupt    — bad magic/header, or geometry mismatching this engine
+      no_pages   — pull succeeded but the pool can't host the spine
+    """
+
+    def __init__(self, reason: str, msg: str = ""):
+        super().__init__(msg or reason)
+        self.reason = reason
+
+
+def _exc_reason(exc: BaseException) -> str:
+    # name-based so this module never imports the store client (engines
+    # without a worker context must import the tier cheaply)
+    name = type(exc).__name__
+    if name == "ObjectEvictedError":
+        return "evicted"
+    return "store_died"
+
+
+def _np_dtype(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # registered by jax; covers bfloat16 et al.
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+# ------------------------- blob codec -----------------------------------
+
+
+def encode_spine(tokens: List[int], kv_k: np.ndarray, kv_v: np.ndarray,
+                 page_size: int) -> bytes:
+    """Serialize a family spine: [MAGIC][u32 hlen][json header][k][v].
+
+    kv arrays are [n_layers, blocks, page_size, n_kv, head_dim]; the
+    header carries the spine's token content so the puller can verify
+    block-by-block how much of a given prompt the blob actually covers.
+    """
+    kv_k = np.ascontiguousarray(kv_k)
+    kv_v = np.ascontiguousarray(kv_v)
+    hdr = {"v": 1, "page_size": int(page_size),
+           "blocks": int(kv_k.shape[1]), "layers": int(kv_k.shape[0]),
+           "kv_heads": int(kv_k.shape[3]), "head_dim": int(kv_k.shape[4]),
+           "dtype": str(kv_k.dtype), "tokens": [int(t) for t in tokens],
+           "k_bytes": int(kv_k.nbytes), "v_bytes": int(kv_v.nbytes)}
+    hb = json.dumps(hdr).encode()
+    return b"".join([_MAGIC, struct.pack("<I", len(hb)), hb,
+                     kv_k.tobytes(), kv_v.tobytes()])
+
+
+def decode_spine(blob) -> Tuple[List[int], np.ndarray, np.ndarray, dict]:
+    """Inverse of encode_spine; raises typed KVPullError on damage."""
+    blob = bytes(blob)  # own the bytes — the source may be a released
+    # shm memoryview by the time numpy reads it
+    if len(blob) < 8 or blob[:4] != _MAGIC:
+        raise KVPullError("corrupt", "bad magic")
+    (hlen,) = struct.unpack_from("<I", blob, 4)
+    if len(blob) < 8 + hlen:
+        raise KVPullError("truncated", "header cut short")
+    try:
+        hdr = json.loads(blob[8:8 + hlen])
+        shape = (hdr["layers"], hdr["blocks"], hdr["page_size"],
+                 hdr["kv_heads"], hdr["head_dim"])
+        dt = _np_dtype(hdr["dtype"])
+        k_bytes, v_bytes = int(hdr["k_bytes"]), int(hdr["v_bytes"])
+        tokens = [int(t) for t in hdr["tokens"]]
+    except KeyError as e:
+        raise KVPullError("corrupt", f"header missing {e}")
+    except Exception as e:  # noqa: BLE001 — any malformed header
+        raise KVPullError("corrupt", f"bad header: {e}")
+    if len(tokens) != hdr["blocks"] * hdr["page_size"]:
+        raise KVPullError("corrupt", "token count != blocks * page_size")
+    if len(blob) < 8 + hlen + k_bytes + v_bytes:
+        raise KVPullError(
+            "truncated", f"blob {len(blob)}B < promised "
+            f"{8 + hlen + k_bytes + v_bytes}B")
+    count = int(np.prod(shape))
+    kv_k = np.frombuffer(blob, dt, count=count,
+                         offset=8 + hlen).reshape(shape)
+    kv_v = np.frombuffer(blob, dt, count=count,
+                         offset=8 + hlen + k_bytes).reshape(shape)
+    return tokens, kv_k, kv_v, hdr
+
+
+# ------------------------- backends / directories ------------------------
+
+
+class InProcessStore:
+    """Dict-backed store stand-in (tests, bench warmup): same surface as
+    the pieces of StoreClient the tier uses."""
+
+    def __init__(self):
+        self._objs: Dict[bytes, bytes] = {}
+        self._lock = threading.Lock()
+
+    def put(self, oid: bytes, data: bytes) -> None:
+        with self._lock:
+            self._objs[bytes(oid)] = bytes(data)
+
+    def get_bytes(self, oid: bytes, timeout_ms: int = 0):
+        with self._lock:
+            return self._objs.get(bytes(oid))
+
+    def contains(self, oid: bytes) -> bool:
+        with self._lock:
+            return bytes(oid) in self._objs
+
+    def delete(self, oid: bytes) -> None:
+        with self._lock:
+            self._objs.pop(bytes(oid), None)
+
+
+class WorkerStoreBackend:
+    """This node's shm store + the striped pull plane behind a miss.
+
+    put() also reports the seal over the scheduler RPC lane
+    (``note_sealed``) so the GCS records this node as a holder; a local
+    get miss then asks the scheduler to ``pull`` — the daemon fetches
+    the stripes daemon-to-daemon over ``XFER_PULL_RANGE`` from a holder
+    — and polls the local store briefly for the object to land."""
+
+    def __init__(self, worker, pull_wait_s: float = 2.0):
+        self._w = worker
+        self._pull_wait_s = pull_wait_s
+
+    def put(self, oid: bytes, data: bytes) -> None:
+        self._w.store.put(oid, data)
+        try:
+            self._w.rpc("note_sealed", {"oid": oid})
+        except Exception:  # noqa: BLE001 — local put stands on its own
+            pass
+
+    def get_bytes(self, oid: bytes, timeout_ms: int = 0):
+        got = self._w.store.get_bytes(oid, timeout_ms)
+        if got is not None:
+            return got
+        try:
+            self._w.rpc("pull", {"oid": oid})
+        except Exception:  # noqa: BLE001 — no transfer plane: a miss
+            return None
+        deadline = time.monotonic() + self._pull_wait_s
+        while time.monotonic() < deadline:
+            got = self._w.store.get_bytes(oid, timeout_ms=200)
+            if got is not None:
+                return got
+        return None
+
+    def contains(self, oid: bytes) -> bool:
+        return self._w.store.contains(oid)
+
+
+class LocalDirectory:
+    """In-process family directory (tests / single-process serving):
+    root digest hex -> {oid, blocks, hits, page_size}."""
+
+    def __init__(self):
+        self._recs: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    def publish(self, root_hex: str, rec: dict) -> None:
+        with self._lock:
+            old = self._recs.get(root_hex)
+            if old is not None and old.get("blocks", 0) > rec.get(
+                    "blocks", 0):
+                # never shadow a deeper spine with a shallower reseal
+                rec = {**rec, "oid": old["oid"], "blocks": old["blocks"]}
+            self._recs[root_hex] = dict(rec)
+
+    def lookup(self, root_hex: str) -> Optional[dict]:
+        with self._lock:
+            rec = self._recs.get(root_hex)
+            return dict(rec) if rec is not None else None
+
+    def hottest(self, n: int) -> List[str]:
+        with self._lock:
+            items = list(self._recs.items())
+        items.sort(key=lambda kv: -int(kv[1].get("hits", 0)))
+        return [root for root, _ in items[:n]]
+
+    def drop(self, root_hex: str) -> None:
+        with self._lock:
+            self._recs.pop(root_hex, None)
+
+
+class GcsDirectory:
+    """Cluster directory over the GCS kv table (namespace ``kv_tier``):
+    one record per family root, plus an advisory ``_index`` heat doc for
+    warm restarts.  The index merge is read-modify-write and therefore
+    racy across publishers — acceptable: it only seeds prehydration
+    hints, the per-root records stay authoritative."""
+
+    NS = "kv_tier"
+    _INDEX_CAP = 64
+
+    def __init__(self, worker):
+        self._w = worker
+
+    def publish(self, root_hex: str, rec: dict) -> None:
+        try:
+            self._w.rpc("kv_put", {
+                "namespace": self.NS, "key": root_hex.encode(),
+                "value": json.dumps(rec).encode()})
+            raw = self._w.rpc("kv_get", {"namespace": self.NS,
+                                         "key": b"_index"})
+            idx = json.loads(raw) if raw else {}
+            idx[root_hex] = int(rec.get("hits", 0))
+            top = dict(sorted(idx.items(), key=lambda kv: -kv[1])
+                       [:self._INDEX_CAP])
+            self._w.rpc("kv_put", {"namespace": self.NS, "key": b"_index",
+                                   "value": json.dumps(top).encode()})
+        except Exception:  # noqa: BLE001 — publishing is best-effort
+            pass
+
+    def lookup(self, root_hex: str) -> Optional[dict]:
+        try:
+            raw = self._w.rpc("kv_get", {"namespace": self.NS,
+                                         "key": root_hex.encode()})
+        except Exception:  # noqa: BLE001
+            return None
+        if not raw:
+            return None
+        try:
+            return json.loads(raw)
+        except Exception:  # noqa: BLE001
+            return None
+
+    def hottest(self, n: int) -> List[str]:
+        try:
+            raw = self._w.rpc("kv_get", {"namespace": self.NS,
+                                         "key": b"_index"})
+        except Exception:  # noqa: BLE001
+            return []
+        if not raw:
+            return []
+        try:
+            idx = json.loads(raw)
+        except Exception:  # noqa: BLE001
+            return []
+        return sorted(idx, key=lambda r: -idx[r])[:n]
+
+
+# ------------------------- the tier --------------------------------------
+
+
+class KVTier:
+    """Digest-addressed KV spine objects over a store + directory.
+
+    Thread-compatibility: each method is self-contained; the `_sealed`
+    and negative-lookup memos are per-instance dicts mutated with
+    GIL-atomic ops, so one tier may be shared by multiple engines'
+    scheduler threads (the bench does).
+    """
+
+    def __init__(self, store, directory, *,
+                 seal_min_hits: Optional[int] = None):
+        self.store = store
+        self.directory = directory
+        self.seal_min_hits = (int(os.environ.get(
+            "RTPU_KV_SEAL_MIN_HITS", "2") or 2)
+            if seal_min_hits is None else int(seal_min_hits))
+        self._sealed: Dict[str, int] = {}  # root hex -> blocks sealed
+        self._neg: Dict[str, float] = {}   # root hex -> miss timestamp
+        self.seals = 0
+        self.pulls = 0
+
+    # -- addressing --------------------------------------------------------
+
+    @staticmethod
+    def oid_for(root_hex: str, blocks: int) -> bytes:
+        """20-byte store oid for one sealed depth of a family.  The depth
+        is part of the address: a deeper reseal gets a fresh oid instead
+        of overwriting a sealed (immutable) object; the directory record
+        points at the current one and stale depths age out of the store."""
+        h = hashlib.blake2b(digest_size=20)
+        h.update(_OID_SALT + bytes.fromhex(root_hex)
+                 + int(blocks).to_bytes(4, "little"))
+        return h.digest()
+
+    # -- sealing -----------------------------------------------------------
+
+    def maybe_seal(self, prefix_cache, extract: Callable, tokens: List[int],
+                   force: bool = False) -> bool:
+        """Seal `tokens`' family spine if it is hot enough and grew since
+        the last seal.  `extract(pages) -> (kv_k, kv_v)` is the engine's
+        host-side page read (scheduler thread: registered full pages are
+        append-only, so the read is not torn).  ``force`` skips the heat
+        gate (the P/D prefill handoff seals unconditionally — the seal IS
+        the transfer)."""
+        ps = prefix_cache.page_size
+        root_hex = prefix_cache.root_digest_for(tokens, ps)
+        if root_hex is None:
+            return False
+        hits = prefix_cache.family_hits(bytes.fromhex(root_hex))
+        if hits < 0:
+            return False
+        if not force and hits < self.seal_min_hits:
+            return False
+        spine_tokens, pages = prefix_cache.spine(bytes.fromhex(root_hex))
+        if not pages:
+            return False
+        if len(pages) <= self._sealed.get(root_hex, 0):
+            return False
+        if root_hex not in self._sealed:
+            rec = self.directory.lookup(root_hex)
+            if rec is not None and int(rec.get("blocks", 0)) >= len(pages):
+                # another engine already sealed at least this depth
+                self._sealed[root_hex] = int(rec["blocks"])
+                return False
+        try:
+            kv_k, kv_v = extract(pages)
+            blob = encode_spine(spine_tokens, kv_k, kv_v, ps)
+            self.store.put(self.oid_for(root_hex, len(pages)), blob)
+        except Exception:  # noqa: BLE001 — sealing is durability, not
+            # correctness: a failed put just means no warm failover
+            return False
+        self._sealed[root_hex] = len(pages)
+        self._neg.pop(root_hex, None)
+        self.directory.publish(root_hex, {
+            "root": root_hex, "oid": self.oid_for(root_hex,
+                                                  len(pages)).hex(),
+            "blocks": len(pages), "hits": int(hits), "page_size": ps})
+        self.seals += 1
+        return True
+
+    # -- lookup / pull -----------------------------------------------------
+
+    def lookup(self, root_hex: str) -> Optional[dict]:
+        return self.directory.lookup(root_hex)
+
+    def lookup_for_pull(self, root_hex: str) -> Optional[dict]:
+        """Directory lookup with a short negative cache — the admission
+        path probes every cold family, and a directory RPC per cold
+        request would tax exactly the traffic that gains nothing."""
+        now = time.monotonic()
+        ts = self._neg.get(root_hex)
+        if ts is not None and now - ts < _NEG_TTL_S:
+            return None
+        rec = self.directory.lookup(root_hex)
+        if rec is None:
+            if len(self._neg) > 4096:
+                self._neg.clear()
+            self._neg[root_hex] = now
+        else:
+            self._neg.pop(root_hex, None)
+        return rec
+
+    def pull(self, root_hex: str, rec: Optional[dict] = None,
+             expect: Optional[dict] = None
+             ) -> Tuple[List[int], np.ndarray, np.ndarray]:
+        """Fetch + decode a family spine; raises KVPullError on any typed
+        failure.  ``expect`` (page_size/layers/kv_heads/head_dim) guards
+        against hydrating a blob sealed under a different geometry."""
+        if rec is None:
+            rec = self.directory.lookup(root_hex)
+        if rec is None:
+            raise KVPullError("miss", f"family {root_hex} not in directory")
+        try:
+            oid = bytes.fromhex(rec["oid"])
+        except Exception:  # noqa: BLE001
+            raise KVPullError("corrupt", f"bad directory record for "
+                                         f"{root_hex}")
+        try:
+            got = self.store.get_bytes(oid, timeout_ms=500)
+        except KVPullError:
+            raise
+        except Exception as e:  # noqa: BLE001 — daemon death / eviction
+            raise KVPullError(_exc_reason(e), str(e))
+        if got is None:
+            raise KVPullError("miss", f"store has no bytes for {root_hex}")
+        try:
+            tokens, kv_k, kv_v, hdr = decode_spine(got)
+        finally:
+            if isinstance(got, memoryview):
+                got.release()
+                rel = getattr(self.store, "release", None)
+                if callable(rel):
+                    rel(oid)
+        for key in ("page_size", "layers", "kv_heads", "head_dim"):
+            if expect and key in expect and hdr[key] != expect[key]:
+                raise KVPullError(
+                    "corrupt", f"{key} mismatch: blob {hdr[key]} != "
+                    f"engine {expect[key]}")
+        if expect and "dtype" in expect and hdr["dtype"] != expect["dtype"]:
+            raise KVPullError("corrupt", f"dtype mismatch: blob "
+                              f"{hdr['dtype']} != engine {expect['dtype']}")
+        self.pulls += 1
+        return tokens, kv_k, kv_v
+
+    def hottest(self, n: int = 8) -> List[str]:
+        return self.directory.hottest(n)
+
+    def stats(self) -> dict:
+        return {"sealed_families": len(self._sealed),
+                "seal_min_hits": self.seal_min_hits,
+                "seals": self.seals, "pulls": self.pulls}
+
+
+# ------------------------- process default -------------------------------
+
+_default_lock = threading.Lock()
+_default_tier: Optional[KVTier] = None
+_default_set = False
+_auto_tiers: Dict[int, KVTier] = {}  # id(worker) -> tier
+
+
+def set_default_tier(tier: Optional[KVTier]) -> None:
+    """Install (or, with None, disable) the process default explicitly;
+    wins over the worker-derived automatic tier."""
+    global _default_tier, _default_set
+    with _default_lock:
+        _default_tier, _default_set = tier, True
+
+
+def default_tier() -> Optional[KVTier]:
+    """The tier an engine in this process should use: the explicitly
+    installed one if any; else, when ``RTPU_KV_TIER`` is on and a
+    ray_tpu worker with a store client is up, a tier over that worker's
+    shm store + the GCS directory.  None outside a worker (plain
+    LLMEngine users opt in by passing a tier)."""
+    with _default_lock:
+        if _default_set:
+            return _default_tier
+    if os.environ.get("RTPU_KV_TIER", "1").strip().lower() in _FALSY:
+        return None
+    from ray_tpu._private.worker import global_worker_or_none
+
+    w = global_worker_or_none()
+    if w is None or getattr(w, "store", None) is None:
+        return None
+    with _default_lock:
+        if _default_set:
+            return _default_tier
+        tier = _auto_tiers.get(id(w))
+        if tier is None:
+            tier = KVTier(WorkerStoreBackend(w), GcsDirectory(w))
+            _auto_tiers.clear()  # a fresh worker obsoletes old bindings
+            _auto_tiers[id(w)] = tier
+        return tier

@@ -252,6 +252,16 @@ class PrefixCache:
             d = cls._chain(d, tokens[k * page_size:(k + 1) * page_size])
         return d.hex()
 
+    @classmethod
+    def root_digest_for(cls, tokens: List[int],
+                        page_size: int) -> Optional[str]:
+        """Digest of `tokens`' FIRST full block — the family identity the
+        KV tier addresses spine objects by (same chain hash as
+        digest_for, so every process derives the same address)."""
+        if len(tokens) < page_size:
+            return None
+        return cls._chain(b"", tokens[:page_size]).hex()
+
     # ------------------------- index ops -----------------------------
 
     def __len__(self) -> int:
@@ -470,6 +480,36 @@ class PrefixCache:
             if len(out) >= limit:
                 break
         return out
+
+    def family_hits(self, root: bytes) -> int:
+        """Hit count of the family rooted at `root`, -1 when the family
+        has no resident blocks (the KV tier's seal gate)."""
+        fam = self._families.get(root)
+        return fam.hits if fam is not None else -1
+
+    def spine(self, root: bytes) -> Tuple[List[int], List[int]]:
+        """The family's shared spine: from the root block down while
+        exactly ONE resident child was ever reused (was_hit) — the pages
+        later requests actually re-walk, and exactly what a KV-tier seal
+        captures.  Unique tails (was_hit=False) and fork points (two hot
+        children — the shared prefix ends where tails diverge) stop the
+        walk.  Returns (tokens, pages); empty when the root is gone."""
+        blk = self._blocks.get(root)
+        if blk is None:
+            return [], []
+        toks: List[int] = list(blk.tokens)
+        pages: List[int] = [blk.page]
+        d = root
+        while True:
+            hot = [cd for cd in self._children.get(d, ())
+                   if (b := self._blocks.get(cd)) is not None and b.was_hit]
+            if len(hot) != 1:
+                break
+            d = hot[0]
+            b = self._blocks[d]
+            toks.extend(b.tokens)
+            pages.append(b.page)
+        return toks, pages
 
     def family_stats(self) -> List[dict]:
         """Per-family heat rows, hottest first (debug/CLI view)."""

@@ -11,8 +11,11 @@ them to the decode deployment, whose engine injects the pages via
 ``submit_with_kv`` and continues decoding WITHOUT recomputing the prompt —
 the point of disaggregation: prefill (compute-bound, MXU-saturating) and
 decode (memory-bound, latency-sensitive) scale independently on different
-slices. KV currently relays through the shm object store (host staging);
-the device-object transport is the drop-in upgrade path.
+slices.  With the store-backed KV tier up (llm/kv_tier.py), the handoff
+ships only the family digest: the prefill admission force-seals the spine
+into the shm store and the decode engine PULLS the pages over the store
+transfer plane — falling back to the legacy host-array relay when no tier
+is configured.
 """
 
 from __future__ import annotations
@@ -22,6 +25,7 @@ import uuid
 from typing import Optional
 
 from ray_tpu import serve
+from ray_tpu.llm import kv_tier as kv_tier_mod
 from ray_tpu.llm.engine import LLMEngine, SamplingParams
 from ray_tpu.llm.server import LLMConfig
 from ray_tpu.llm.tokenizer import get_tokenizer
@@ -33,8 +37,10 @@ class PrefillServer:
     def __init__(self, llm_config: LLMConfig):
         params, model_cfg = llm_config.model_loader()
         self._tok = get_tokenizer(llm_config.tokenizer)
+        self._tier = kv_tier_mod.default_tier()
         self._engine = LLMEngine(params, model_cfg,
-                                 llm_config.engine_config)
+                                 llm_config.engine_config,
+                                 kv_tier=self._tier)
         self._engine.start()
         self._config = llm_config
 
@@ -51,9 +57,23 @@ class PrefillServer:
         # prefix-aware router matches them instead of re-hashing the prompt.
         digest = PrefixCache.digest_for(
             tokens, self._engine.cfg.page_size)
-        return {"prompt_tokens": tokens, "first_token": first,
-                "kv_k": kv_k, "kv_v": kv_v, "n_tokens": n,
-                "prefix_digest": digest}
+        out = {"prompt_tokens": tokens, "first_token": first,
+               "n_tokens": n, "prefix_digest": digest}
+        if (self._tier is not None
+                and len(tokens) > self._engine.cfg.page_size):
+            # KV-tier handoff (ISSUE 16): the prefill admission already
+            # force-sealed this prompt's spine into the store, so the
+            # decode hop needs only the address — its engine pulls the
+            # pages over the store transfer plane instead of receiving
+            # multi-MB host arrays through the RPC lane.
+            out["kv_in_tier"] = True
+        else:
+            out["kv_k"], out["kv_v"] = kv_k, kv_v
+        return out
+
+    def kv_prehydrate(self, roots) -> int:
+        self._engine.kv_prehydrate(list(roots))
+        return len(list(roots))
 
     def engine_stats(self) -> dict:
         return self._engine.stats()
@@ -65,8 +85,10 @@ class DecodeServer:
     def __init__(self, llm_config: LLMConfig):
         params, model_cfg = llm_config.model_loader()
         self._tok = get_tokenizer(llm_config.tokenizer)
+        self._tier = kv_tier_mod.default_tier()
         self._engine = LLMEngine(params, model_cfg,
-                                 llm_config.engine_config)
+                                 llm_config.engine_config,
+                                 kv_tier=self._tier)
         self._engine.start()
         self._config = llm_config
 
@@ -78,6 +100,23 @@ class DecodeServer:
             stop = tuple(sp_kwargs.get("stop_token_ids", ())) + (eos,)
             sp_kwargs["stop_token_ids"] = stop
         sp = SamplingParams(**sp_kwargs)
+        if prefill_result.get("kv_in_tier") and "kv_k" not in prefill_result:
+            # KV-tier handoff: submit as a NORMAL request — admission
+            # pulls the sealed spine from the store and hydrates it, so
+            # only the final partial block prefills here.  Greedy decode
+            # over identical KV regenerates the prefill's first token
+            # bit-for-bit; a pull failure degrades to a cold prefill of
+            # the same request (counted, never fatal).
+            req = self._engine.submit(prefill_result["prompt_tokens"], sp)
+            toks = []
+            while True:
+                item = req.out_queue.get(timeout=300)
+                if item is None:
+                    break
+                if isinstance(item, Exception):
+                    raise item
+                toks.append(item)
+            return {"tokens": toks, "text": self._tok.decode(toks)}
         req = self._engine.submit_with_kv(
             prefill_result["prompt_tokens"],
             prefill_result["first_token"],
@@ -94,6 +133,10 @@ class DecodeServer:
                     raise item
                 toks.append(item)
         return {"tokens": toks, "text": self._tok.decode(toks)}
+
+    def kv_prehydrate(self, roots) -> int:
+        self._engine.kv_prehydrate(list(roots))
+        return len(list(roots))
 
     def engine_stats(self) -> dict:
         return self._engine.stats()

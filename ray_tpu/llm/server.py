@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from ray_tpu import serve
+from ray_tpu.llm import kv_tier as kv_tier_mod
 from ray_tpu.llm.engine import EngineConfig, LLMEngine, SamplingParams
 from ray_tpu.llm.tokenizer import get_tokenizer
 from ray_tpu.models.llama import LlamaConfig
@@ -45,9 +46,26 @@ class LLMServer:
             raise ValueError("LLMConfig.model_loader is required")
         params, model_cfg = llm_config.model_loader()
         self._tok = get_tokenizer(llm_config.tokenizer)
+        # Store-backed KV tier (ISSUE 16): in a ray_tpu worker the engine
+        # seals hot family spines into the shm store and pulls them back
+        # on sheds/failover instead of cold-prefilling.
+        self._tier = kv_tier_mod.default_tier()
         self._engine = LLMEngine(params, model_cfg,
-                                 llm_config.engine_config)
+                                 llm_config.engine_config,
+                                 kv_tier=self._tier)
         self._engine.start()
+        if self._tier is not None:
+            # Warm restart: a replica the controller just restarted (or a
+            # fresh scale-up) re-hydrates the cluster's hottest families
+            # from the store before traffic arrives, instead of starting
+            # from zero hits.  Best-effort and async (scheduler thread
+            # drains the queue); an empty directory is a no-op.
+            try:
+                roots = self._tier.hottest(8)
+            except Exception:  # noqa: BLE001
+                roots = []
+            if roots:
+                self._engine.kv_prehydrate(roots)
 
     def _params_from(self, body: dict) -> SamplingParams:
         stop_ids = tuple(body.get("stop_token_ids", ()))
@@ -199,6 +217,13 @@ class LLMServer:
 
     def engine_stats(self) -> dict:
         return self._engine.stats()
+
+    def kv_prehydrate(self, roots) -> int:
+        """Controller KV replication fan-out: pull these family spines
+        from the store tier (no-op without a tier)."""
+        roots = list(roots)
+        self._engine.kv_prehydrate(roots)
+        return len(roots)
 
     def check_health(self):
         if self._engine._thread is not None \

@@ -70,6 +70,12 @@ class _DeploymentState:
     # per replica, piggybacked onto get_replicas for handles
     router_stats: Dict[bytes, Any] = field(default_factory=dict)
     last_router_poll: float = 0.0
+    # replicas recently seen DEAD (rid, ts): piggybacked onto get_replicas
+    # so handle routers purge the corpse's stats/prefix homes immediately
+    # instead of waiting out RTPU_ROUTER_STALE_S (ISSUE 16)
+    dead_replicas: Any = field(default_factory=deque)
+    # KV-tier replication throttle: family root hex -> last prehydrate ts
+    kv_pushes: Dict[str, float] = field(default_factory=dict)
 
 
 @dataclass
@@ -98,7 +104,10 @@ def _engine_summary(engine: Optional[dict]) -> Optional[dict]:
             "prefill_tokens_saved": engine.get("prefill_tokens_saved"),
             "cow_copies": engine.get("cow_copies"),
             "evictions_cold_family": pc.get("evictions_cold_family"),
-            "evictions_hot_root_forced": pc.get("evictions_hot_root_forced")}
+            "evictions_hot_root_forced": pc.get("evictions_hot_root_forced"),
+            "kv_seals": engine.get("kv_seals"),
+            "kv_pulls": engine.get("kv_pulls"),
+            "kv_pull_fallbacks": engine.get("kv_pull_fallbacks")}
 
 
 def _actor_is_dead(handle) -> bool:
@@ -216,7 +225,11 @@ class ServeController:
                     "version": self._version,
                     "policy": getattr(ds.config, "request_router_policy",
                                       "pow2") or "pow2",
-                    "stats": stats}
+                    "stats": stats,
+                    # recent deaths (vs scale-downs): handles purge these
+                    # from router stats/prefix homes on refresh
+                    "dead": [rid for rid, ts in ds.dead_replicas
+                             if now - ts <= self._DEAD_TTL_S]}
 
     def report_no_replica(self, app_name: str, deployment: str,
                           queued: int = 1) -> str:
@@ -314,6 +327,7 @@ class ServeController:
                 ds.replicas = [r for r in ds.replicas if r not in dead]
                 for r in dead:
                     ds.health_failures.pop(r.actor_id, None)
+                    self._note_dead(ds, r.actor_id)
             changed = True
         # 2. poll replicas that are still starting (non-blocking — one slow
         #    init must not stall other deployments; the reference controller
@@ -439,6 +453,56 @@ class ServeController:
             merged.update(samples)
             ds.router_stats = merged
         self._publish_router_stats(ds, merged)
+        self._replicate_kv(ds, merged)
+
+    def _replicate_kv(self, ds: _DeploymentState,
+                      samples: Dict[bytes, Any]) -> None:
+        """KV-tier family replication (ISSUE 16): the engines' stats
+        samples carry per-family heat rows (kv_families); each of the
+        hottest families should be resident on ``RTPU_KV_REPLICAS``
+        replicas, so a single replica death never takes a hot family's
+        only warm copy.  Under-replicated families get a fire-and-forget
+        kv_prehydrate on replicas missing them — the replica pulls the
+        sealed spine from the store tier; replicas without a tier treat
+        it as a no-op.  Throttled per family root."""
+        import os
+
+        want = int(os.environ.get("RTPU_KV_REPLICAS", "2") or 2)
+        with self._lock:
+            replicas = list(ds.replicas)
+        if want <= 1 or len(replicas) < 2:
+            return
+        by_id = {r.actor_id: r for r in replicas}
+        holders: Dict[str, set] = {}
+        heat: Dict[str, int] = {}
+        for rid, payload in samples.items():
+            if rid not in by_id:
+                continue
+            engine = payload.get("engine") or {}
+            if engine.get("kv_tier") is None:
+                return  # deployment has no tier: nothing to replicate
+            for row in engine.get("kv_families") or []:
+                root = row.get("root")
+                if not root:
+                    continue
+                holders.setdefault(root, set()).add(rid)
+                heat[root] = max(heat.get(root, 0),
+                                 int(row.get("hits") or 0))
+        now = time.monotonic()
+        goal = min(want, len(by_id))
+        for root in sorted(heat, key=lambda r: -heat[r])[:8]:
+            have = holders.get(root, set())
+            if not have or len(have) >= goal:
+                continue
+            if now - ds.kv_pushes.get(root, 0.0) < 2.0:
+                continue
+            ds.kv_pushes[root] = now
+            targets = [r for rid, r in by_id.items() if rid not in have]
+            for r in targets[:goal - len(have)]:
+                try:
+                    r.kv_prehydrate.remote([root])
+                except Exception:  # noqa: BLE001 — replication is
+                    pass           # best-effort durability, not liveness
 
     def _publish_router_stats(self, ds: _DeploymentState,
                               samples: Dict[bytes, Any]) -> None:
@@ -605,6 +669,18 @@ class ServeController:
             ds.replicas = [r for r in ds.replicas if r not in to_replace]
             for r in to_replace:
                 ds.health_failures.pop(r.actor_id, None)
+                self._note_dead(ds, r.actor_id)
         for r in to_replace:
             self._kill_quiet(r)
         return True
+
+    _DEAD_TTL_S = 30.0  # how long a death stays in the get_replicas feed
+
+    def _note_dead(self, ds: _DeploymentState, rid: bytes) -> None:
+        """Record a replica death for the router purge feed (caller holds
+        _lock); its stale stats sample goes with it."""
+        ds.router_stats.pop(rid, None)
+        ds.dead_replicas.append((rid, time.monotonic()))
+        while (ds.dead_replicas and time.monotonic()
+               - ds.dead_replicas[0][1] > self._DEAD_TTL_S):
+            ds.dead_replicas.popleft()

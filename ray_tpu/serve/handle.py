@@ -120,6 +120,10 @@ class DeploymentHandle:
                             info.get("policy") or "pow2")
         router.update_replicas(info["replicas"])
         router.update_stats(info.get("stats") or {})
+        # replicas the controller saw DIE (vs scale down): purge their
+        # stats / prefix-tree homes NOW instead of letting a stale digest
+        # pin a dead home until RTPU_ROUTER_STALE_S expires
+        router.purge_dead(info.get("dead") or [])
         with self._lock:
             self._router = router
             self._version = info["version"]

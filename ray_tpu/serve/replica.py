@@ -9,6 +9,8 @@ signal), runs optional user health checks and reconfigure(user_config).
 from __future__ import annotations
 
 import inspect
+import os
+import random
 import threading
 import time as time_mod
 from typing import Any, Dict, Optional
@@ -108,7 +110,27 @@ class ReplicaActor:
     def ready(self) -> str:
         return "ok"
 
+    @staticmethod
+    def _maybe_chaos_kill() -> None:
+        """RTPU_TESTING_REPLICA_FAILURE chaos: '<kill%>' — each incoming
+        request kills this replica's whole process with kill% probability
+        (os._exit: no unwinding, exactly like a node OOM or preempted VM).
+        Drills the mid-burst death path end to end: the controller must
+        notice via the GCS actor table and replace the replica, handles
+        must fail over, the router must purge the corpse, and survivors
+        must pull its hot KV families from the store tier."""
+        spec = os.environ.get("RTPU_TESTING_REPLICA_FAILURE", "")
+        if not spec:
+            return
+        try:
+            pct = float(spec.split(":")[0])
+        except ValueError:
+            return
+        if random.random() * 100.0 < pct:
+            os._exit(1)
+
     def handle_request(self, method: str, args, kwargs):
+        self._maybe_chaos_kill()
         # Count the request as ongoing BEFORE resolving forwarded refs —
         # a composed request blocked on its upstream must still register as
         # load (drain + autoscaling read queue_len).
@@ -288,6 +310,18 @@ class ReplicaActor:
             except Exception:  # noqa: BLE001 — stats must never break lane
                 pass
         return out
+
+    def kv_prehydrate(self, roots) -> str:
+        """KV-tier replication fan-out (ISSUE 16): forward family roots
+        to the user callable when it exposes kv_prehydrate (LLMServer and
+        the P/D deployments do); a deployment without one is a no-op."""
+        fn = getattr(self._user, "kv_prehydrate", None)
+        if callable(fn):
+            try:
+                fn(list(roots))
+            except Exception:  # noqa: BLE001 — best-effort durability
+                pass
+        return "ok"
 
     def check_health(self) -> str:
         fn = getattr(self._user, "check_health", None)

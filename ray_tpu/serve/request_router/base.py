@@ -126,6 +126,26 @@ class RequestRouter:
             if best_rate is not None:
                 self._m["hit_rate"].set(best_rate, tags=self._mtags)
 
+    def purge_dead(self, rids: List[bytes]) -> None:
+        """Controller reported these replica ids DEAD: drop their stats
+        (and idle in-flight accounting) immediately.  update_replicas only
+        prunes when the replica list itself refreshes, so without this a
+        dead replica's last stats sample — fresh-looking for up to
+        RTPU_ROUTER_STALE_S — keeps winning digest-hit routing and pins
+        requests to a corpse until failover burns attempts on it."""
+        if not rids:
+            return
+        with self._lock:
+            dead = set(rids)
+            self._replicas = [r for r in self._replicas
+                              if r.actor_id not in dead]
+            for rid in dead:
+                self._stats.pop(rid, None)
+                if self._inflight.get(rid, 0) <= 0:
+                    # in-flight requests still settle through move/on_done;
+                    # only idle counters can be dropped outright
+                    self._inflight.pop(rid, None)
+
     def stats_for(self, rid: bytes) -> Optional[ReplicaStats]:
         with self._lock:
             st = self._stats.get(rid)

@@ -123,6 +123,13 @@ class PrefixAwareRouter(RequestRouter):
         for rid in gone:
             self.tree.forget(rid)
 
+    def purge_dead(self, rids: List[bytes]) -> None:
+        """Replica death: beyond the base purge (stats + in-flight), drop
+        the corpse's prefix-tree homes so no hint re-homes onto it."""
+        super().purge_dead(rids)
+        for rid in rids or ():
+            self.tree.forget(rid)
+
     def _overloaded(self, rid: bytes, reps: List) -> Optional[str]:
         """None when `rid` is an acceptable affinity home, else why not.
 

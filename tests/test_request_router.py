@@ -193,6 +193,36 @@ def test_departed_replica_forgotten():
     assert router.choose(hint) is survivor
 
 
+def test_purge_dead_evicts_stats_tree_and_routing():
+    """Replica DEATH (vs scale-down): purge_dead must drop the corpse's
+    stats sample, its prefix-tree homes, and the replica itself — a
+    fresh-looking digest sample would otherwise keep winning digest-hit
+    routing and pin requests to the corpse for up to RTPU_ROUTER_STALE_S
+    (update_replicas only prunes on a list refresh, which the handle's
+    cached replica set delays)."""
+    random.seed(5)
+    r1, r2 = FakeReplica(b"r1"), FakeReplica(b"r2")
+    router = _aware([r1, r2])
+    digest = "feedfacecafebeef"
+    hint = "doomed:" + "q" * 64
+    router.update_stats({r1.actor_id: {
+        "queue_len": 0, "engine": {"prefix_digests": [digest]}}})
+    router.tree.insert(hint, r1.actor_id)
+    assert router.choose(digest) is r1  # sanity: r1 owns both signals
+    assert router.choose(hint) is r1
+
+    router.purge_dead([r1.actor_id])
+
+    assert router.stats_for(r1.actor_id) is None
+    assert router.tree.count_for(r1.actor_id) == 0
+    # every signal that pointed at the corpse now lands on the survivor
+    for h in (digest, hint, None):
+        assert router.choose(h) is r2
+    # idle in-flight accounting dropped too; settled entries never go
+    # negative for a replica that no longer exists
+    assert r1.actor_id not in router._inflight
+
+
 # ------------------------------------------------------- stats staleness
 
 
