@@ -188,6 +188,15 @@ class DataServiceWorker:
         if kill_pct > 0 and random.random() * 100.0 < kill_pct:
             import os
 
+            try:
+                from ray_tpu.util import events
+
+                events.emit("chaos.data_kill", severity="error",
+                            message="RTPU_TESTING_DATA_FAILURE fired: "
+                                    "killing data worker",
+                            data={"pct": kill_pct}, flush=True)
+            except Exception:
+                pass
             os._exit(1)
 
     def run_chunk(self, job: str, epoch: int, chunk: int) -> dict:
@@ -677,6 +686,19 @@ class DataServiceCoordinator:
                     and now - job.last_spawn > 0.5):
                 self._spawn_worker(job)
                 job.backlog_ticks = 0
+                try:
+                    from ray_tpu.util import events
+
+                    events.emit(
+                        "data.scale_up",
+                        message=f"data job {job.name}: backlog {queued} > "
+                                f"free capacity; +1 worker "
+                                f"(now {len(job.workers)})",
+                        data={"job": job.name, "queued": queued,
+                              "workers": len(job.workers)},
+                        coalesce_s=1.0)
+                except Exception:
+                    pass
         else:
             job.backlog_ticks = 0
         if queued == 0 and len(job.workers) > job.min_workers:
@@ -687,6 +709,18 @@ class DataServiceCoordinator:
                 victim = idle[0]
                 job.workers.pop(victim.wid, None)
                 kills.append(victim.handle)
+                try:
+                    from ray_tpu.util import events
+
+                    events.emit(
+                        "data.scale_down",
+                        message=f"data job {job.name}: idle worker "
+                                f"released (now {len(job.workers)})",
+                        data={"job": job.name,
+                              "workers": len(job.workers)},
+                        coalesce_s=1.0)
+                except Exception:
+                    pass
         return kills
 
     def _poll_ctl(self):

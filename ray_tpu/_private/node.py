@@ -271,6 +271,9 @@ class Node:
         # per-scheduler wiring: in-process multi-node clusters must not
         # share (or hijack) one process-global exporter
         self.scheduler._event_exporter = self._event_exporter
+        # metrics_snapshot threads the store daemon's incarnation through
+        # as the counter-reset generation for cumulative store_* gauges
+        self.scheduler._store_server = self.store_server
         self.dashboard = None
         self.dashboard_url = None
         if head and include_dashboard and not os.environ.get(
@@ -320,6 +323,19 @@ class Node:
                 _store_restart_counter().inc()
             except Exception:
                 pass  # observability must never block recovery
+            try:
+                # straight into this node's bank — the supervisor thread
+                # has no worker context for the emit() flusher to use
+                self.scheduler.bank_events([{
+                    "kind": "store.daemon_restart", "severity": "error",
+                    "message": (f"store daemon exited rc={rc}; respawned "
+                                f"as incarnation "
+                                f"{self.store_server.incarnation}"),
+                    "data": {"exit_code": rc,
+                             "incarnation": self.store_server.incarnation},
+                }])
+            except Exception:
+                pass
             xfer_addr = ""
             if self.store_server.xfer_port:
                 xfer_addr = (f"{self.store_server.xfer_host}:"

@@ -86,8 +86,26 @@ def chaos_should_fail(method: str, direction: str) -> bool:
         if p and _chaos_rng.random() < p:
             if remaining > 0:
                 entry[0] = remaining - 1
+            _note_chaos_event(f"method {method} {direction}")
             return True
     return False
+
+
+def _note_chaos_event(detail: str) -> None:
+    """RTPU_TESTING_RPC_FAILURE injections go on the cluster event plane
+    so chaos-test incidents are attributable on the `rtpu events`
+    timeline.  Buffered + coalesced, never flushed inline: the flush
+    path itself traverses this transport (emit's thread-local guard
+    breaks the recursion; coalescing keeps frame-rate chaos to <=1
+    event/s on the wire)."""
+    try:
+        from ray_tpu.util import events
+
+        events.emit("chaos.rpc", severity="warning",
+                    message=f"injected RPC failure: {detail}",
+                    data={"detail": detail}, coalesce_s=1.0)
+    except Exception:
+        pass
 
 
 class ProtocolError(ConnectionError):
@@ -110,6 +128,7 @@ class Connection:
             # connection — a failure mode lease-less dispatch paths cannot
             # detect (the task would hang in in_flight forever).
             self.close()
+            _note_chaos_event("connection send")
             raise ConnectionResetError("rpc chaos: injected send failure")
         data = pickle.dumps(msg, protocol=5)
         frame = _LEN.pack(len(data)) + data
@@ -136,6 +155,7 @@ class Connection:
         if _CHAOS_RECV and _chaos_rng.random() < _CHAOS_RECV:
             # raise (not clean-EOF None): dispatch loops must hit their
             # error/crash-recovery paths, not their graceful-shutdown path
+            _note_chaos_event("connection recv")
             raise ConnectionResetError("rpc chaos: injected recv failure")
         header = self._recv_exact(_LEN.size)
         if header is None:
@@ -169,6 +189,7 @@ class Connection:
         Oversize frames raise ValueError (NOT None): None means the peer
         hung up and retrying is safe, which is false for oversize."""
         if _CHAOS_RECV and _chaos_rng.random() < _CHAOS_RECV:
+            _note_chaos_event("connection recv_raw")
             raise ConnectionResetError("rpc chaos: injected recv failure")
         header = self._recv_exact(_LEN.size)
         if header is None:

@@ -291,6 +291,18 @@ class Scheduler:
         # the memory plane): structured rows banked by the log monitor.
         self._log_ring: deque = deque(
             maxlen=max(1, int(flags.get("RTPU_LOG_RING_CAP"))))
+        # Cluster event plane (util/events.emit flushes here over the
+        # control socket, "events_push" — the incident lane of the same
+        # telemetry family): structured records banked in a capped ring,
+        # stamped with this node's id and a per-node monotonic seq so the
+        # head's sampler can drain incrementally ({"since_seq": cursor}).
+        self._events_ring: deque = deque(
+            maxlen=max(1, int(flags.get("RTPU_EVENTS_CAP"))))
+        self._events_seq = 0
+        self._events_lock = threading.Lock()
+        # Spill-decision event coalescing: at most one spill event per
+        # second rides the plane, carrying the suppressed count.
+        self._spill_evt = {"last": 0.0, "suppressed": 0}
         self._profiler_conns: dict[bytes, object] = {}
         self._profile_cv = threading.Condition(self._lock)
         self._profile_pending: dict[str, int] = {}  # stop replies awaited
@@ -746,6 +758,10 @@ class Scheduler:
             policy_mod.commit_spill(spec, target, self._cluster_nodes)
             if m is not None:
                 m["spill_remote"].inc()
+            try:
+                self._note_spill_event(target)
+            except Exception:
+                pass
         else:
             self._note_local_queue(spec)
             if m is not None:
@@ -1045,6 +1061,91 @@ class Scheduler:
         """Bank task-attributed worker-log rows for `rtpu logs` (the log
         monitor calls this on its own thread; deque append is atomic)."""
         self._log_ring.extend(rows)
+
+    def bank_events(self, events: list[dict]):
+        """Bank cluster-plane events (events_push lane, or direct calls
+        from in-process emitters like node.py's store supervisor).  Each
+        record gains this node's id and a per-node monotonic seq; the
+        file exporter (util/events.py) is forwarded every banked record —
+        it is one subscriber of the plane, not a parallel path."""
+        banked = []
+        with self._events_lock:
+            for ev in events or ():
+                if not isinstance(ev, dict):
+                    continue
+                rec = dict(ev)
+                rec.pop("_buffered", None)
+                rec.setdefault("ts", time.time())
+                rec.setdefault("kind", "unknown")
+                rec.setdefault("severity", "info")
+                rec.setdefault("message", "")
+                rec.setdefault("data", {})
+                rec.setdefault("trace_id", "")
+                rec["node_id"] = (self.node_id.hex()
+                                  if isinstance(self.node_id, bytes)
+                                  else str(self.node_id))
+                self._events_seq += 1
+                rec["seq"] = self._events_seq
+                self._events_ring.append(rec)
+                banked.append(rec)
+        exporter = getattr(self, "_event_exporter", None)
+        if exporter is not None:
+            for rec in banked:
+                try:
+                    exporter.export_cluster_event(rec)
+                except Exception:
+                    pass
+        return len(banked)
+
+    def _list_events(self, params: dict) -> list[dict]:
+        """Filtered view of this node's event ring.  Drains the
+        process-local emit() buffer first when this scheduler runs
+        without a driver/worker context (standalone node: no flusher
+        exists to deliver, so the read path does)."""
+        from ray_tpu.util import events as events_mod
+
+        pending = events_mod.take_buffered()
+        if pending:
+            self.bank_events(pending)
+        since_seq = int(params.get("since_seq") or 0)
+        since_ts = float(params.get("since_ts") or 0.0)
+        kind = params.get("kind") or ""
+        severity = params.get("severity") or ""
+        limit = int(params.get("limit") or 500)
+        out = []
+        with self._events_lock:
+            ring = list(self._events_ring)
+        for rec in ring:
+            if since_seq and rec.get("seq", 0) <= since_seq:
+                continue
+            if since_ts and rec.get("ts", 0.0) < since_ts:
+                continue
+            if kind and not str(rec.get("kind", "")).startswith(kind):
+                continue
+            if severity and rec.get("severity") != severity:
+                continue
+            out.append(dict(rec))
+        return out[-limit:]
+
+    def _note_spill_event(self, target) -> None:
+        """Spill decisions are hot; coalesce to <=1 event/s carrying the
+        count suppressed in between.  Called outside the scheduler lock."""
+        from ray_tpu.util import events as events_mod
+
+        now = time.time()
+        st = self._spill_evt
+        with self._events_lock:
+            if now - st["last"] < 1.0:
+                st["suppressed"] += 1
+                return
+            suppressed, st["suppressed"], st["last"] = (
+                st["suppressed"], 0, now)
+        tgt = target.hex() if isinstance(target, bytes) else str(target)
+        # emit() buffers; the flusher (driver ctx) or the _list_events
+        # drain (standalone node) delivers it to bank_events exactly once.
+        events_mod.emit(
+            "sched.spill", message=f"queue-time spillback -> {tgt[:12]}",
+            data={"target": tgt, "suppressed": suppressed})
 
     def _logs_search(self, params: dict) -> list[dict]:
         """Filtered view of the attributed log ring: task matches by task
@@ -2148,6 +2249,33 @@ class Scheduler:
             return True
         if method == "list_refs":
             return self._list_refs()
+        if method == "events_push":
+            # Cluster event plane (util/events.emit flusher; the head's
+            # SLO engine also pushes its alert transitions here).
+            self.bank_events(params.get("events") or [])
+            return True
+        if method == "list_events":
+            return self._list_events(params)
+        if method in ("query_timeseries", "slo_status", "tsdb_overview",
+                      "tsdb_stats"):
+            # Retained-signal plane: served by the head's MetricsSampler
+            # (dashboard/head.py), which registers itself as the global
+            # plane in the head scheduler's process.
+            from ray_tpu._private import tsdb as tsdb_mod
+
+            plane = tsdb_mod.global_plane()
+            if plane is None:
+                raise RuntimeError(
+                    "no retained-signal plane on this node (the head's "
+                    "dashboard sampler serves query_timeseries/slo_status;"
+                    " is RTPU_TSDB_SAMPLE_S > 0 and this the head?)")
+            if method == "query_timeseries":
+                return plane.query_timeseries(params)
+            if method == "slo_status":
+                return plane.slo_status()
+            if method == "tsdb_overview":
+                return plane.tsdb_overview(params)
+            return plane.tsdb_stats()
         if method == "store_audit":
             # Per-object store audit (size/seal/age/pins + occupancy and
             # fragmentation summary) straight from the shm daemon.
@@ -2189,6 +2317,12 @@ class Scheduler:
                 "store_num_objects": store.get("num_objects", 0),
                 "available": self._res_snapshot(),
                 "resources": dict(self.total_resources),
+                # Counter-reset generation (PR 1 incarnation): the TSDB
+                # keys cumulative store_* counters on this so a daemon
+                # restart reads as reset-to-zero, never a negative rate.
+                "store_incarnation": getattr(
+                    getattr(self, "_store_server", None),
+                    "incarnation", 0),
             }
             # Occupancy/fragmentation/eviction-pressure gauges from the
             # summary-only audit (max_rows=0: one tiny round trip, no
@@ -2209,6 +2343,12 @@ class Scheduler:
             except Exception:
                 pass
             app = list(sources.values())
+            # Parallel per-source ids (hex worker id / "driver") aligned
+            # with "app": the TSDB keys per-process series on these so two
+            # workers' identical counters never merge into one series.
+            app_sources = [
+                (k.hex() if isinstance(k, bytes) else str(k))
+                for k in sources.keys()]
             # A standalone node process (no driver/worker context in this
             # process) has nobody flushing ITS registry — the scheduler's
             # own queue-wait/depth instruments would be invisible.  Include
@@ -2222,7 +2362,9 @@ class Scheduler:
                 local = app_metrics.snapshot()
                 if local:
                     app.append(local)
-            return {"runtime": runtime, "app": app}
+                    app_sources.append("local")
+            return {"runtime": runtime, "app": app,
+                    "app_sources": app_sources}
         if method == "shutdown_node":
             # `rtpu stop`: only standalone `rtpu start` processes opt in
             # (reference parity: `ray stop` kills only `ray start` nodes,
@@ -2954,6 +3096,23 @@ class Scheduler:
             self.gcs.update_worker(worker.worker_id, {
                 "state": "DEAD", "end_ts": time.time(),
                 "exit_detail": "worker process exited"})
+        except Exception:
+            pass
+        try:
+            self.bank_events([{
+                "kind": "worker.oom_kill" if oom else "worker.death",
+                "severity": "error" if oom else "warning",
+                "message": (f"worker {worker.worker_id.hex()[:12]} "
+                            + ("killed by memory monitor" if oom
+                               else "died")),
+                "data": {
+                    "worker_id": worker.worker_id.hex(),
+                    "actor_id": dead_actor.hex() if dead_actor else "",
+                    "in_flight": len(in_flight),
+                    **({"rss": oom["rss"], "node_used": oom["used"]}
+                       if oom else {}),
+                },
+            }])
         except Exception:
             pass
 

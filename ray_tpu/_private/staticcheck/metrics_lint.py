@@ -12,7 +12,16 @@ Statically scans every ``Counter(...)`` / ``Gauge(...)`` /
   with one name either double-count or fight over kind/help);
 - every family the renderer hardcodes (``fam("…")``) carries the
   ``ray_tpu_`` prefix, and the renderer both emits ``# HELP``/``# TYPE``
-  and applies the prefix to pushed families.
+  and applies the prefix to pushed families;
+- SLO rules (any string literal in the tree parsing under
+  ``_private/slo.py``'s grammar — DEFAULT_RULES, test rules, smoke
+  rules) reference only families that exist: ctor-registered,
+  dict-literal-synthesized (``{"name": ..., "kind": ...}``, the
+  slo_burn_rate/slo_healthy path), or the TSDB's runtime ``node_*``
+  namespace — a rule over a typo'd family silently never fires;
+- the reverse direction: a ctor-registered family whose name appears in
+  no OTHER source/doc (no rule, dashboard, CLI, test, or README mention)
+  is flagged as unconsumed — it burns scrape bytes nobody judges.
 """
 
 from __future__ import annotations
@@ -102,6 +111,101 @@ def _scan_registrations(root: str, violations: list[Violation]):
                 "metrics/duplicate-family", rel, line,
                 f"family {name!r} is constructed at {len(where)} sites "
                 f"({locs}); register it once and share the instance"))
+    return sites
+
+
+def _scan_synthesized(root: str) -> set[str]:
+    """Families synthesized as push-shaped dict literals ({"name": N,
+    "kind": K, ...} — slo.py's status_metrics) rather than constructed:
+    real on the wire, so rules may reference them."""
+    names: set[str] = set()
+    for rel, src in walk_sources(root, (".py",)):
+        if "/staticcheck/" in rel:
+            continue
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Dict):
+                continue
+            keys = {k.value for k in node.keys
+                    if isinstance(k, ast.Constant)}
+            if "name" not in keys or "kind" not in keys:
+                continue
+            for k, v in zip(node.keys, node.values):
+                if (isinstance(k, ast.Constant) and k.value == "name"
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)):
+                    names.add(v.value)
+    return names
+
+
+def _scan_slo_rules(root: str, registered: set[str],
+                    violations: list[Violation]):
+    """Both directions of rule/registry agreement.
+
+    Forward: every family referenced by an SLO rule — any string literal
+    that parses under the rule grammar — must exist.  The TSDB's runtime
+    namespace (node_* gauges from metrics_snapshot, resource gauges) is
+    implicitly registered; everything else must be a ctor or synthesized
+    family.  Returns the set of rule-consumed families for the reverse
+    pass."""
+    from ray_tpu._private import slo as slo_mod
+
+    consumed: set[str] = set()
+    for rel, src in walk_sources(root, (".py",)):
+        if "/staticcheck/" in rel:
+            continue
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            for part in re.split(r"[;\n]", node.value):
+                m = slo_mod._RULE_RE.match(part.strip())
+                if not m:
+                    continue
+                try:
+                    rule = slo_mod.Rule(part)
+                except slo_mod.RuleError:
+                    continue
+                for fam in rule.families():
+                    consumed.add(fam)
+                    if fam in registered or fam.startswith("node_") \
+                            or fam.startswith("resource_"):
+                        continue
+                    violations.append(Violation(
+                        "metrics/slo-unknown-family", rel, node.lineno,
+                        f"SLO rule {rule.name!r} references family "
+                        f"{fam!r}, which no Counter/Gauge/Histogram "
+                        "registers and no push path synthesizes — the "
+                        "rule can never fire"))
+    return consumed
+
+
+def _scan_unconsumed(root: str, sites: dict, violations: list[Violation]):
+    """A ctor-registered family nobody mentions anywhere else (not a
+    rule, dashboard, CLI, test, or doc) is write-only telemetry."""
+    mentions: dict[str, set[str]] = {name: set() for name in sites}
+    for rel, src in walk_sources(root, (".py", ".md"), subdir=""):
+        if "/staticcheck/" in rel:
+            continue  # this checker + its allowlist don't count as use
+        for name in mentions:
+            if name in src:
+                mentions[name].add(rel)
+    for name, where in sorted(sites.items()):
+        rel, line, _ = where[0]
+        others = mentions[name] - {rel}
+        if not others:
+            violations.append(Violation(
+                "metrics/family-unconsumed", rel, line,
+                f"family {name!r} is registered here but consumed "
+                "nowhere — no SLO rule, dashboard, CLI, test, or doc "
+                "mentions it"))
 
 
 def _scan_renderer(root: str, violations: list[Violation]):
@@ -138,6 +242,9 @@ def _scan_renderer(root: str, violations: list[Violation]):
 
 def check(root: str) -> list[Violation]:
     violations: list[Violation] = []
-    _scan_registrations(root, violations)
+    sites = _scan_registrations(root, violations)
     _scan_renderer(root, violations)
+    registered = set(sites) | _scan_synthesized(root)
+    _scan_slo_rules(root, registered, violations)
+    _scan_unconsumed(root, sites, violations)
     return violations

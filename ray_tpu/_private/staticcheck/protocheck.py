@@ -34,6 +34,12 @@ every new opcode lands against these rules:
   one genuine injection read in a source file belonging to the lane
   its name claims (``RPC`` → protocol/direct/core_worker, ``STORE`` →
   the store daemon/clients, ``DATA`` → the data service).
+- ``proto/chaos-no-event`` — each chaos flag's lane must put the
+  injection on the cluster event plane: some genuine-read lane file
+  must call ``events.emit("chaos...")``.  An injection that emits no
+  event leaves kill-rung and chaos-test incidents unattributable on
+  the ``rtpu events`` timeline (C++-side injections satisfy this via a
+  Python-side observer of the injected effect, as the store lane does).
 
 All inputs come from the tree under ``root``; checks whose inputs are
 absent (no anchor, no ``.cc`` daemons, no Python clients) are skipped
@@ -178,6 +184,25 @@ def _const_strings(node: ast.AST):
             yield sub
 
 
+def _emits_chaos_event(tree: ast.AST) -> bool:
+    """Does this module call ``emit("chaos...")`` /
+    ``events.emit("chaos...")`` anywhere?  That call is what puts an
+    injection on the cluster event plane (events_push → head bank)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        label = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else "")
+        if label != "emit":
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str) \
+                and first.value.startswith("chaos"):
+            return True
+    return False
+
+
 def _lane_off_shape(if_node: ast.If) -> bool:
     """Does this ``if <chaos flag>:`` body disable a lane (latch a
     ``*_failed``/``*_disabled`` flag, report, and return None) rather
@@ -214,6 +239,7 @@ def check(root: str) -> list[Violation]:
     py_refs = _PyRefs()
     py_chaos: list[tuple[str, int, str]] = []        # rel, line, flag
     lane_off: list[tuple[str, int, str]] = []        # rel, line, flag
+    chaos_emit_files: set[str] = set()               # rel with emit("chaos…")
     scanned_py = False
     for rel, src in walk_sources(root, (".py",)):
         if rel == _ANCHOR_REL or rel.startswith(_SELF_DIR) \
@@ -235,6 +261,8 @@ def check(root: str) -> list[Violation]:
                         lane_off.append((rel, node.lineno, flag))
         for c in _const_strings(tree):
             py_chaos.append((rel, c.lineno, c.value))
+        if _emits_chaos_event(tree):
+            chaos_emit_files.add(rel)
     py_any = py_refs.compare | py_refs.plain
 
     # -- opcode / status / frame wiring ------------------------------------
@@ -321,4 +349,13 @@ def check(root: str) -> list[Violation]:
                 f"{flag} claims to test the '{token}' lane but has no "
                 f"injection read in any {'/'.join(lane_names)} source — "
                 "it cannot reach the path it names"))
+        elif genuine and not any(rel in chaos_emit_files
+                                 for rel, _ in genuine):
+            rel, line = min(genuine)
+            violations.append(Violation(
+                "proto/chaos-no-event", rel, line,
+                f"{flag} injects failure but no genuine-read file in its "
+                f"'{token}' lane calls emit(\"chaos…\") — injections never "
+                "reach the cluster event plane, so chaos incidents are "
+                "invisible on the rtpu events timeline"))
     return violations

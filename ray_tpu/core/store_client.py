@@ -461,6 +461,23 @@ class StoreClient:
                     _metrics()["reconnects"].inc()
                 except Exception:
                     pass  # metrics must never break recovery (teardown)
+                if os.environ.get("RTPU_TESTING_STORE_FAILURE"):
+                    # Chaos attribution for the store lane: the injection
+                    # itself lives in the C++ daemon (shm_store.cc), so
+                    # the Python-side observer of its effect — a forced
+                    # reconnect while the flag is armed — is what puts
+                    # the incident on the `rtpu events` timeline.
+                    try:
+                        from ray_tpu.util import events
+
+                        events.emit(
+                            "chaos.store", severity="warning",
+                            message=f"store connection lost during {what} "
+                                    "with RTPU_TESTING_STORE_FAILURE "
+                                    "armed",
+                            data={"op": what}, coalesce_s=1.0)
+                    except Exception:
+                        pass
                 if self._closed:
                     raise
                 now = time.monotonic()

@@ -15,6 +15,8 @@ import json
 import os
 import re
 import threading
+import time
+from collections import deque
 from typing import Optional
 
 from ray_tpu._private import protocol
@@ -43,6 +45,9 @@ _INDEX_HTML = """<!doctype html><title>ray_tpu dashboard API</title>
 <li><a href="/api/profile">/api/profile (CPU profiles; ?id=&lt;profile_id&gt;&amp;format=speedscope|folded|raw)</a></li>
 <li><a href="/api/goodput">/api/goodput (training goodput/step anatomy; ?run=&lt;name&gt; for one run)</a></li>
 <li><a href="/api/memory">/api/memory (cluster objects by creation call site, store occupancy, leak report)</a></li>
+<li><a href="/api/events">/api/events (cluster incident timeline; ?kind=&lt;prefix&gt;&amp;severity=&lt;s&gt;&amp;limit=&lt;n&gt;)</a></li>
+<li><a href="/api/timeseries">/api/timeseries (metrics history ring; ?family=&lt;name&gt;&amp;window=&lt;sec&gt;)</a></li>
+<li><a href="/api/slo">/api/slo (SLO rule table + burn rates)</a></li>
 <li><a href="/metrics">/metrics (Prometheus)</a></li>
 </ul>"""
 
@@ -226,6 +231,159 @@ def _render_prometheus(per_node: list[dict]) -> str:
     return "\n".join(lines) + "\n"
 
 
+class MetricsSampler:
+    """The retained-signal plane: head-side sampling thread that turns
+    point-in-time scrapes into queryable history and judged health.
+
+    Every ``RTPU_TSDB_SAMPLE_S`` it (1) polls each alive node's
+    ``metrics_snapshot`` into the ring TSDB (_private/tsdb.py), (2)
+    drains each node's banked cluster events (incremental, per-node seq
+    cursors) into one merged incident ring, (3) runs the SLO engine's
+    burn-rate tick — alert transitions are pushed back onto the event
+    plane (head scheduler bank: they hit the file exporter and the rings
+    like any other incident) with the nearest recent incident's trace id
+    stamped on a fire, and (4) exports current burn state as the
+    ``slo_burn_rate``/``slo_healthy`` gauges via a plain metrics_push.
+
+    Registers itself as tsdb.set_global_plane so the head scheduler's
+    control socket serves query_timeseries/slo_status/tsdb_overview/
+    tsdb_stats to the CLI and state API without HTTP in the loop.
+    """
+
+    def __init__(self, gcs, head_sched_socket: str):
+        from ray_tpu._private import flags
+        from ray_tpu._private import slo as slo_mod
+        from ray_tpu._private import tsdb as tsdb_mod
+
+        self._gcs = gcs
+        self._head_sock = head_sched_socket
+        self.sample_s = max(0.05, float(flags.get("RTPU_TSDB_SAMPLE_S")))
+        self.tsdb = tsdb_mod.TSDB(
+            points_per_series=max(2, int(flags.get("RTPU_TSDB_CAP"))),
+            max_series=max(1, int(flags.get("RTPU_TSDB_MAX_SERIES"))))
+        self.engine = slo_mod.SLOEngine(sample_s=self.sample_s)
+        self._events: deque = deque(
+            maxlen=max(1, int(flags.get("RTPU_EVENTS_CAP"))))
+        self._cursors: dict[str, int] = {}  # node hex -> last seq seen
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        tsdb_mod.set_global_plane(self)
+        self._thread = threading.Thread(
+            target=self._loop, name="metrics-sampler", daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(self.sample_s):
+            try:
+                self.tick()
+            except Exception:
+                pass  # a sick node or mid-shutdown GCS must not kill it
+
+    def tick(self, now: Optional[float] = None):
+        now = time.time() if now is None else now
+        nodes = []
+        try:
+            nodes = [(n.node_id.hex(), n.sched_socket)
+                     for n in self._gcs.list_nodes() if n.alive]
+        except Exception:
+            pass
+        for node_hex, sock in nodes:
+            try:
+                self.tsdb.ingest(_node_rpc(sock, "metrics_snapshot"), now)
+            except Exception:
+                pass
+            try:
+                evs = _node_rpc(sock, "list_events", {
+                    "since_seq": self._cursors.get(node_hex, 0)})
+            except Exception:
+                evs = []
+            if evs:
+                with self._lock:
+                    for ev in evs:
+                        self._cursors[node_hex] = max(
+                            self._cursors.get(node_hex, 0),
+                            int(ev.get("seq") or 0))
+                        self._events.append(ev)
+        transitions = self.engine.tick(self.tsdb, now)
+        for tr in transitions:
+            if tr["kind"] == "slo.fire":
+                self._correlate(tr)
+        if transitions:
+            try:
+                _node_rpc(self._head_sock, "events_push",
+                          {"events": transitions})
+            except Exception:
+                pass
+        from ray_tpu._private import slo as slo_mod
+
+        try:
+            _node_rpc(self._head_sock, "metrics_push", {
+                "source": b"slo-engine",
+                "metrics": slo_mod.status_metrics(self.engine.status())})
+        except Exception:
+            pass
+
+    def _correlate(self, alert: dict):
+        """Stamp a firing alert with the newest recent incident's trace id
+        so `rtpu events` links the event->alert pair into the trace tree."""
+        horizon = alert["ts"] - max(
+            30.0, self.engine.fast_window(
+                next((r for r in self.engine.rules
+                      if r.name == alert["data"]["rule"]), None)
+                or self.engine.rules[0]) * 2)
+        with self._lock:
+            recent = list(self._events)
+        for ev in reversed(recent):
+            if (ev.get("ts", 0) >= horizon
+                    and ev.get("trace_id")
+                    and ev.get("severity") in ("warning", "error",
+                                               "critical")
+                    and not str(ev.get("kind", "")).startswith("slo.")):
+                alert["trace_id"] = ev["trace_id"]
+                alert["data"]["correlated_event"] = {
+                    "kind": ev.get("kind"), "ts": ev.get("ts"),
+                    "node_id": ev.get("node_id"), "seq": ev.get("seq")}
+                return
+
+    # -- plane interface (scheduler control-socket delegation) -----------
+    def query_timeseries(self, params: dict) -> dict:
+        family = params.get("family") or ""
+        window_s = float(params.get("window_s") or 300.0)
+        if not family:
+            return {"families": self.tsdb.families()}
+        return {"family": family, "window_s": window_s,
+                "series": self.tsdb.query(family, window_s)}
+
+    def slo_status(self) -> dict:
+        status = self.engine.status()
+        status["sample_s"] = self.sample_s
+        return status
+
+    def tsdb_overview(self, params: dict) -> list:
+        return self.tsdb.overview(float(params.get("window_s") or 60.0))
+
+    def tsdb_stats(self) -> dict:
+        return self.tsdb.stats()
+
+    def merged_events(self, kind: str = "", severity: str = "",
+                      limit: int = 500) -> list[dict]:
+        with self._lock:
+            ring = list(self._events)
+        out = [dict(ev) for ev in ring
+               if (not kind or str(ev.get("kind", "")).startswith(kind))
+               and (not severity or ev.get("severity") == severity)]
+        out.sort(key=lambda e: e.get("ts", 0.0))
+        return out[-max(1, int(limit)):]
+
+    def shutdown(self):
+        from ray_tpu._private import tsdb as tsdb_mod
+
+        self._stop.set()
+        self._thread.join(timeout=5)
+        if tsdb_mod.global_plane() is self:
+            tsdb_mod.set_global_plane(None)
+
+
 class DashboardHead:
     """Serves on 127.0.0.1:<port> from a daemon thread with its own loop."""
 
@@ -244,6 +402,13 @@ class DashboardHead:
         self._started.wait(timeout=10)
         if self.url is None:
             raise RuntimeError("dashboard server failed to start")
+        # Retained-signal plane (TSDB + event ring + SLO engine); off
+        # when RTPU_TSDB_SAMPLE_S <= 0.
+        from ray_tpu._private import flags
+
+        self.sampler = None
+        if float(flags.get("RTPU_TSDB_SAMPLE_S")) > 0:
+            self.sampler = MetricsSampler(gcs, head_sched_socket)
 
     # -- data sources ------------------------------------------------------
     def _sched_socks(self) -> list[str]:
@@ -536,6 +701,33 @@ class DashboardHead:
                 continue
         return goodput_mod.merge_records(records)
 
+    def _events_rows(self, kind: str, severity: str, limit: int):
+        """Merged incident timeline.  With the sampler running this is
+        its (already drained + cap-bounded) ring; without it, fan in the
+        per-node banks directly."""
+        if getattr(self, "sampler", None) is not None:
+            return self.sampler.merged_events(kind, severity, limit)
+        rows = []
+        for sock in self._sched_socks():
+            try:
+                rows.extend(_node_rpc(sock, "list_events", {
+                    "kind": kind, "severity": severity, "limit": limit}))
+            except Exception:
+                continue
+        rows.sort(key=lambda e: e.get("ts", 0.0))
+        return rows[-max(1, limit):]
+
+    def _slo_api(self):
+        if getattr(self, "sampler", None) is None:
+            return {"error": "SLO engine disabled (RTPU_TSDB_SAMPLE_S=0)"}
+        return self.sampler.slo_status()
+
+    def _timeseries_api(self, family: str, window_s: float):
+        if getattr(self, "sampler", None) is None:
+            return {"error": "TSDB disabled (RTPU_TSDB_SAMPLE_S=0)"}
+        return self.sampler.query_timeseries(
+            {"family": family, "window_s": window_s})
+
     # -- server ------------------------------------------------------------
     def _run(self):
         from aiohttp import web
@@ -683,6 +875,35 @@ class DashboardHead:
             return web.Response(text=json.dumps(rec, default=str),
                                 content_type="application/json")
 
+        async def events(request):
+            # /api/events?kind=<prefix>&severity=<s>&limit=<n>
+            kind = request.query.get("kind") or ""
+            severity = request.query.get("severity") or ""
+            try:
+                limit = int(request.query.get("limit", "500"))
+            except ValueError:
+                limit = 500
+            rows = await loop.run_in_executor(
+                None, self._events_rows, kind, severity, limit)
+            return web.Response(text=json.dumps(rows, default=str),
+                                content_type="application/json")
+
+        async def timeseries(request):
+            # /api/timeseries                    -> known families
+            # /api/timeseries?family=F&window=N  -> in-window points
+            family = request.query.get("family") or ""
+            try:
+                window = float(request.query.get("window", "300"))
+            except ValueError:
+                window = 300.0
+            data = await loop.run_in_executor(
+                None, self._timeseries_api, family, window)
+            return web.Response(text=json.dumps(data, default=str),
+                                content_type="application/json")
+
+        app.router.add_get("/api/events", events)
+        app.router.add_get("/api/timeseries", timeseries)
+        app.router.add_get("/api/slo", json_handler(self._slo_api))
         app.router.add_get("/api/data/jobs", data_jobs)
         app.router.add_get("/api/traces", traces)
         app.router.add_get("/api/profile", profile)
@@ -713,6 +934,8 @@ class DashboardHead:
             loop.close()
 
     def shutdown(self):
+        if getattr(self, "sampler", None) is not None:
+            self.sampler.shutdown()
         if self._loop is not None and self._loop.is_running():
             self._loop.call_soon_threadsafe(self._loop.stop)
         self._thread.join(timeout=5)

@@ -768,6 +768,30 @@ class LLMEngine:
     def _kv_fallback(self, reason: str) -> None:
         self._stats["kv_pull_fallbacks"] += 1
         self._m["kv_pull_fallbacks"].inc(tags={"reason": reason})
+        try:
+            from ray_tpu.util import events
+
+            events.emit("kv.pull_fallback", severity="warning",
+                        message=f"KV tier pull fell back to cold prefill "
+                                f"({reason})",
+                        data={"reason": reason}, coalesce_s=1.0)
+        except Exception:
+            pass
+
+    def _note_kv_pull(self, pages: int) -> None:
+        self._stats["kv_pulls"] += 1
+        self._stats["kv_pull_pages"] += pages
+        self._m["kv_pulls"].inc()
+        self._m["kv_pull_pages"].inc(pages)
+        try:
+            from ray_tpu.util import events
+
+            events.emit("kv.pull",
+                        message=f"hydrated {pages} KV pages from the "
+                                f"store tier",
+                        data={"pages": pages}, coalesce_s=1.0)
+        except Exception:
+            pass
 
     def _extract_pages(self, pages: List[int]):
         """Host copies of the given pages' KV (seal extraction).  Runs on
@@ -811,10 +835,7 @@ class LLMEngine:
             return
         n = self._hydrate_spine(spine, kv_k, kv_v, limit_tokens=tokens)
         if n > 0:
-            self._stats["kv_pulls"] += 1
-            self._stats["kv_pull_pages"] += n
-            self._m["kv_pulls"].inc()
-            self._m["kv_pull_pages"].inc(n)
+            self._note_kv_pull(n)
 
     def _drain_hydrations(self) -> bool:
         """Scheduler-thread half of kv_prehydrate: pull queued family
@@ -838,10 +859,7 @@ class LLMEngine:
             n = self._hydrate_spine(spine, kv_k, kv_v)
             if n > 0:
                 did = True
-                self._stats["kv_pulls"] += 1
-                self._stats["kv_pull_pages"] += n
-                self._m["kv_pulls"].inc()
-                self._m["kv_pull_pages"].inc(n)
+                self._note_kv_pull(n)
         return did
 
     def _hydrate_spine(self, spine: List[int], kv_k, kv_v,
@@ -920,6 +938,15 @@ class LLMEngine:
         self._slots[i] = None
         self._stats["preempted"] += 1
         self._m["preempted"].inc()
+        try:
+            from ray_tpu.util import events
+
+            events.emit("llm.preempt",
+                        message="sequence evicted from its slot "
+                                "(recompute preemption)",
+                        data={"tokens": s.num_tokens}, coalesce_s=1.0)
+        except Exception:
+            pass
         self._waiting.queue.appendleft(req)  # type: ignore[attr-defined]
 
     def _shared_pages(self, s: _Slot) -> int:

@@ -513,6 +513,122 @@ def cmd_goodput(args):
                   f"{a['p90_ms']:8.1f}ms")
 
 
+_SEV_MARK = {"info": " ", "warning": "!", "error": "E", "critical": "C"}
+
+
+def cmd_events(args):
+    """Cluster incident timeline: every node's banked event-plane records
+    (store restarts, replica deaths, chaos injections, spill/scale
+    decisions, SLO alert transitions) merged and time-ordered, each with
+    its trace link when the incident happened under a trace."""
+    sock = find_address(args.address)
+    nodes = [n for n in _rpc(sock, "list_nodes") if n["alive"]]
+    rows = []
+    for n in nodes:
+        try:
+            rows.extend(_rpc(n["sched_socket"], "list_events", {
+                "kind": args.kind or "", "severity": args.severity or "",
+                "limit": args.limit}))
+        except Exception:
+            continue
+    rows.sort(key=lambda e: e.get("ts", 0.0))
+    rows = rows[-args.limit:]
+    print(f"======== Cluster events ({len(rows)}) ========")
+    for ev in rows:
+        ts = time.strftime("%H:%M:%S", time.localtime(ev.get("ts", 0)))
+        mark = _SEV_MARK.get(ev.get("severity", "info"), "?")
+        trace = ev.get("trace_id") or ""
+        link = f"  trace={trace[:16]}" if trace else ""
+        node = (ev.get("node_id") or "")[:8]
+        msg = ev.get("message") or ""
+        data = ev.get("data") or {}
+        corr = data.get("correlated_event")
+        extra = (f"  <- {corr['kind']}@{corr.get('node_id', '')[:8]}"
+                 if corr else "")
+        count = data.get("count")
+        if count and count > 1:
+            msg += f" (x{count})"
+        print(f"  {ts} {mark} {ev.get('kind', '?'):22s} "
+              f"[{node}] {msg}{extra}{link}")
+    if not rows:
+        print("  (none)")
+
+
+def cmd_slo(args):
+    """SLO rule table: objective, current value, fast/slow burn rates,
+    firing state (served by the head's sampler; see _private/slo.py for
+    the rule grammar and RTPU_SLO_RULES)."""
+    sock = find_address(args.address)
+    heads = [n for n in _rpc(sock, "list_nodes")
+             if n["alive"] and n["is_head"]]
+    if not heads:
+        sys.exit("no alive head node")
+    try:
+        status = _rpc(heads[0]["sched_socket"], "slo_status")
+    except RuntimeError as e:
+        sys.exit(str(e))
+    healthy = "HEALTHY" if status.get("healthy") else "BURNING"
+    print(f"======== SLOs: {healthy} "
+          f"(sampled every {status.get('sample_s', '?')}s) ========")
+    print(f"  {'rule':22s} {'objective':44s} {'value':>10s} "
+          f"{'fast':>7s} {'slow':>7s}  state")
+    for r in status.get("rules", []):
+        val = "-" if r["value"] is None else f"{r['value']:.4g}"
+        state = "FIRING" if r["firing"] else "ok"
+        if r["firing"] and r.get("since"):
+            state += f" {time.time() - r['since']:.0f}s"
+        if r.get("fired_total"):
+            state += f" (fired {r['fired_total']}x)"
+        print(f"  {r['rule']:22s} {r['objective']:44s} {val:>10s} "
+              f"{r['burn_fast']:7.2f} {r['burn_slow']:7.2f}  {state}")
+
+
+def cmd_top(args):
+    """Live windowed view over the head TSDB: one judged row per metric
+    family — counters as rates, histograms as rate + p50/p90, gauges as
+    latest/mean — over the last --window seconds."""
+    sock = find_address(args.address)
+    heads = [n for n in _rpc(sock, "list_nodes")
+             if n["alive"] and n["is_head"]]
+    if not heads:
+        sys.exit("no alive head node")
+    try:
+        rows = _rpc(heads[0]["sched_socket"], "tsdb_overview",
+                    {"window_s": args.window})
+        stats = _rpc(heads[0]["sched_socket"], "tsdb_stats")
+    except RuntimeError as e:
+        sys.exit(str(e))
+    print(f"======== rtpu top (window {args.window:g}s; "
+          f"{stats['series']} series, {stats['points']} points, "
+          f"~{stats['approx_bytes'] // 1024}KiB) ========")
+    print(f"  {'family':38s} {'kind':9s} {'value':>12s}  detail")
+    for row in rows:
+        fam, kind = row["family"], row["kind"]
+        if args.family and not fam.startswith(args.family):
+            continue
+        if kind == "counter":
+            rate = row.get("rate")
+            val = "-" if rate is None else f"{rate:.3f}/s"
+            by = row.get("by") or {}
+            detail = " ".join(f"{k}={v:g}/s" for k, v in
+                              list(by.items())[:3] if k != "-")
+        elif kind == "histogram":
+            rate = row.get("rate")
+            val = "-" if rate is None else f"{rate:.3f}/s"
+            p50, p90 = row.get("p50"), row.get("p90")
+            detail = (f"p50={p50:.4g} p90={p90:.4g}"
+                      if p50 is not None and p90 is not None else "")
+        else:
+            v = row.get("value")
+            val = "-" if v is None else f"{v:.4g}"
+            mean = row.get("mean")
+            detail = f"mean={mean:.4g}" if mean is not None else ""
+        print(f"  {fam:38s} {kind:9s} {val:>12s}  {detail}")
+    if not rows:
+        print("  (TSDB empty — is the head sampler on? "
+              "RTPU_TSDB_SAMPLE_S must be > 0)")
+
+
 def cmd_comm(args):
     """Analytic per-axis collective-volume estimate for a dense LM step
     (ray_tpu/parallel/comm.py) — the ICI comm bound, no cluster needed."""
@@ -913,6 +1029,24 @@ def main(argv=None):
                     help="run name to inspect (omit to list known runs)")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_goodput)
+    sp = sub.add_parser("events")
+    sp.add_argument("--kind", default=None,
+                    help='filter by kind prefix (e.g. "chaos.", "slo.")')
+    sp.add_argument("--severity", default=None,
+                    help="filter: info|warning|error|critical")
+    sp.add_argument("--limit", type=int, default=200)
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_events)
+    sp = sub.add_parser("slo")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_slo)
+    sp = sub.add_parser("top")
+    sp.add_argument("--window", type=float, default=60.0,
+                    help="aggregation window in seconds (default 60)")
+    sp.add_argument("--family", default=None,
+                    help="filter metric families by prefix")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_top)
     sp = sub.add_parser("comm")
     sp.add_argument("--model", default=None,
                     help="model preset (gpt2_124m, llama3_8b, "

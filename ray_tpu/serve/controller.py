@@ -36,6 +36,26 @@ from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.replica import ReplicaActor
 
 
+_DEATH_COUNTER = None
+
+
+def _death_counter():
+    """Lazy (the controller actor registers it on first replica death;
+    idle control planes register nothing): the deterministic signal SLO
+    death-rate rules key on, e.g.
+    ``rate(serve_replica_deaths_total, 1m) < 0.01``."""
+    global _DEATH_COUNTER
+    if _DEATH_COUNTER is None:
+        from ray_tpu.util.metrics import Counter
+
+        _DEATH_COUNTER = Counter(
+            "serve_replica_deaths_total",
+            description="Serve replicas observed dead and purged from "
+                        "routing (controller _note_dead)",
+            tag_keys=("app", "deployment"))
+    return _DEATH_COUNTER
+
+
 @dataclass
 class _DeploymentState:
     name: str
@@ -678,9 +698,28 @@ class ServeController:
 
     def _note_dead(self, ds: _DeploymentState, rid: bytes) -> None:
         """Record a replica death for the router purge feed (caller holds
-        _lock); its stale stats sample goes with it."""
+        _lock); its stale stats sample goes with it.  The death also goes
+        on the cluster event plane (buffered emit — never a synchronous
+        push under _lock) and bumps serve_replica_deaths_total, the
+        counter SLO death-rate rules key on."""
         ds.router_stats.pop(rid, None)
         ds.dead_replicas.append((rid, time.monotonic()))
         while (ds.dead_replicas and time.monotonic()
                - ds.dead_replicas[0][1] > self._DEAD_TTL_S):
             ds.dead_replicas.popleft()
+        try:
+            _death_counter().inc(tags={"app": ds.app_name,
+                                       "deployment": ds.name})
+        except Exception:
+            pass
+        try:
+            from ray_tpu.util import events
+
+            events.emit(
+                "serve.replica_dead", severity="warning",
+                message=f"replica {rid.hex()[:12]} of "
+                        f"{ds.app_name}/{ds.name} died; router purged",
+                data={"app": ds.app_name, "deployment": ds.name,
+                      "replica": rid.hex()})
+        except Exception:
+            pass

@@ -127,6 +127,17 @@ class ReplicaActor:
         except ValueError:
             return
         if random.random() * 100.0 < pct:
+            try:
+                from ray_tpu.util import events
+
+                # flush=True: the push must beat the os._exit below —
+                # the incident record is the only trace this death leaves
+                events.emit("chaos.replica_kill", severity="error",
+                            message="RTPU_TESTING_REPLICA_FAILURE fired: "
+                                    "killing replica process",
+                            data={"pct": pct}, flush=True)
+            except Exception:
+                pass
             os._exit(1)
 
     def handle_request(self, method: str, args, kwargs):

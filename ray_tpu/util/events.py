@@ -7,12 +7,30 @@ JSONL files an external system can tail — one record per line, stable
 ``type``/``ts``/``data`` envelope.
 
 Enable by pointing ``RTPU_EXPORT_EVENTS`` at a directory (the head node
-starts the exporter).  Three files are written there:
+starts the exporter).  Four files are written there:
 
-- ``actor_events.jsonl`` — every actor state transition (from GCS pubsub)
-- ``node_events.jsonl``  — node alive/dead transitions
-- ``task_events.jsonl``  — task lifecycle records (exported by each
+- ``actor_events.jsonl``   — every actor state transition (from GCS pubsub)
+- ``node_events.jsonl``    — node alive/dead transitions
+- ``task_events.jsonl``    — task lifecycle records (exported by each
   node's scheduler as tasks finish)
+- ``cluster_events.jsonl`` — the cluster event plane (below): the file
+  exporter is ONE SUBSCRIBER of that plane (the scheduler forwards every
+  banked event here), not a parallel path
+
+Cluster event plane
+-------------------
+``emit()`` records a structured in-cluster incident — store-daemon
+restarts, replica deaths, KV tier pulls/fallbacks, spill decisions,
+preemptions, data-worker scale actions, every ``RTPU_TESTING_*`` chaos
+injection — stamped with the current trace id when one is attached, so
+incidents link into the trace tree.  Records buffer process-locally and a
+background flusher pushes them to the node scheduler over the control
+socket ("events_push", the incident lane next to metrics_push/spans_push/
+goodput_push); the scheduler banks them in a capped ring
+(``RTPU_EVENTS_CAP``) that ``rtpu events`` / ``state.list_events`` /
+``/api/events`` read and the head's sampler drains.  Severity "error"/
+"critical" (and ``flush=True`` — chaos sites that ``os._exit``) push
+synchronously so the incident survives the process it describes.
 """
 
 from __future__ import annotations
@@ -73,6 +91,11 @@ class ExportEventLogger:
     def export_task_event(self, record: dict):
         """Called by the scheduler (under its lock): enqueue only."""
         self._queue.put(("task", record))
+
+    def export_cluster_event(self, record: dict):
+        """Cluster-event-plane subscription (scheduler bank_events):
+        enqueue only — the bank is called from RPC reader threads."""
+        self._queue.put(("cluster", record))
 
     def _writer_loop(self):
         import queue as queue_mod
@@ -179,3 +202,131 @@ def start_exporter(gcs_address: str,
 
 def get_exporter() -> Optional[ExportEventLogger]:
     return _exporter
+
+
+# -- cluster event plane (events_push lane) ------------------------------
+
+_EV_BUF_MAX = 512  # process-local backlog; oldest dropped past this
+_ev_lock = threading.Lock()
+_ev_buf: list[dict] = []
+_ev_recent: dict[str, list] = {}  # kind -> [ts, record] for coalescing
+_ev_flusher_started = False
+_ev_flush_stop = threading.Event()
+_ev_tls = threading.local()
+
+
+def emit(kind: str, message: str = "", severity: str = "info",
+         data: Optional[dict] = None, trace_id: Optional[str] = None,
+         flush: bool = False, coalesce_s: float = 0.0) -> dict:
+    """Record one structured cluster event (see module docstring).
+
+    coalesce_s > 0 merges a repeat of the same kind arriving within the
+    window into the buffered record's ``count`` instead of appending —
+    hot emitters (spills, preemptions, chaos frame drops) must not flood
+    the ring or the control socket.  flush=True (and severity error/
+    critical) pushes synchronously; everything else rides the background
+    flusher.  Best-effort by design: with no driver/worker context the
+    record waits in the process buffer until the node scheduler drains it
+    (list_events / sample tick) or the process dies.
+    """
+    now = time.time()
+    if trace_id is None:
+        try:
+            from ray_tpu.util import tracing
+
+            ctx = tracing.current_context()
+            trace_id = ctx[0] if ctx else ""
+        except Exception:
+            trace_id = ""
+    rec = {"ts": now, "kind": str(kind), "severity": str(severity),
+           "message": str(message), "data": dict(data or {}),
+           "pid": os.getpid(), "trace_id": trace_id or ""}
+    with _ev_lock:
+        if coalesce_s > 0:
+            recent = _ev_recent.get(rec["kind"])
+            if (recent is not None and now - recent[0] < coalesce_s
+                    and recent[1].get("_buffered")):
+                merged = recent[1]
+                merged["data"]["count"] = merged["data"].get("count", 1) + 1
+                merged["ts"] = now
+                return merged
+            _ev_recent[rec["kind"]] = [now, rec]
+        rec["_buffered"] = True
+        _ev_buf.append(rec)
+        if len(_ev_buf) > _EV_BUF_MAX:
+            dropped = _ev_buf[:len(_ev_buf) - _EV_BUF_MAX]
+            del _ev_buf[:len(_ev_buf) - _EV_BUF_MAX]
+            for r in dropped:
+                r.pop("_buffered", None)
+    _ensure_ev_flusher()
+    if flush or severity in ("error", "critical"):
+        flush_events()
+    return rec
+
+
+def flush_events() -> None:
+    """Push buffered events to the node scheduler now (best-effort; no-op
+    without a driver/worker context).  Reentrancy-guarded: the push itself
+    may traverse chaos-instrumented transport code that emits."""
+    if getattr(_ev_tls, "flushing", False):
+        return
+    from ray_tpu._private import worker as worker_mod
+
+    ctx = worker_mod.global_worker_or_none()
+    if ctx is None:
+        return
+    with _ev_lock:
+        if not _ev_buf:
+            return
+        batch = list(_ev_buf)
+        del _ev_buf[:]
+        for r in batch:
+            r.pop("_buffered", None)
+    _ev_tls.flushing = True
+    try:
+        ctx.rpc("events_push", {"events": batch})
+    except Exception:
+        pass  # node shutting down; events are best-effort
+    finally:
+        _ev_tls.flushing = False
+
+
+def take_buffered() -> list[dict]:
+    """Drain the process-local buffer for direct banking — called by a
+    scheduler running in a process WITHOUT a driver/worker context (a
+    standalone `rtpu start` node), where no flusher can deliver.  With a
+    context present this returns [] and the flusher keeps ownership."""
+    from ray_tpu._private import worker as worker_mod
+
+    if worker_mod.global_worker_or_none() is not None:
+        return []
+    with _ev_lock:
+        batch = list(_ev_buf)
+        del _ev_buf[:]
+        for r in batch:
+            r.pop("_buffered", None)
+    return batch
+
+
+def _ev_flush_interval() -> float:
+    from ray_tpu._private import flags
+
+    return max(0.25, float(flags.get("RTPU_METRICS_FLUSH_S")))
+
+
+def _ensure_ev_flusher() -> None:
+    global _ev_flusher_started
+    with _ev_lock:
+        if _ev_flusher_started:
+            return
+        _ev_flusher_started = True
+    threading.Thread(target=_ev_flush_loop, name="events-flush",
+                     daemon=True).start()
+
+
+def _ev_flush_loop() -> None:
+    while not _ev_flush_stop.wait(_ev_flush_interval()):
+        try:
+            flush_events()
+        except Exception:
+            pass

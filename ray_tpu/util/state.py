@@ -632,6 +632,58 @@ def get_goodput(run: str) -> Optional[Dict[str, Any]]:
     return goodput.merge_records(records)
 
 
+def list_events(kind: str = "", severity: str = "",
+                limit: int = 500) -> List[Dict[str, Any]]:
+    """Cluster incident timeline (the event plane): every node's banked
+    events merged and time-ordered — store-daemon restarts, replica
+    deaths, chaos injections, spill/scale decisions, SLO alert
+    transitions.  ``kind`` filters by prefix (e.g. "chaos."), each row
+    carries its trace_id when the incident happened under a trace."""
+    from ray_tpu.util import events as events_mod
+
+    events_mod.flush_events()  # the driver's own buffered events first
+    rows: List[dict] = []
+    for n in _alive_nodes():
+        try:
+            rows.extend(_node_rpc(n["sched_socket"], "list_events", {
+                "kind": kind, "severity": severity, "limit": limit}))
+        except (OSError, RuntimeError):
+            continue
+    rows.sort(key=lambda e: e.get("ts", 0.0))
+    return rows[-max(1, int(limit)):]
+
+
+def _head_sock() -> str:
+    for n in _alive_nodes():
+        if n["is_head"]:
+            return n["sched_socket"]
+    raise RuntimeError("no alive head node")
+
+
+def query_timeseries(family: str = "",
+                     window_s: float = 300.0) -> Dict[str, Any]:
+    """Windowed history from the head's ring TSDB: no ``family`` lists
+    the known families; with one, the in-window raw points per series
+    (same shape as the dashboard's /api/timeseries)."""
+    return _node_rpc(_head_sock(), "query_timeseries",
+                     {"family": family, "window_s": window_s})
+
+
+def slo_status() -> Dict[str, Any]:
+    """The SLO engine's rule table: per-rule current value, fast/slow
+    burn rates, firing state — plus the aggregate ``healthy`` bit the
+    autoscaler consumes (same shape as /api/slo)."""
+    return _node_rpc(_head_sock(), "slo_status")
+
+
+def tsdb_overview(window_s: float = 60.0) -> List[Dict[str, Any]]:
+    """One judged row per metric family over the window (what `rtpu top`
+    renders): counters as rates, histograms as rate+p50/p90, gauges as
+    latest/mean."""
+    return _node_rpc(_head_sock(), "tsdb_overview",
+                     {"window_s": window_s})
+
+
 def record_profile(duration: float = 5.0, hz: float = 99.0,
                    profile_id: Optional[str] = None,
                    ) -> Optional[Dict[str, Any]]:

@@ -1,0 +1,470 @@
+"""Metrics history ring (TSDB), SLO burn-rate engine, and the cluster
+event plane (reference: the dashboard's Prometheus/Grafana metrics
+history + alerting stack, dashboard/modules/metrics/).
+
+Three layers:
+- pure unit tests over ``_private/tsdb.py`` (ring eviction, windowed
+  math vs. hand-computed values, counter-reset/generation handling) and
+  ``_private/slo.py`` (rule grammar, burn math, fire/clear hysteresis);
+- a single-node live run covering the sampler plane end to end: events
+  banking + cap, HTTP API shapes, and the store-daemon SIGKILL
+  counter-reset regression (windowed rates must never go negative);
+- a two-node serve run with RTPU_TESTING_REPLICA_FAILURE armed,
+  asserting the full correlated incident: replica kill -> chaos event
+  -> fast-window SLO alert within a sample period, linked by trace id.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ray_tpu._private import slo as slo_mod
+from ray_tpu._private.tsdb import TSDB
+
+
+def _snap(metrics, node=b"\x01" * 8, runtime=None, source="w1"):
+    """Build a metrics_snapshot document from push-shaped app metrics."""
+    rt = {"node_id": node}
+    rt.update(runtime or {})
+    return {"runtime": rt, "app": [metrics], "app_sources": [source]}
+
+
+def _gauge(name, value, tags=()):
+    return {"name": name, "kind": "gauge", "tag_keys": tuple(
+        k for k, _ in tags), "values": {tuple(v for _, v in tags): value}}
+
+
+def _counter(name, value):
+    return {"name": name, "kind": "counter", "tag_keys": (),
+            "values": {(): value}}
+
+
+def _hist(name, bounds, vec):
+    return {"name": name, "kind": "histogram", "tag_keys": (),
+            "boundaries": tuple(bounds), "hist": {(): list(vec)}}
+
+
+# ---------------------------------------------------------------------------
+# TSDB unit
+
+
+def test_ring_evicts_oldest_points():
+    db = TSDB(points_per_series=8)
+    for i in range(20):
+        db.ingest(_snap([_gauge("g", float(i))]), ts=float(i))
+    series = db.query("g", window_s=1e9, now=25.0)
+    assert len(series) == 1
+    pts = series[0]["points"]
+    assert len(pts) == 8
+    # oldest 12 points fell off the ring; the newest 8 survive in order
+    assert [p[0] for p in pts] == [float(i) for i in range(12, 20)]
+
+
+def test_max_series_evicts_lru():
+    db = TSDB(points_per_series=16, max_series=4)
+    for i in range(7):
+        db.ingest(_snap([_gauge(f"fam_{i}", 1.0)]), ts=float(i))
+    st = db.stats()
+    assert st["series"] <= 4 + 1  # +1: the node_resource-free runtime adds 0
+    # the first-created app series are gone, the newest survive
+    assert db.query("fam_0", 1e9, now=10.0) == []
+    assert len(db.query("fam_6", 1e9, now=10.0)) == 1
+
+
+def test_windowed_rate_matches_hand_computed():
+    db = TSDB()
+    # 10 units/s for 10 samples: raw 0, 10, 20, ... 90 at ts 0..9
+    for i in range(10):
+        db.ingest(_snap([_counter("c", 10.0 * i)]), ts=float(i))
+    # window [4, 9]: baseline is the point at ts=4 (40), latest 90
+    assert db.rate("c", window_s=5.0, now=9.0) == pytest.approx(50.0 / 5.0)
+    # whole history: 90 over 9s, but window_s=9 divides by 9
+    assert db.rate("c", window_s=9.0, now=9.0) == pytest.approx(10.0)
+    # unknown family is None (not 0): callers distinguish absent from idle
+    assert db.rate("nope", 5.0, now=9.0) is None
+
+
+def test_counter_reset_same_source_never_negative():
+    db = TSDB()
+    for ts, v in [(0, 100.0), (1, 110.0), (2, 5.0), (3, 15.0)]:
+        db.ingest(_snap([_counter("c", v)]), ts=float(ts))
+    # raw dropped 110 -> 5 (a restart): adjusted must stay monotone
+    pts = db.query("c", 1e9, now=10.0)[0]["points"]
+    vals = [p[1] for p in pts]
+    assert vals == sorted(vals)
+    assert vals[-1] == pytest.approx(110.0 + 15.0)
+    assert db.rate("c", window_s=4.0, now=3.0) >= 0.0
+
+
+def test_counter_generation_bump_counts_fresh_increments():
+    db = TSDB()
+    # runtime store_* counters carry the daemon incarnation as generation
+    for ts, v, gen in [(0, 100.0, 0), (1, 110.0, 0),
+                       (2, 3.0, 1), (3, 9.0, 1)]:
+        db.ingest(_snap([], runtime={"store_evictions_total": v,
+                                     "store_incarnation": gen}),
+                  ts=float(ts))
+    pts = db.query("node_store_evictions_total", 1e9, now=10.0)[0]["points"]
+    vals = [p[1] for p in pts]
+    assert vals == [100.0, 110.0, 113.0, 119.0]
+    assert db.rate("node_store_evictions_total", 3.0, now=3.0) \
+        == pytest.approx((119.0 - 100.0) / 3.0)
+
+
+def test_counter_same_generation_decrease_clamps_to_zero_delta():
+    db = TSDB()
+    for ts, v in [(0, 50.0), (1, 40.0), (2, 45.0)]:
+        db.ingest(_snap([], runtime={"store_evictions_total": v,
+                                     "store_incarnation": 7}),
+                  ts=float(ts))
+    vals = [p[1] for p in
+            db.query("node_store_evictions_total", 1e9, now=9.0)[0]["points"]]
+    # a decrease WITHIN one incarnation is a bug, not a restart: the drop
+    # contributes zero, later genuine increments still count
+    assert vals == [50.0, 50.0, 55.0]
+
+
+def test_histogram_quantile_and_rate():
+    db = TSDB()
+    bounds = (1.0, 2.0)
+    # vec = [count in (0,1], count in (1,2], +inf count, sum] — per-bucket
+    # counts, matching util.metrics.Histogram.observe
+    db.ingest(_snap([_hist("h", bounds, [0, 0, 0, 0.0])]), ts=0.0)
+    db.ingest(_snap([_hist("h", bounds, [10, 10, 0, 25.0])]), ts=10.0)
+    # 10 obs in (0,1], 10 in (1,2]: p50 at the top of bucket 1
+    assert db.quantile("h", 0.5, 20.0, now=10.0) == pytest.approx(1.0)
+    # p75: target 15 of 20 -> halfway through bucket 2
+    assert db.quantile("h", 0.75, 20.0, now=10.0) == pytest.approx(1.5)
+    # observation rate = count delta / window (sum slot excluded)
+    assert db.rate("h", 10.0, now=10.0) == pytest.approx(20.0 / 10.0)
+
+
+def test_gauge_window_aggregation():
+    db = TSDB()
+    for ts, v in [(0, 1.0), (5, 3.0), (9, 2.0)]:
+        db.ingest(_snap([_gauge("g", v)]), ts=float(ts))
+    assert db.gauge_agg("g", 10.0, "mean", now=9.0) == pytest.approx(2.0)
+    assert db.gauge_agg("g", 10.0, "max", now=9.0) == 3.0
+    assert db.gauge_agg("g", 10.0, "latest", now=9.0) == 2.0
+    # window excludes the first point
+    assert db.gauge_agg("g", 5.0, "mean", now=9.0) == pytest.approx(2.5)
+
+
+def test_stats_reports_bounded_memory():
+    db = TSDB(points_per_series=64, max_series=8)
+    for i in range(200):
+        db.ingest(_snap([_gauge("g", float(i))]), ts=float(i))
+    st = db.stats()
+    assert st["points"] <= st["cap_points"] == 64 * 8
+    assert st["ingested"] == 200
+    assert st["approx_bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# SLO rules + burn engine unit
+
+
+def test_rule_grammar():
+    r = slo_mod.Rule("err: rate(errs_total, 1m) / rate(reqs_total, 1m)"
+                     " < 0.01")
+    assert r.name == "err" and r.window_s == 60.0
+    assert r.families() == ["errs_total", "reqs_total"]
+    r2 = slo_mod.Rule("lat: p99.9(lat_s, 30s) < 2")
+    assert r2.num.func == "p99.9" and r2.num.window_s == 30.0
+    r3 = slo_mod.Rule("up: some_gauge > 0.5")  # bare = latest(family, 1m)
+    assert r3.num.func == "latest" and r3.window_s == 60.0
+    with pytest.raises(slo_mod.RuleError):
+        slo_mod.Rule("not a rule at all")
+
+
+def test_rule_env_overlay(monkeypatch):
+    monkeypatch.setenv(
+        "RTPU_SLO_RULES",
+        "llm_ttft_p90: p90(llm_ttft_s, 1m) < 9.9; broken rule;"
+        "extra: mean(train_goodput_fraction, 1m) > 0.5")
+    rules = {r.name: r for r in slo_mod.load_rules()}
+    assert rules["llm_ttft_p90"].threshold == 9.9  # same-name replaces
+    assert "extra" in rules                        # new rule appended
+    assert len(rules) == len(slo_mod.DEFAULT_RULES) + 1  # bad rule skipped
+
+
+def test_burn_math():
+    lt = slo_mod.Rule("r: mean(g, 1m) < 2.0")
+    assert lt.burn(1.0) == pytest.approx(0.5)
+    assert lt.burn(4.0) == pytest.approx(2.0)
+    assert lt.burn(None) is None
+    gt = slo_mod.Rule("r: mean(g, 1m) > 0.9")
+    assert gt.burn(0.45) == pytest.approx(2.0)
+    assert gt.burn(0.0) == float("inf")
+
+
+def test_slo_engine_fire_and_clear_hysteresis():
+    db = TSDB()
+    rule = slo_mod.Rule("q: mean(g, 10s) < 1.0")
+    eng = slo_mod.SLOEngine([rule], sample_s=1.0, clear_ticks=3)
+    ts = 0.0
+    # healthy feed: no transitions
+    for _ in range(5):
+        db.ingest(_snap([_gauge("g", 0.5)]), ts=ts)
+        assert eng.tick(db, now=ts) == []
+        ts += 1.0
+    # breach: both fast (2s) and slow (10s) windows must burn before the
+    # alert lands — the first bad sample alone already pushes both means
+    fired = []
+    for _ in range(3):
+        db.ingest(_snap([_gauge("g", 5.0)]), ts=ts)
+        fired += eng.tick(db, now=ts)
+        ts += 1.0
+    assert [t["kind"] for t in fired] == ["slo.fire"]
+    assert fired[0]["severity"] == "error"
+    assert fired[0]["data"]["rule"] == "q"
+    assert eng.status()["healthy"] is False
+    # recovery: fast burn drops below clear_ratio, but the alert must hold
+    # through clear_ticks-1 good ticks (hysteresis) before clearing
+    cleared = []
+    for i in range(6):
+        db.ingest(_snap([_gauge("g", 0.1)]), ts=ts)
+        cleared += eng.tick(db, now=ts)
+        if i < 2:
+            assert cleared == [], f"cleared too early at tick {i}"
+        ts += 1.0
+    assert [t["kind"] for t in cleared] == ["slo.clear"]
+    assert eng.status()["healthy"] is True
+    st = eng.status()["rules"][0]
+    assert st["fired_total"] == 1 and st["firing"] is False
+
+
+def test_slo_no_data_burns_zero():
+    db = TSDB()  # empty: every term evaluates to None
+    eng = slo_mod.SLOEngine([slo_mod.Rule("q: mean(g, 10s) < 1.0")],
+                            sample_s=1.0)
+    assert eng.tick(db, now=0.0) == []
+    assert eng.status()["healthy"] is True
+
+
+def test_status_metrics_push_shape():
+    eng = slo_mod.SLOEngine([slo_mod.Rule("q: mean(g, 10s) < 1.0")],
+                            sample_s=1.0)
+    eng.tick(TSDB(), now=0.0)
+    fams = {m["name"]: m for m in slo_mod.status_metrics(eng.status())}
+    assert set(fams) == {"slo_burn_rate", "slo_healthy"}
+    assert fams["slo_burn_rate"]["values"][("q", "fast")] == 0.0
+    assert fams["slo_healthy"]["values"][("q",)] == 1.0
+    assert fams["slo_healthy"]["values"][("all",)] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# live: sampler plane, events bank + cap, API shapes, store SIGKILL
+
+
+def _run_script(script, env_extra, timeout=300):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **env_extra)
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=timeout,
+                          env=env, cwd="/root/repo")
+    assert proc.returncode == 0, \
+        f"stdout:\n{proc.stdout[-4000:]}\nstderr:\n{proc.stderr[-4000:]}"
+    return proc.stdout
+
+
+def test_live_sampler_events_api_and_store_sigkill():
+    script = r"""
+import json
+import os
+import signal
+import time
+import urllib.request
+
+import ray_tpu
+from ray_tpu._private import worker as worker_mod
+from ray_tpu.util import events, state
+
+node = ray_tpu.init(min_workers=1, resources={"CPU": 4.0},
+                    object_store_memory=1 << 27)
+
+@ray_tpu.remote
+def work(x):
+    return x * 2
+
+assert ray_tpu.get([work.remote(i) for i in range(4)], timeout=60) \
+    == [0, 2, 4, 6]
+
+# -- events bank + cap (RTPU_EVENTS_CAP=32 in the env) ----------------------
+for i in range(50):
+    events.emit("test.burst", message=f"event {i}", data={"i": i})
+events.flush_events()
+rows = state.list_events(kind="test.burst", limit=1000)
+assert rows, "no test.burst events banked"
+assert len(rows) <= 32, f"events ring over cap: {len(rows)}"
+# the ring keeps the newest: the very last burst event must be present
+assert any(r["data"].get("i") == 49 for r in rows)
+assert all(r.get("node_id") and "seq" in r and "ts" in r for r in rows)
+
+# -- explicit trace id sticks ------------------------------------------------
+events.emit("test.traced", severity="warning", trace_id="cafe" * 8,
+            flush=True)
+traced = state.list_events(kind="test.traced")
+assert traced and traced[-1]["trace_id"] == "cafe" * 8
+
+# -- TSDB sampling + query surfaces -----------------------------------------
+deadline = time.time() + 30
+while time.time() < deadline:
+    fams = state.query_timeseries().get("families", [])
+    if any(f["family"] == "node_tasks_pending" for f in fams):
+        break
+    time.sleep(0.3)
+else:
+    raise AssertionError("sampler never ingested node runtime families")
+
+qr = state.query_timeseries("node_tasks_pending", window_s=120)
+assert qr["family"] == "node_tasks_pending" and qr["series"]
+pt = qr["series"][0]["points"][0]
+assert len(pt) == 2 and isinstance(pt[0], float)
+
+slo = state.slo_status()
+assert {r["rule"] for r in slo["rules"]} >= {
+    "serve_error_rate", "llm_ttft_p90", "train_goodput"}
+assert "healthy" in slo and slo["sample_s"] > 0
+
+top = state.tsdb_overview(window_s=60)
+assert any(r["family"] == "node_workers" for r in top)
+
+# -- dashboard HTTP API shapes ----------------------------------------------
+base = node.dashboard_url
+if base:
+    def get(path):
+        with urllib.request.urlopen(base + path, timeout=10) as r:
+            return json.loads(r.read())
+    ev = get("/api/events?kind=test.burst&limit=10")
+    assert isinstance(ev, list) and len(ev) <= 10
+    assert all(e["kind"] == "test.burst" for e in ev)
+    sl = get("/api/slo")
+    assert "rules" in sl and "healthy" in sl
+    tsq = get("/api/timeseries?family=node_tasks_pending&window=120")
+    assert tsq["family"] == "node_tasks_pending"
+    assert isinstance(get("/api/timeseries"), dict)
+
+# -- store daemon SIGKILL: counter-reset regression -------------------------
+incar0 = node.store_server.incarnation
+os.kill(node.store_server._proc.pid, signal.SIGKILL)
+deadline = time.time() + 30
+while time.time() < deadline:
+    if node.store_server.incarnation > incar0:
+        break
+    time.sleep(0.25)
+else:
+    raise AssertionError("store daemon never respawned after SIGKILL")
+
+# exercise the new incarnation + let a few sample ticks land
+assert ray_tpu.get([work.remote(i) for i in range(4)], timeout=60) \
+    == [0, 2, 4, 6]
+time.sleep(1.5)
+
+restarts = state.list_events(kind="store.daemon_restart")
+assert restarts, "no store.daemon_restart event banked"
+assert restarts[-1]["severity"] == "error"
+assert restarts[-1]["data"]["incarnation"] > incar0
+
+# every retained counter series must stay monotone across the restart —
+# the windowed rate can never go negative
+fams = state.query_timeseries().get("families", [])
+for f in fams:
+    if f["kind"] != "counter":
+        continue
+    qr = state.query_timeseries(f["family"], window_s=600)
+    for s in qr["series"]:
+        vals = [p[1] for p in s["points"]]
+        assert vals == sorted(vals), \
+            f"non-monotone adjusted counter {f['family']}: {vals}"
+
+ray_tpu.shutdown()
+print("TSDB-SLO-LIVE-OK")
+"""
+    out = _run_script(script, {
+        "RTPU_TSDB_SAMPLE_S": "0.25",
+        "RTPU_EVENTS_CAP": "32",
+        "RTPU_METRICS_FLUSH_S": "0.25",
+    })
+    assert "TSDB-SLO-LIVE-OK" in out
+
+
+# ---------------------------------------------------------------------------
+# live: the correlated incident — replica kill -> chaos event -> SLO alert
+# within a sample period, the pair linked by one trace id.
+
+
+def test_live_replica_kill_correlated_slo_alert():
+    script = r"""
+import time
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.util import state, tracing
+
+ray_tpu.init(min_workers=2, resources={"CPU": 6.0},
+             object_store_memory=1 << 27)
+tracing.enable_tracing()
+
+@serve.deployment(num_replicas=2)
+class Victim:
+    def __call__(self, x):
+        return x + 1
+
+handle = serve.run(Victim.bind(), name="victim", route_prefix="/victim")
+
+# chaos is armed at 100%: every handled request kills its replica with
+# os._exit(1), emitting chaos.replica_kill (flush=True) on the way down.
+deaths = 0
+deadline = time.time() + 60
+while deaths < 2 and time.time() < deadline:
+    with tracing.trace_span("kill-burst"):
+        try:
+            ray_tpu.get(handle.remote(1), timeout=20)
+        except Exception:
+            deaths += 1
+    time.sleep(0.5)
+assert deaths >= 1, "chaos never killed a replica"
+
+# the alert must land within about one sample period of the breach:
+# poll for the slo.fire transition (rule from RTPU_SLO_RULES).
+fire = None
+deadline = time.time() + 45
+while fire is None and time.time() < deadline:
+    for ev in state.list_events(kind="slo.fire"):
+        if ev["data"].get("rule") == "replica_deaths":
+            fire = ev
+    time.sleep(0.5)
+assert fire is not None, (
+    "replica_deaths SLO never fired; events: "
+    + repr([e["kind"] for e in state.list_events(limit=100)]))
+
+# the chaos event itself reached the plane, stamped with the request's
+# trace id (the replica died mid-traced-request)
+chaos = [e for e in state.list_events(kind="chaos.replica_kill")]
+dead = [e for e in state.list_events(kind="serve.replica_dead")]
+assert chaos or dead, "no replica death event on the plane"
+
+# correlated triple: the alert carries the trace id of a recent incident
+# event, and names it in data.correlated_event
+corr = fire["data"].get("correlated_event")
+assert corr is not None, f"alert not correlated: {fire}"
+assert corr["kind"] in ("chaos.replica_kill", "serve.replica_dead",
+                        "worker.death", "worker.oom_kill"), corr
+assert fire.get("trace_id"), "correlated alert lost its trace id"
+if chaos and chaos[-1].get("trace_id"):
+    assert any(fire["trace_id"] == c.get("trace_id") for c in chaos)
+
+serve.shutdown()
+ray_tpu.shutdown()
+print("CORRELATED-INCIDENT-OK")
+"""
+    out = _run_script(script, {
+        "RTPU_TSDB_SAMPLE_S": "0.25",
+        "RTPU_METRICS_FLUSH_S": "0.25",
+        "RTPU_TESTING_REPLICA_FAILURE": "100",
+        "RTPU_SLO_RULES":
+            "replica_deaths: rate(serve_replica_deaths_total, 30s) < 0.001",
+    }, timeout=420)
+    assert "CORRELATED-INCIDENT-OK" in out
