@@ -914,6 +914,27 @@ class Scheduler:
                     buf.append(s)
                 self._trace_spans.move_to_end(tid)
 
+    def _spans_window(self, since_ts: float,
+                      name_prefix: str = "") -> list[dict]:
+        """Flat slice of recently-ended banked spans ("spans_window" RPC):
+        the head's SLO burn-attribution step fans this out over nodes to
+        decompose a breaching window's TTFT into phase shares without
+        shipping whole traces.  Capped so a breach during a span storm
+        can't flood the control socket."""
+        out: list[dict] = []
+        with self._lock:
+            for buf in self._trace_spans.values():
+                for s in buf:
+                    if (s.get("end_ts") or 0.0) < since_ts:
+                        continue
+                    if name_prefix and not str(
+                            s.get("name") or "").startswith(name_prefix):
+                        continue
+                    out.append(dict(s))
+                    if len(out) >= 20_000:
+                        return out
+        return out
+
     def _list_traces(self) -> list[dict]:
         with self._lock:
             rows = []
@@ -2300,6 +2321,10 @@ class Scheduler:
                 return list(self._trace_spans.get(params["trace_id"], ()))
         if method == "list_traces":
             return self._list_traces()
+        if method == "spans_window":
+            return self._spans_window(
+                float(params.get("since_ts") or 0.0),
+                str(params.get("name_prefix") or ""))
         if method == "node_physical_stats":
             return self.reporter.latest()
         if method == "metrics_snapshot":

@@ -170,6 +170,67 @@ def load_rules() -> list[Rule]:
     return list(by_name.values())
 
 
+# Engine span name -> attribution phase.  The verdict names answer the
+# operator question directly: WHERE did the breaching window's latency go.
+_PHASE_BY_SPAN = {
+    "llm.queue": "queue",
+    "llm.kv_pull": "kv_pull",
+    "llm.prefill": "prefill",
+    "llm.decode": "decode",
+}
+_VERDICT_BY_PHASE = {
+    "queue": "queue_bound",
+    "kv_pull": "kv_pull",
+    "prefill": "cold_prefill",
+    "decode": "decode_contention",
+}
+
+
+def attribute_burn(spans) -> Optional[dict]:
+    """Decompose a breaching window's serving latency into phase shares
+    from banked engine spans (pure function; the head's sampler feeds it
+    the nodes' ``spans_window`` output when an ``slo.fire`` lands on a
+    serving-latency rule).
+
+    Returns ``{"phases": {phase: share}, "verdict": str,
+    "exemplar_trace_ids": [...], "traces": n}`` or None when no engine
+    span in the window maps to a phase.  Shares are fractions of the
+    total time spent across the four phases; the verdict is the dominant
+    phase; exemplars are the 3 traces that spent the most pre-decode time
+    (queue + kv_pull + prefill) — the requests worth pulling up in
+    ``rtpu trace`` to see WHY the objective burned."""
+    phase_tot = {p: 0.0 for p in _VERDICT_BY_PHASE}
+    per_trace: dict[str, dict] = {}
+    for s in spans or ():
+        phase = _PHASE_BY_SPAN.get(s.get("name"))
+        if phase is None:
+            continue
+        dur = s.get("run_s")
+        if dur is None:
+            dur = max(0.0, float(s.get("end_ts", 0.0))
+                      - float(s.get("start_ts", 0.0)))
+        dur = max(0.0, float(dur))
+        phase_tot[phase] += dur
+        tid = s.get("trace_id")
+        if tid:
+            t = per_trace.setdefault(str(tid),
+                                     {p: 0.0 for p in _VERDICT_BY_PHASE})
+            t[phase] += dur
+    total = sum(phase_tot.values())
+    if total <= 0:
+        return None
+    phases = {p: round(v / total, 4) for p, v in phase_tot.items()}
+    verdict = _VERDICT_BY_PHASE[
+        max(phase_tot, key=lambda p: phase_tot[p])]
+    ranked = sorted(
+        per_trace.items(),
+        key=lambda kv: -(kv[1]["queue"] + kv[1]["kv_pull"]
+                         + kv[1]["prefill"]))
+    return {"phases": phases, "verdict": verdict,
+            "exemplar_trace_ids": [tid for tid, _ in ranked[:3]],
+            "traces": len(per_trace)}
+
+
 class SLOEngine:
     """Multi-window burn-rate state machine over a TSDB."""
 
@@ -184,8 +245,16 @@ class SLOEngine:
         self._state: dict[str, dict] = {
             r.name: {"firing": False, "since": None, "ok_ticks": 0,
                      "value": None, "burn_fast": 0.0, "burn_slow": 0.0,
-                     "fired_total": 0}
+                     "fired_total": 0, "attribution": None}
             for r in self.rules}
+
+    def note_attribution(self, rule_name: str, attribution) -> None:
+        """Bank a fire-time phase-share attribution (from
+        :func:`attribute_burn`) so ``rtpu slo --explain`` can replay the
+        verdict after the alert event has scrolled by."""
+        st = self._state.get(rule_name)
+        if st is not None:
+            st["attribution"] = attribution
 
     def fast_window(self, rule: Rule) -> float:
         return max(2.0 * self.sample_s,
@@ -254,6 +323,7 @@ class SLOEngine:
                 "firing": st["firing"],
                 "since": st["since"],
                 "fired_total": st["fired_total"],
+                "attribution": st.get("attribution"),
             })
         return {"rules": rows,
                 "healthy": not any(r["firing"] for r in rows)}

@@ -21,7 +21,11 @@ Statically scans every ``Counter(...)`` / ``Gauge(...)`` /
   namespace — a rule over a typo'd family silently never fires;
 - the reverse direction: a ctor-registered family whose name appears in
   no OTHER source/doc (no rule, dashboard, CLI, test, or README mention)
-  is flagged as unconsumed — it burns scrape bytes nobody judges.
+  is flagged as unconsumed — it burns scrape bytes nobody judges;
+- every family listed in ``util/metrics.py``'s ``EXEMPLAR_FAMILIES``
+  (the exemplar-capable serving-latency set) is constructed as a
+  ``Histogram`` — exemplars hang off buckets, so a Counter/Gauge (or an
+  unregistered name) in that tuple could never carry one.
 """
 
 from __future__ import annotations
@@ -208,6 +212,53 @@ def _scan_unconsumed(root: str, sites: dict, violations: list[Violation]):
                 "mentions it"))
 
 
+def _scan_exemplars(root: str, sites: dict, violations: list[Violation]):
+    """Every family in util/metrics.py's EXEMPLAR_FAMILIES tuple must be
+    constructed as a Histogram somewhere in the tree: exemplar trace ids
+    are banked per bucket, so a non-histogram (or never-registered)
+    family in that list silently drops the "which request was the p99"
+    linkage."""
+    for rel, src in walk_sources(root, (".py",)):
+        if not rel.endswith("util/metrics.py"):
+            continue
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+            if "EXEMPLAR_FAMILIES" not in targets:
+                continue
+            if not isinstance(node.value, (ast.Tuple, ast.List)):
+                continue
+            for elt in node.value.elts:
+                fam = _literal_str(elt)
+                if fam is None:
+                    continue
+                where = sites.get(fam)
+                if not where:
+                    violations.append(Violation(
+                        "metrics/exemplar-not-histogram", rel, elt.lineno,
+                        f"EXEMPLAR_FAMILIES lists {fam!r}, but no "
+                        "Counter/Gauge/Histogram registers it — an "
+                        "exemplar-capable family must be a registered "
+                        "Histogram"))
+                    continue
+                bad = [(r, ln, k) for r, ln, k in where
+                       if k != "Histogram"]
+                if bad:
+                    locs = ", ".join(f"{r}:{ln} ({k})"
+                                     for r, ln, k in bad)
+                    violations.append(Violation(
+                        "metrics/exemplar-not-histogram", rel, elt.lineno,
+                        f"EXEMPLAR_FAMILIES lists {fam!r}, but it is "
+                        f"constructed as a non-histogram at {locs} — "
+                        "exemplars hang off histogram buckets"))
+
+
 def _scan_renderer(root: str, violations: list[Violation]):
     rendered_any = False
     for rel, src in walk_sources(root, (".py",), subdir="ray_tpu/dashboard"):
@@ -247,4 +298,5 @@ def check(root: str) -> list[Violation]:
     registered = set(sites) | _scan_synthesized(root)
     _scan_slo_rules(root, registered, violations)
     _scan_unconsumed(root, sites, violations)
+    _scan_exemplars(root, sites, violations)
     return violations

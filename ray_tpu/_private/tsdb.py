@@ -48,7 +48,7 @@ def _tags_key(tags) -> tuple:
 
 class _Series:
     __slots__ = ("family", "kind", "tags", "source", "points", "gen",
-                 "last_raw", "offset", "boundaries", "cap")
+                 "last_raw", "offset", "boundaries", "cap", "exemplars")
 
     def __init__(self, family: str, kind: str, tags: tuple, source: str,
                  cap: int, boundaries=None):
@@ -62,6 +62,9 @@ class _Series:
         self.last_raw = None    # float (counter) or list (histogram)
         self.offset = None      # float or list, added to raw -> monotone
         self.boundaries = tuple(boundaries or ())
+        # histogram only: bucket index -> trace id of the LAST observation
+        # that landed there (exemplar linkage; bounded by bucket count)
+        self.exemplars: dict = {}
 
     def _append(self, ts: float, value) -> None:
         self.points.append((ts, value))
@@ -94,7 +97,13 @@ class _Series:
         self.last_raw = raw
         self._append(ts, self.offset + raw)
 
-    def add_hist(self, ts: float, raw, gen=None) -> None:
+    def add_hist(self, ts: float, raw, gen=None, exemplars=None) -> None:
+        if exemplars:
+            for bucket, tid in exemplars.items():
+                try:
+                    self.exemplars[int(bucket)] = str(tid)
+                except (TypeError, ValueError):
+                    continue
         # raw: [bucket counts..., +inf count, sum] — every component is a
         # cumulative counter; normalize the vector with the same
         # reset-vs-decrease rule as add_counter
@@ -224,10 +233,11 @@ class TSDB:
         keys = tuple(m.get("tag_keys") or ())
         if kind == "histogram":
             bounds = tuple(m.get("boundaries") or ())
+            ex_by_tags = m.get("exemplars") or {}
             for tagvals, h in (m.get("hist") or {}).items():
                 tags = _tags_key(zip(keys, tuple(tagvals)))
                 s = self._get_series(family, kind, tags, source, bounds)
-                s.add_hist(ts, h)
+                s.add_hist(ts, h, exemplars=ex_by_tags.get(tagvals))
             return
         for tagvals, v in (m.get("values") or {}).items():
             tags = _tags_key(zip(keys, tuple(tagvals)))
@@ -338,6 +348,60 @@ class TSDB:
                     return lo + (hi - lo) * ((target - prev_cum) / c)
             return float(bounds[-1]) if bounds else 0.0
 
+    def exemplar(self, family: str, q: float, window_s: float,
+                 now: Optional[float] = None) -> Optional[str]:
+        """Trace id of an observation representative of the family's
+        q-quantile over the window: walk the merged bucket deltas to the
+        quantile's bucket (same walk as :meth:`quantile`), then return the
+        banked exemplar at that bucket — or the nearest populated bucket at
+        or above it, so "which request was the p99" answers with the worst
+        traced request even when the exact bucket carried no exemplar."""
+        with self._lock:
+            series = [s for s in self._family_series(family)
+                      if s.kind == "histogram"]
+            if not series:
+                return None
+            now = self._now(now)
+            start = now - float(window_s)
+            bounds = None
+            merged = None
+            ex: dict[int, str] = {}
+            for s in series:
+                d = s.window_delta(start, now)
+                if d is None:
+                    continue
+                counts = [max(0.0, c) for c in d[:-1]]
+                if merged is None:
+                    bounds = s.boundaries
+                    merged = counts
+                elif s.boundaries == bounds and len(counts) == len(merged):
+                    merged = [a + b for a, b in zip(merged, counts)]
+                else:
+                    continue
+                for b, t in s.exemplars.items():
+                    if 0 <= int(b) < len(counts):
+                        ex[int(b)] = t
+            if not merged or not ex:
+                return None
+            total = sum(merged)
+            if total <= 0:
+                return None
+            target = max(0.0, min(1.0, float(q))) * total
+            cum = 0.0
+            hit = len(merged) - 1
+            for i, c in enumerate(merged):
+                cum += c
+                if cum >= target and c > 0:
+                    hit = i
+                    break
+            for i in range(hit, len(merged)):
+                if i in ex:
+                    return ex[i]
+            for i in range(hit - 1, -1, -1):
+                if i in ex:
+                    return ex[i]
+            return None
+
     def gauge_agg(self, family: str, window_s: float, fn: str = "mean",
                   now: Optional[float] = None) -> Optional[float]:
         """mean/max/min over every in-window point of a gauge family, or
@@ -389,13 +453,17 @@ class TSDB:
                 pts = s.window_points(start)
                 if not pts:
                     continue
-                out.append({
+                row = {
                     "family": s.family, "kind": s.kind,
                     "tags": dict(s.tags), "source": s.source,
                     "boundaries": list(s.boundaries),
                     "points": [[ts, list(v) if isinstance(v, tuple) else v]
                                for ts, v in pts],
-                })
+                }
+                if s.exemplars:
+                    row["exemplars"] = {int(b): t
+                                        for b, t in s.exemplars.items()}
+                out.append(row)
             return out
 
     def overview(self, window_s: float,

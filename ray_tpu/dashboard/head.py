@@ -308,12 +308,22 @@ class MetricsSampler:
         for tr in transitions:
             if tr["kind"] == "slo.fire":
                 self._correlate(tr)
+                self._attribute(tr, nodes)
         if transitions:
             try:
                 _node_rpc(self._head_sock, "events_push",
                           {"events": transitions})
             except Exception:
                 pass
+        # A fire can race ahead of the engines' span flush cadence: while
+        # a serving rule burns without a phase decomposition, retry the
+        # attribution each tick until the banked spans yield one.
+        for row in self.engine.status()["rules"]:
+            a = row.get("attribution")
+            if row["firing"] and (a is None
+                                  or a.get("verdict") == "unattributed"):
+                self._attribute({"ts": now, "data": {"rule": row["rule"]}},
+                                nodes)
         from ray_tpu._private import slo as slo_mod
 
         try:
@@ -344,6 +354,47 @@ class MetricsSampler:
                     "kind": ev.get("kind"), "ts": ev.get("ts"),
                     "node_id": ev.get("node_id"), "seq": ev.get("seq")}
                 return
+
+    def _attribute(self, alert: dict, nodes):
+        """Burn attribution for serving-latency fires: pull every node's
+        banked engine spans over the breaching window, decompose the
+        latency into phase shares (queue vs cold-prefill vs kv-pull vs
+        decode contention), and stamp verdict + exemplar trace ids on the
+        alert — `rtpu slo --explain` replays the verdict from the engine
+        state afterwards."""
+        from ray_tpu._private import slo as slo_mod
+        from ray_tpu.util import metrics as metrics_mod
+
+        rule = next((r for r in self.engine.rules
+                     if r.name == alert["data"].get("rule")), None)
+        if rule is None:
+            return
+        if not set(rule.families()) & set(metrics_mod.EXEMPLAR_FAMILIES):
+            return  # not a serving-latency objective: nothing to decompose
+        since = alert["ts"] - max(rule.window_s, 30.0)
+        spans: list = []
+        for _node_hex, sock in nodes:
+            try:
+                spans.extend(_node_rpc(sock, "spans_window", {
+                    "since_ts": since, "name_prefix": "llm."}))
+            except Exception:
+                continue
+        attr = slo_mod.attribute_burn(spans)
+        if attr is None:
+            # no banked engine spans (sampling off, or a serving path
+            # without the LLM engine): still answer "which request was
+            # the p99" from the TSDB's banked histogram exemplar
+            tid = self.tsdb.exemplar(rule.num.family, 0.99, rule.window_s)
+            if tid is None:
+                return
+            attr = {"phases": {}, "verdict": "unattributed",
+                    "exemplar_trace_ids": [tid], "traces": 0}
+        alert["data"]["phases"] = attr["phases"]
+        alert["data"]["verdict"] = attr["verdict"]
+        alert["data"]["exemplar_trace_ids"] = attr["exemplar_trace_ids"]
+        if not alert.get("trace_id") and attr["exemplar_trace_ids"]:
+            alert["trace_id"] = attr["exemplar_trace_ids"][0]
+        self.engine.note_attribution(rule.name, attr)
 
     # -- plane interface (scheduler control-socket delegation) -----------
     def query_timeseries(self, params: dict) -> dict:

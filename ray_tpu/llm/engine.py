@@ -30,6 +30,7 @@ from ray_tpu.llm.kv_tier import KVPullError
 from ray_tpu.llm.paged_cache import (CacheConfig, PageAllocator, PrefixCache,
                                      init_cache)
 from ray_tpu.models.llama import LlamaConfig
+from ray_tpu.util import tracing
 
 # Serving observability (ISSUE 8): the engine-local stats() dict stays the
 # cheap in-process view, but the same events also feed util.metrics so
@@ -180,6 +181,16 @@ class _Request:
     # preempted request folds its generated tokens into the prompt, so
     # len(slot.generated) restarts from zero while `produced` does not.
     produced: int = 0
+    # Per-request trace anatomy (ISSUE 20): the submitting thread's
+    # (trace_id, parent span_id) captured at submit; the scheduler thread
+    # has no thread-local context, so every phase span it records carries
+    # this explicitly.  span_id is the umbrella "llm.request" span phase
+    # spans parent under; submitted_wall anchors it on the wall clock
+    # (spans are wall-time; submitted_at stays monotonic for latency math).
+    trace_ctx: Optional[tuple] = None
+    span_id: Optional[str] = None
+    submitted_wall: float = field(default_factory=time.time)
+    preempts: int = 0
 
 
 @dataclass
@@ -286,6 +297,7 @@ class LLMEngine:
                 f"{self.cfg.num_pages - 1} allocatable pages")
         req = _Request(request_id=uuid.uuid4().hex[:12],
                        prompt_tokens=list(prompt_tokens), params=params)
+        self._trace_init(req)
         self._waiting.put(req)
         return req
 
@@ -304,6 +316,7 @@ class LLMEngine:
         n_pages = -(-len(prompt_tokens) // self.cfg.page_size)
         if n_pages > self.cfg.num_pages - 1:
             raise ValueError(f"prompt needs {n_pages} KV pages > capacity")
+        self._trace_init(req)
         self._waiting.put(req)
         item = req.out_queue.get(timeout=timeout_s)
         if isinstance(item, Exception):
@@ -334,6 +347,7 @@ class LLMEngine:
                        prompt_tokens=list(prompt_tokens), params=params,
                        kind="decode_kv", first_token=int(first_token),
                        kv=(kv_k, kv_v))
+        self._trace_init(req)
         self._waiting.put(req)
         return req
 
@@ -450,16 +464,64 @@ class LLMEngine:
         m["waiting"].set(self._waiting.qsize())
         m["prefix_resident"].set(self.allocator.num_resident())
 
+    # -------------------- per-request trace anatomy (ISSUE 20) -------------
+
+    def _trace_init(self, req: _Request) -> None:
+        """Capture the submitting thread's trace context onto the request
+        so the scheduler thread can stamp phase spans for it."""
+        ctx = tracing.current_context()
+        if ctx is not None:
+            req.trace_ctx = ctx
+            req.span_id = tracing.new_span_id()
+
+    def _span(self, req: _Request, name: str, t0: float, t1: float,
+              ok: bool = True, **attrs) -> None:
+        """One phase span under the request's umbrella span."""
+        if req.trace_ctx is None:
+            return
+        tracing.record_span(
+            req.trace_ctx[0], name, t0, t1, parent_id=req.span_id,
+            kind="engine", ok=ok,
+            attrs=dict(attrs, request_id=req.request_id))
+
+    def _close_request_span(self, req: _Request, ok: bool = True,
+                            **attrs) -> None:
+        """Close the umbrella "llm.request" span (submit -> stream end),
+        parented under whatever the submitter was doing (replica task
+        span, SSE generator, P/D decode span)."""
+        if req.trace_ctx is None or req.span_id is None:
+            return
+        tracing.record_span(
+            req.trace_ctx[0], "llm.request", req.submitted_wall,
+            time.time(), parent_id=req.trace_ctx[1], span_id=req.span_id,
+            kind="engine", ok=ok,
+            attrs=dict(attrs, request_id=req.request_id,
+                       req_kind=req.kind, preempts=req.preempts))
+        req.span_id = None  # closed exactly once
+
     def _finish_request(self, req: _Request):
         """Latency histograms at stream end (successful finishes only;
         prefill_only requests are half a request and are skipped)."""
         if req.kind == "prefill_only":
             return
         now = time.monotonic()
-        self._m["e2e"].observe(now - req.submitted_at)
+        tid = req.trace_ctx[0] if req.trace_ctx else None
+        self._m["e2e"].observe(now - req.submitted_at, exemplar=tid)
         if req.first_token_at is not None and req.emitted > 1:
             self._m["tpot"].observe(
-                (now - req.first_token_at) / (req.emitted - 1))
+                (now - req.first_token_at) / (req.emitted - 1),
+                exemplar=tid)
+        if req.trace_ctx is not None:
+            w_now = time.time()
+            if req.first_token_at is not None:
+                # decode aggregate: first token -> stream end (per-step
+                # spans would be noise; contention shows up as the gap
+                # between this span's rate and the prefill-adjacent TPOT)
+                self._span(req, "llm.decode",
+                           w_now - max(0.0, now - req.first_token_at),
+                           w_now, tokens=req.emitted,
+                           preempts=req.preempts)
+            self._close_request_span(req, ok=True, tokens=req.emitted)
 
     def _pick_waiting(self) -> Optional[_Request]:
         """Next request to admit: FIFO normally; under pool pressure (the
@@ -540,9 +602,11 @@ class LLMEngine:
                     # the sealed spine IS the page transfer the decode
                     # engine pulls (pd_disagg ships only the digest)
                     self._maybe_seal(req.prompt_tokens, force=True)
+                    self._close_request_span(req)
                 except Exception as e:  # noqa: BLE001
                     req.out_queue.put(e)
                     req.out_queue.put(None)
+                    self._close_request_span(req, ok=False)
                 finally:
                     self.allocator.free(pages)
                 admitted = True
@@ -563,7 +627,13 @@ class LLMEngine:
                 # from a killed replica), hydrate it FIRST so match_cow
                 # below finds warm pages instead of cold-prefilling.
                 if self.kv_tier is not None:
-                    self._maybe_tier_pull(req.prompt_tokens)
+                    t_pull = time.time()
+                    outcome, pulled = self._maybe_tier_pull(
+                        req.prompt_tokens, req=req)
+                    if outcome is not None:
+                        self._span(req, "llm.kv_pull", t_pull, time.time(),
+                                   ok=outcome in ("resident", "hydrated"),
+                                   outcome=outcome, pages=pulled)
                 matched, cow_src, cow_len = \
                     self.prefix_cache.match_cow(req.prompt_tokens)
             need_total = n // self.cfg.page_size + 1
@@ -603,6 +673,11 @@ class LLMEngine:
                         jnp.asarray(kv_k, self.cache_k.dtype),
                         jnp.asarray(kv_v, self.cache_v.dtype))
                     last = int(req.first_token)
+                    # no prefill here, so stamp the admission wait itself
+                    qw = max(0.0, time.monotonic() - req.submitted_at)
+                    self._span(req, "llm.queue", req.submitted_wall,
+                               req.submitted_wall + qw,
+                               wait_s=round(qw, 6))
                 else:
                     if cow_src is not None:
                         # COW boundary page: duplicate the diverging
@@ -624,6 +699,7 @@ class LLMEngine:
                 self.allocator.free(pages)
                 req.out_queue.put(e)
                 req.out_queue.put(None)
+                self._close_request_span(req, ok=False, error=repr(e))
                 continue
             finally:
                 if cow_src is not None:
@@ -717,8 +793,30 @@ class LLMEngine:
         self._stats["admitted"] += 1
         self._m["prefills"].inc()
         self._m["admitted"].inc()
-        self._m["prefill_t"].observe(dt)
-        self._m["queue_wait"].observe(max(0.0, t0 - req.submitted_at))
+        tid = req.trace_ctx[0] if req.trace_ctx else None
+        self._m["prefill_t"].observe(dt, exemplar=tid)
+        qw = max(0.0, t0 - req.submitted_at)
+        self._m["queue_wait"].observe(qw, exemplar=tid)
+        if req.trace_ctx is not None:
+            w_end = time.time()
+            self._span(req, "llm.queue", req.submitted_wall,
+                       req.submitted_wall + qw, wait_s=round(qw, 6))
+            self._span(req, "llm.prefill", w_end - dt, w_end, tokens=n,
+                       prefix_len=prefix_len, resumed=bool(req.preempts))
+        if req.preempts:
+            try:
+                from ray_tpu.util import events
+
+                events.emit(
+                    "llm.resume",
+                    message=f"request {req.request_id} resumed after "
+                            f"preemption (prefix_len={prefix_len})",
+                    data={"request_id": req.request_id,
+                          "preempts": req.preempts,
+                          "prefix_len": prefix_len},
+                    trace_id=tid)
+            except Exception:
+                pass
         return out
 
     def _reserve(self, n: int) -> bool:
@@ -765,20 +863,29 @@ class LLMEngine:
                 "head_dim": self.model_cfg.head_dim,
                 "dtype": str(np.dtype(self.cache_k.dtype))}
 
-    def _kv_fallback(self, reason: str) -> None:
+    def _kv_fallback(self, reason: str,
+                     req: Optional[_Request] = None) -> None:
         self._stats["kv_pull_fallbacks"] += 1
         self._m["kv_pull_fallbacks"].inc(tags={"reason": reason})
         try:
             from ray_tpu.util import events
 
+            data: Dict[str, Any] = {"reason": reason}
+            if req is not None:
+                data["request_id"] = req.request_id
             events.emit("kv.pull_fallback", severity="warning",
                         message=f"KV tier pull fell back to cold prefill "
-                                f"({reason})",
-                        data={"reason": reason}, coalesce_s=1.0)
+                                f"({reason})", data=data,
+                        trace_id=(req.trace_ctx[0]
+                                  if req is not None and req.trace_ctx
+                                  else None),
+                        # identity-bearing events must not merge
+                        coalesce_s=0.0 if req is not None else 1.0)
         except Exception:
             pass
 
-    def _note_kv_pull(self, pages: int) -> None:
+    def _note_kv_pull(self, pages: int,
+                      req: Optional[_Request] = None) -> None:
         self._stats["kv_pulls"] += 1
         self._stats["kv_pull_pages"] += pages
         self._m["kv_pulls"].inc()
@@ -786,10 +893,16 @@ class LLMEngine:
         try:
             from ray_tpu.util import events
 
+            data: Dict[str, Any] = {"pages": pages}
+            if req is not None:
+                data["request_id"] = req.request_id
             events.emit("kv.pull",
                         message=f"hydrated {pages} KV pages from the "
-                                f"store tier",
-                        data={"pages": pages}, coalesce_s=1.0)
+                                f"store tier", data=data,
+                        trace_id=(req.trace_ctx[0]
+                                  if req is not None and req.trace_ctx
+                                  else None),
+                        coalesce_s=0.0 if req is not None else 1.0)
         except Exception:
             pass
 
@@ -810,32 +923,43 @@ class LLMEngine:
             self._stats["kv_seals"] += 1
             self._m["kv_seals"].inc()
 
-    def _maybe_tier_pull(self, tokens: List[int]) -> None:
+    def _maybe_tier_pull(self, tokens: List[int],
+                         req: Optional[_Request] = None):
         """Admission-path pull: hydrate this prompt's family spine from
         the tier when the store holds more of it than the local pool.
         Every failure is a typed fallback to cold prefill, never an
-        admission error."""
+        admission error.  Returns ``(outcome, pages_hydrated)`` where
+        outcome is None (prompt too short to ever pull), "miss" (family
+        never sealed), "resident" (pool already covers the blob),
+        "hydrated", or the typed KVPullError reason — the admission path
+        stamps it on the request's kv-pull span."""
         tier, pc = self.kv_tier, self.prefix_cache
         ps = self.cfg.page_size
         cap = (len(tokens) - 1) // ps  # ≥1 suffix token stays to prefill
         if cap <= 0:
-            return
+            return None, 0
         root_hex = pc.root_digest_for(tokens, ps)
         rec = tier.lookup_for_pull(root_hex)
         if rec is None:
-            return  # never sealed: plain cold traffic, not a fallback
+            # never sealed: plain cold traffic, not a fallback
+            return "miss", 0
         local = pc.peek_match_tokens(tokens) // ps
         if min(int(rec.get("blocks", 0)), cap) <= local:
-            return  # the pool already covers what the blob would add
+            return "resident", 0  # the pool already covers the blob
         try:
             spine, kv_k, kv_v = tier.pull(root_hex, rec=rec,
                                           expect=self._tier_expect())
         except KVPullError as e:
-            self._kv_fallback(e.reason)
-            return
-        n = self._hydrate_spine(spine, kv_k, kv_v, limit_tokens=tokens)
+            self._kv_fallback(e.reason, req=req)
+            return e.reason, 0
+        n = self._hydrate_spine(spine, kv_k, kv_v, limit_tokens=tokens,
+                                req=req)
+        if n is None:
+            return "no_pages", 0  # _hydrate_spine already logged fallback
         if n > 0:
-            self._note_kv_pull(n)
+            self._note_kv_pull(n, req=req)
+            return "hydrated", n
+        return "resident", 0
 
     def _drain_hydrations(self) -> bool:
         """Scheduler-thread half of kv_prehydrate: pull queued family
@@ -857,18 +981,21 @@ class LLMEngine:
                 self._kv_fallback(e.reason)
                 continue
             n = self._hydrate_spine(spine, kv_k, kv_v)
-            if n > 0:
+            if n:
                 did = True
                 self._note_kv_pull(n)
         return did
 
     def _hydrate_spine(self, spine: List[int], kv_k, kv_v,
-                       limit_tokens: Optional[List[int]] = None) -> int:
+                       limit_tokens: Optional[List[int]] = None,
+                       req: Optional[_Request] = None) -> Optional[int]:
         """Scatter a pulled spine's missing blocks into fresh pages and
         register them cached-resident; returns pages hydrated (0 = all
-        resident / nothing usable).  With ``limit_tokens`` (admission
-        path) only the blocks that are a true prefix of that prompt are
-        hydrated, capped so ≥1 suffix token remains to prefill."""
+        resident / nothing usable, None = the pool couldn't cover the
+        scatter — a "no_pages" fallback).  With ``limit_tokens``
+        (admission path) only the blocks that are a true prefix of that
+        prompt are hydrated, capped so ≥1 suffix token remains to
+        prefill."""
         pc = self.prefix_cache
         ps = self.cfg.page_size
         nblk = int(kv_k.shape[1])
@@ -893,8 +1020,8 @@ class LLMEngine:
         self.allocator.retain(resident)
         if not self._reserve(need):
             self.allocator.free(resident)
-            self._kv_fallback("no_pages")
-            return 0
+            self._kv_fallback("no_pages", req=req)
+            return None
         fresh = self.allocator.allocate(need)
         P = self.max_pages_per_seq
         idx = np.zeros(P, np.int32)
@@ -938,13 +1065,25 @@ class LLMEngine:
         self._slots[i] = None
         self._stats["preempted"] += 1
         self._m["preempted"].inc()
+        req.preempts += 1
+        if req.trace_ctx is not None:
+            now_w = time.time()
+            self._span(req, "llm.preempt", now_w, now_w, ok=False,
+                       tokens=s.num_tokens, produced=req.produced)
         try:
             from ray_tpu.util import events
 
+            # identity, not an anonymous count: `rtpu events --trace`
+            # shows this preemption inside the request's own tree
             events.emit("llm.preempt",
-                        message="sequence evicted from its slot "
-                                "(recompute preemption)",
-                        data={"tokens": s.num_tokens}, coalesce_s=1.0)
+                        message=f"request {req.request_id} evicted from "
+                                f"its slot (recompute preemption, "
+                                f"{s.num_tokens} tokens resident)",
+                        data={"tokens": s.num_tokens,
+                              "request_id": req.request_id,
+                              "produced": req.produced},
+                        trace_id=(req.trace_ctx[0]
+                                  if req.trace_ctx else None))
         except Exception:
             pass
         self._waiting.queue.appendleft(req)  # type: ignore[attr-defined]
@@ -1108,7 +1247,9 @@ class LLMEngine:
         req.produced += 1  # survives preemption (len(generated) does not)
         if req.first_token_at is None:
             req.first_token_at = time.monotonic()
-            self._m["ttft"].observe(req.first_token_at - req.submitted_at)
+            self._m["ttft"].observe(
+                req.first_token_at - req.submitted_at,
+                exemplar=req.trace_ctx[0] if req.trace_ctx else None)
         self._m["tokens"].inc()
         req.out_queue.put(int(token))
 

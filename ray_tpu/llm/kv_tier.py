@@ -325,6 +325,12 @@ class KVTier:
         self._neg: Dict[str, float] = {}   # root hex -> miss timestamp
         self.seals = 0
         self.pulls = 0
+        # transfer-plane accounting for the serving anatomy: bytes moved
+        # and the last pull's wall time, surfaced via stats() so the
+        # kv-pull span/burn attribution can tell "pulled a lot slowly"
+        # from "pulled nothing"
+        self.pull_bytes = 0
+        self.last_pull_ms: Optional[float] = None
 
     # -- addressing --------------------------------------------------------
 
@@ -422,6 +428,7 @@ class KVTier:
         except Exception:  # noqa: BLE001
             raise KVPullError("corrupt", f"bad directory record for "
                                          f"{root_hex}")
+        t0 = time.monotonic()
         try:
             got = self.store.get_bytes(oid, timeout_ms=500)
         except KVPullError:
@@ -430,6 +437,7 @@ class KVTier:
             raise KVPullError(_exc_reason(e), str(e))
         if got is None:
             raise KVPullError("miss", f"store has no bytes for {root_hex}")
+        nbytes = len(got)
         try:
             tokens, kv_k, kv_v, hdr = decode_spine(got)
         finally:
@@ -447,6 +455,8 @@ class KVTier:
             raise KVPullError("corrupt", f"dtype mismatch: blob "
                               f"{hdr['dtype']} != engine {expect['dtype']}")
         self.pulls += 1
+        self.pull_bytes += nbytes
+        self.last_pull_ms = round((time.monotonic() - t0) * 1e3, 3)
         return tokens, kv_k, kv_v
 
     def hottest(self, n: int = 8) -> List[str]:
@@ -455,7 +465,9 @@ class KVTier:
     def stats(self) -> dict:
         return {"sealed_families": len(self._sealed),
                 "seal_min_hits": self.seal_min_hits,
-                "seals": self.seals, "pulls": self.pulls}
+                "seals": self.seals, "pulls": self.pulls,
+                "pull_bytes": self.pull_bytes,
+                "last_pull_ms": self.last_pull_ms}
 
 
 # ------------------------- process default -------------------------------

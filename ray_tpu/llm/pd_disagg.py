@@ -46,10 +46,13 @@ class PrefillServer:
 
     def prefill(self, prompt: str, params_dict: Optional[dict] = None):
         from ray_tpu.llm.paged_cache import PrefixCache
+        from ray_tpu.util import tracing
 
         sp = SamplingParams(**(params_dict or {}))
         tokens = self._tok.encode(prompt)
-        first, kv_k, kv_v, n = self._engine.prefill_extract(tokens, sp)
+        with tracing.trace_span("pd.prefill",
+                                tokens=len(tokens)) as span:
+            first, kv_k, kv_v, n = self._engine.prefill_extract(tokens, sp)
         # page-residency hint for the decode hop: the block-chain digest of
         # the prompt's cacheable prefix.  digest_for is a pure function of
         # (tokens, page_size), so the decode engine that admitted these
@@ -59,6 +62,12 @@ class PrefillServer:
             tokens, self._engine.cfg.page_size)
         out = {"prompt_tokens": tokens, "first_token": first,
                "n_tokens": n, "prefix_digest": digest}
+        if span is not None:
+            # cross-engine link: the decode hop re-establishes THIS span
+            # as its parent, so the prefill->decode handoff renders as one
+            # connected tree across the two engines
+            out["trace_id"] = span.trace_id
+            out["prefill_span_id"] = span.span_id
         if (self._tier is not None
                 and len(tokens) > self._engine.cfg.page_size):
             # KV-tier handoff (ISSUE 16): the prefill admission already
@@ -94,45 +103,62 @@ class DecodeServer:
 
     def decode(self, prefill_result: dict,
                params_dict: Optional[dict] = None) -> dict:
+        import contextlib
+
+        from ray_tpu.util import tracing
+
         sp_kwargs = dict(params_dict or {})
         eos = getattr(self._tok, "eos_id", None)
         if eos is not None:
             stop = tuple(sp_kwargs.get("stop_token_ids", ())) + (eos,)
             sp_kwargs["stop_token_ids"] = stop
         sp = SamplingParams(**sp_kwargs)
-        if prefill_result.get("kv_in_tier") and "kv_k" not in prefill_result:
-            # KV-tier handoff: submit as a NORMAL request — admission
-            # pulls the sealed spine from the store and hydrates it, so
-            # only the final partial block prefills here.  Greedy decode
-            # over identical KV regenerates the prefill's first token
-            # bit-for-bit; a pull failure degrades to a cold prefill of
-            # the same request (counted, never fatal).
-            req = self._engine.submit(prefill_result["prompt_tokens"], sp)
-            toks = []
-            while True:
-                item = req.out_queue.get(timeout=300)
-                if item is None:
-                    break
-                if isinstance(item, Exception):
-                    raise item
-                toks.append(item)
+        tier_path = (prefill_result.get("kv_in_tier")
+                     and "kv_k" not in prefill_result)
+        with contextlib.ExitStack() as stack:
+            # Linked spans across engines: re-establish the prefill span
+            # as this thread's context so pd.decode parents under
+            # pd.prefill — the handoff arrow in the Perfetto export.
+            if prefill_result.get("trace_id"):
+                stack.enter_context(tracing.use_context(
+                    (prefill_result["trace_id"],
+                     prefill_result.get("prefill_span_id"))))
+            stack.enter_context(tracing.trace_span(
+                "pd.decode", handoff="tier" if tier_path else "host"))
+            if tier_path:
+                # KV-tier handoff: submit as a NORMAL request — admission
+                # pulls the sealed spine from the store and hydrates it, so
+                # only the final partial block prefills here.  Greedy decode
+                # over identical KV regenerates the prefill's first token
+                # bit-for-bit; a pull failure degrades to a cold prefill of
+                # the same request (counted, never fatal).
+                req = self._engine.submit(
+                    prefill_result["prompt_tokens"], sp)
+                toks = []
+                while True:
+                    item = req.out_queue.get(timeout=300)
+                    if item is None:
+                        break
+                    if isinstance(item, Exception):
+                        raise item
+                    toks.append(item)
+                return {"tokens": toks, "text": self._tok.decode(toks)}
+            req = self._engine.submit_with_kv(
+                prefill_result["prompt_tokens"],
+                prefill_result["first_token"],
+                prefill_result["kv_k"], prefill_result["kv_v"], sp)
+            toks = [int(prefill_result["first_token"])]
+            if toks[0] in sp.stop_token_ids:
+                toks = []
+            else:
+                while True:
+                    item = req.out_queue.get(timeout=300)
+                    if item is None:
+                        break
+                    if isinstance(item, Exception):
+                        raise item
+                    toks.append(item)
             return {"tokens": toks, "text": self._tok.decode(toks)}
-        req = self._engine.submit_with_kv(
-            prefill_result["prompt_tokens"],
-            prefill_result["first_token"],
-            prefill_result["kv_k"], prefill_result["kv_v"], sp)
-        toks = [int(prefill_result["first_token"])]
-        if toks[0] in sp.stop_token_ids:
-            toks = []
-        else:
-            while True:
-                item = req.out_queue.get(timeout=300)
-                if item is None:
-                    break
-                if isinstance(item, Exception):
-                    raise item
-                toks.append(item)
-        return {"tokens": toks, "text": self._tok.decode(toks)}
 
     def kv_prehydrate(self, roots) -> int:
         self._engine.kv_prehydrate(list(roots))
@@ -173,19 +199,23 @@ class PDRouter:
                 "top_p": float(body.get("top_p", 1.0)),
                 "seed": body.get("seed"),
             }
-            # Prefix-affinity: same prompt prefix lands on the same
-            # prefill replica (KV/weight cache locality).
-            pre = self._prefill.options(
-                routing_hint=prompt[:64]).prefill.remote(
-                    prompt, params).result(timeout_s=300)
-            # Decode routes on the PAGE-RESIDENCY digest from the prefill
-            # result, not a re-hash of the prompt: a decode replica that
-            # already admitted this prefix advertises the digest in its
-            # stats-plane prefix_digests, and the prefix-aware router
-            # sends the request straight to those warm pages.
-            out = self._decode.options(
-                routing_hint=pre.get("prefix_digest") or prompt[:64]
-            ).decode.remote(pre, params).result(timeout_s=300)
+            from ray_tpu.util import tracing
+
+            with tracing.serving_span("pd.request", path=path):
+                # Prefix-affinity: same prompt prefix lands on the same
+                # prefill replica (KV/weight cache locality).
+                pre = self._prefill.options(
+                    routing_hint=prompt[:64]).prefill.remote(
+                        prompt, params).result(timeout_s=300)
+                # Decode routes on the PAGE-RESIDENCY digest from the
+                # prefill result, not a re-hash of the prompt: a decode
+                # replica that already admitted this prefix advertises
+                # the digest in its stats-plane prefix_digests, and the
+                # prefix-aware router sends the request straight to those
+                # warm pages.
+                out = self._decode.options(
+                    routing_hint=pre.get("prefix_digest") or prompt[:64]
+                ).decode.remote(pre, params).result(timeout_s=300)
             return {
                 "id": f"cmpl-{uuid.uuid4().hex[:12]}",
                 "object": "text_completion",

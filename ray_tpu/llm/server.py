@@ -86,15 +86,21 @@ class LLMServer:
                 else self._tok.encode(str(prompt)))
 
     def _sse_stream(self, tokens: List[int], params: SamplingParams,
-                    rid: str, model: str, chat: bool):
+                    rid: str, model: str, chat: bool, trace_ctx=None):
         """Token stream -> OpenAI SSE chunks (reference gets this from
         vLLM; the engine already streams per-request token queues)."""
         import json as _json
         import queue as _queue
 
+        from ray_tpu.util import tracing
+
         obj = "chat.completion.chunk" if chat else "text_completion"
         try:
-            req = self._engine.submit(tokens, params)
+            # the generator body runs lazily on the proxy's pull thread,
+            # where the registration-time task span is long gone: restore
+            # the captured context so the engine request parents correctly
+            with tracing.use_context(trace_ctx):
+                req = self._engine.submit(tokens, params)
         except Exception as e:  # frame submit rejections as SSE errors
             yield ("data: " + _json.dumps(
                 {"error": {"message": f"{type(e).__name__}: {e}"}}) + "\n\n")
@@ -148,17 +154,20 @@ class LLMServer:
 
     def completions_stream(self, body: dict):
         from ray_tpu.serve import StreamingResponse
+        from ray_tpu.util import tracing
 
         tokens = self._encode_prompt(body.get("prompt", ""))
         return StreamingResponse(
             self._sse_stream(tokens, self._params_from(body),
                              f"cmpl-{uuid.uuid4().hex[:24]}",
                              body.get("model", self._config.model_id),
-                             chat=False),
+                             chat=False,
+                             trace_ctx=tracing.current_context()),
             content_type="text/event-stream")
 
     def chat_stream(self, body: dict):
         from ray_tpu.serve import StreamingResponse
+        from ray_tpu.util import tracing
 
         prompt = self._tok.apply_chat_template(body.get("messages", []))
         return StreamingResponse(
@@ -166,7 +175,8 @@ class LLMServer:
                              self._params_from(body),
                              f"chatcmpl-{uuid.uuid4().hex[:24]}",
                              body.get("model", self._config.model_id),
-                             chat=True),
+                             chat=True,
+                             trace_ctx=tracing.current_context()),
             content_type="text/event-stream")
 
     def completions(self, body: dict) -> dict:
@@ -266,19 +276,31 @@ class OpenAIRouter:
         if path.endswith("/v1/models") or path == "/models":
             return {"object": "list",
                     "data": [{"id": self._model_id, "object": "model"}]}
+        # Trace root for the serving anatomy (ISSUE 20): every request
+        # that survives RTPU_TRACE_SAMPLE renders as one connected tree —
+        # openai.request -> serve.route -> replica task -> llm.request
+        # (queue / kv_pull / prefill / decode phase spans under it).
+        from ray_tpu.util import tracing
+
         if path.endswith("/chat/completions"):
-            h = self._server.options(routing_hint=self._hint(body, True))
-            if body.get("stream"):
-                # the stream marker passes through untouched: the proxy
-                # pulls SSE chunks straight from the LLMServer replica
-                return h.chat_stream.remote(body).result(timeout_s=300)
-            return h.chat.remote(body).result(timeout_s=300)
+            with tracing.serving_span("openai.request", path=path,
+                                      stream=bool(body.get("stream"))):
+                h = self._server.options(
+                    routing_hint=self._hint(body, True))
+                if body.get("stream"):
+                    # the stream marker passes through untouched: the proxy
+                    # pulls SSE chunks straight from the LLMServer replica
+                    return h.chat_stream.remote(body).result(timeout_s=300)
+                return h.chat.remote(body).result(timeout_s=300)
         if path.endswith("/completions"):
-            h = self._server.options(routing_hint=self._hint(body, False))
-            if body.get("stream"):
-                return h.completions_stream.remote(body).result(
-                    timeout_s=300)
-            return h.completions.remote(body).result(timeout_s=300)
+            with tracing.serving_span("openai.request", path=path,
+                                      stream=bool(body.get("stream"))):
+                h = self._server.options(
+                    routing_hint=self._hint(body, False))
+                if body.get("stream"):
+                    return h.completions_stream.remote(body).result(
+                        timeout_s=300)
+                return h.completions.remote(body).result(timeout_s=300)
         return {"error": f"unknown endpoint {path}"}
 
 

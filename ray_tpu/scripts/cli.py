@@ -581,6 +581,26 @@ def cmd_slo(args):
             state += f" (fired {r['fired_total']}x)"
         print(f"  {r['rule']:22s} {r['objective']:44s} {val:>10s} "
               f"{r['burn_fast']:7.2f} {r['burn_slow']:7.2f}  {state}")
+    if getattr(args, "explain", False):
+        explained = [r for r in status.get("rules", [])
+                     if r.get("attribution")]
+        print("======== burn attribution ========")
+        if not explained:
+            print("  (no attributed fires yet — attribution is stamped "
+                  "when a serving-latency rule fires)")
+        for r in explained:
+            a = r["attribution"]
+            print(f"  {r['rule']}: verdict={a.get('verdict', '?')} "
+                  f"({a.get('traces', 0)} traced request(s) in window)")
+            phases = a.get("phases") or {}
+            for phase in ("queue", "kv_pull", "prefill", "decode"):
+                if phase not in phases:
+                    continue
+                frac = float(phases[phase])
+                bar = "#" * int(round(frac * 40))
+                print(f"    {phase:9s} {frac * 100:5.1f}%  {bar}")
+            for tid in a.get("exemplar_trace_ids") or ():
+                print(f"    exemplar trace={tid}")
 
 
 def cmd_top(args):
@@ -1038,6 +1058,10 @@ def main(argv=None):
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_events)
     sp = sub.add_parser("slo")
+    sp.add_argument("--explain", action="store_true",
+                    help="show burn attribution for fired serving rules: "
+                         "phase shares (queue/kv-pull/prefill/decode), "
+                         "verdict, exemplar trace ids")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_slo)
     sp = sub.add_parser("top")

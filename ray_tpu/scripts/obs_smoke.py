@@ -6,10 +6,16 @@ observability surface is live: the trace assembles into one
 cross-process tree with a critical-path summary, the dashboard serves a
 valid Prometheus /metrics document carrying the runtime's
 self-instrumentation, and /api/traces returns both the summary rows and
-the assembled tree.  The final section deliberately breaches an SLO
-(a queue-wait burst over CPU capacity) and asserts the burn-rate alert
-fires with a trace-linked correlated event, clears with hysteresis, and
-renders on `rtpu events` / `rtpu slo` / `rtpu top`.
+the assembled tree.  The traced-serving section routes one request
+through a serve handle into a KV-tiered LLM engine and asserts it
+renders as ONE connected router→replica→engine span tree (with the
+typed kv-pull span), that the impossible smoke_ttft objective then
+fires with phase-share burn attribution + exemplar trace ids, and that
+the exemplar survives metrics_push into the TSDB.  The final section
+deliberately breaches an SLO (a queue-wait burst over CPU capacity) and
+asserts the burn-rate alert fires with a trace-linked correlated event,
+clears with hysteresis, and renders on `rtpu events` / `rtpu slo`
+(`--explain` shows the banked phase shares) / `rtpu top`.
 
 Usage:  python -m ray_tpu.scripts.obs_smoke
 """
@@ -30,7 +36,8 @@ import urllib.request
 # cluster until the burst below deliberately overcommits the CPUs.
 os.environ.setdefault(
     "RTPU_SLO_RULES",
-    "smoke_queue: p90(scheduler_task_queue_wait_s, 15s) < 0.05")
+    "smoke_queue: p90(scheduler_task_queue_wait_s, 15s) < 0.05;"
+    "smoke_ttft: p90(llm_ttft_s, 15s) < 0.0001")
 os.environ.setdefault("RTPU_TSDB_SAMPLE_S", "0.5")
 os.environ.setdefault("RTPU_METRICS_FLUSH_S", "0.25")
 
@@ -335,6 +342,137 @@ def main() -> int:
         print(f"request router ok (decisions={dict(decisions)}, "
               f"{len(routing[0]['replicas'])} replicas in KV snapshot)")
 
+        # -- traced serving anatomy -----------------------------------
+        # One routed request must render as ONE connected trace tree:
+        # serving root -> serve.route (policy/outcome attrs) -> the
+        # replica task -> replica.handle -> llm.request with queue /
+        # kv-pull / prefill / decode children.  The engine runs with the
+        # KV tier up so the pull shows as a typed-outcome span ("miss"
+        # on cold traffic); the impossible smoke_ttft objective then
+        # fires with phase-share burn attribution + exemplar trace ids
+        # stamped by the head sampler.
+        @serve.deployment(num_replicas=1,
+                          request_router_policy="prefix_aware")
+        class Gen:
+            def __init__(self):
+                import jax as jax_mod
+
+                from ray_tpu.llm import kv_tier as kv_tier_mod
+                from ray_tpu.llm.engine import (
+                    EngineConfig as EC,
+                    LLMEngine as Eng,
+                )
+                from ray_tpu.models import llama as llama_mod
+
+                mcfg = llama_mod.LlamaConfig(
+                    vocab_size=128, d_model=64, n_layers=2, n_heads=4,
+                    n_kv_heads=2, d_ff=128, max_seq_len=256,
+                    dtype="float32", remat=False)
+                params = llama_mod.init(mcfg, jax_mod.random.PRNGKey(0))
+                self._eng = Eng(params, mcfg, EC(
+                    max_slots=2, num_pages=32, page_size=8,
+                    max_seq_len=256, prefill_buckets=(16, 32)),
+                    kv_tier=kv_tier_mod.default_tier())
+
+            def __call__(self, toks):
+                from ray_tpu.llm.engine import SamplingParams as SP
+
+                return self._eng.generate(list(toks), SP(max_tokens=4))
+
+        hgen = serve.run(Gen.bind(), name="obs-smoke-gen",
+                         route_prefix="/obs-smoke-gen", proxy=False)
+
+        # warmup: the replica's llm_ttft_s series does not exist until
+        # its first observation, and the TSDB's counter-reset handling
+        # treats a fresh series' earliest point as the baseline — so a
+        # single request on a cold replica can never produce a window
+        # delta.  One untimed request banks that baseline (and pays the
+        # prefill-bucket compile) so the traced request below registers
+        # as a real increment.
+        assert len(hgen.remote([1, 5, 9, 3]).result(timeout_s=120)) == 4
+
+        # the warmup (and the serving-metrics engine run above)
+        # legitimately tripped the impossible smoke_ttft objective; let
+        # it clear so the fire below is attributable to THIS traced
+        # request
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            row = next(r for r in state.slo_status()["rules"]
+                       if r["rule"] == "smoke_ttft")
+            if not row["firing"]:
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError(state.slo_status())
+
+        anatomy_start = time.time()
+        with tracing.trace_span("serving-anatomy") as ser_root:
+            toks2 = hgen.options(routing_hint="anatomy").remote(
+                [1, 5, 9, 3, 7, 2, 8, 4, 6, 11, 12, 13]).result(
+                    timeout_s=120)
+        assert len(toks2) == 4, toks2
+
+        want_spans = {"serving-anatomy", "serve.route", "replica.handle",
+                      "llm.request", "llm.queue", "llm.kv_pull",
+                      "llm.prefill", "llm.decode"}
+        names: set = set()
+        anat = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            anat = state.get_trace(ser_root.trace_id)
+            names = {sp["name"] for sp in anat["spans"]}
+            if want_spans <= names:
+                break
+            time.sleep(0.5)
+        assert want_spans <= names, names
+        # ONE connected tree rooted at the serving span — every engine
+        # span found its parent across the router/replica hops
+        assert len(anat["tree"]) == 1 and \
+            anat["tree"][0]["name"] == "serving-anatomy", anat["tree"]
+        route_sp = next(sp for sp in anat["spans"]
+                        if sp["name"] == "serve.route")
+        assert route_sp.get("args", {}).get("policy"), route_sp
+        pull_sp = next(sp for sp in anat["spans"]
+                       if sp["name"] == "llm.kv_pull")
+        assert pull_sp.get("args", {}).get("outcome"), pull_sp
+        print(f"serving anatomy ok ({len(anat['spans'])} spans, "
+              f"route policy={route_sp['args']['policy']} "
+              f"kv_pull={pull_sp['args']['outcome']})")
+
+        # the TTFT observation above breaches smoke_ttft: the fire must
+        # carry >=1 exemplar trace id, and the engine's banked verdict
+        # must decompose the burn into phase shares
+        fire_ttft = None
+        deadline = time.monotonic() + 45
+        while fire_ttft is None and time.monotonic() < deadline:
+            for ev in state.list_events(kind="slo.fire"):
+                if ev["data"].get("rule") == "smoke_ttft" \
+                        and ev["ts"] >= anatomy_start:
+                    fire_ttft = ev
+            time.sleep(0.5)
+        assert fire_ttft is not None, \
+            [e["kind"] for e in state.list_events(limit=50)]
+        assert fire_ttft["data"].get("exemplar_trace_ids"), fire_ttft
+        attr = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            row = next(r for r in state.slo_status()["rules"]
+                       if r["rule"] == "smoke_ttft")
+            attr = row.get("attribution")
+            if attr and attr.get("verdict") != "unattributed":
+                break
+            time.sleep(0.5)
+        assert attr and attr.get("phases"), state.slo_status()
+        assert ser_root.trace_id in attr["exemplar_trace_ids"], attr
+        # the exemplar survived metrics_push -> TSDB: the banked bucket
+        # map for llm_ttft_s must point back at this trace
+        ex = state.exemplars_for("llm_ttft_s", window_s=120.0)
+        assert any(ser_root.trace_id in by_bucket.values()
+                   for by_bucket in ex.values()), ex
+        serve.delete("obs-smoke-gen")
+        print(f"slo attribution ok (verdict={attr['verdict']}, "
+              f"phases={attr['phases']}, exemplar linked)")
+
         # -- SLO breach drill -----------------------------------------
         # Overcommit the 4 CPUs with sleeping tasks so queue wait p90
         # blows through the smoke_queue objective; the driver emits a
@@ -418,6 +556,9 @@ def main() -> int:
         assert "<- smoke.breach_burst" in ev_out, ev_out
         slo_out = _cli(["slo"])
         assert "smoke_queue" in slo_out and "fired" in slo_out, slo_out
+        slo_x = _cli(["slo", "--explain"])
+        assert "burn attribution" in slo_x and "verdict=" in slo_x, slo_x
+        assert ser_root.trace_id in slo_x, slo_x
         top_out = _cli(["top", "--window", "120"])
         assert "node_workers" in top_out, top_out
         assert "scheduler_task_queue_wait_s" in top_out, top_out

@@ -518,11 +518,31 @@ class ServeController:
                 continue
             ds.kv_pushes[root] = now
             targets = [r for rid, r in by_id.items() if rid not in have]
+            pushed = 0
             for r in targets[:goal - len(have)]:
                 try:
                     r.kv_prehydrate.remote([root])
+                    pushed += 1
                 except Exception:  # noqa: BLE001 — replication is
                     pass           # best-effort durability, not liveness
+            if pushed:
+                try:
+                    from ray_tpu.util import events
+
+                    # incident-plane record of the fan-out: the resulting
+                    # kv.pull events on the target replicas correlate back
+                    # to this push by family root
+                    events.emit(
+                        "kv.replicate",
+                        message=f"replicating family {root[:12]} to "
+                                f"{pushed} replica(s) "
+                                f"({ds.app_name}/{ds.name})",
+                        data={"root": root, "targets": pushed,
+                              "deployment": ds.name,
+                              "holders": len(have)},
+                        coalesce_s=5.0)
+                except Exception:  # noqa: BLE001
+                    pass
 
     def _publish_router_stats(self, ds: _DeploymentState,
                               samples: Dict[bytes, Any]) -> None:

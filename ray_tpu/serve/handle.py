@@ -144,6 +144,20 @@ class DeploymentHandle:
 
     def _call(self, method: str, args, kwargs,
               hint: Optional[str] = None) -> DeploymentResponse:
+        # The serve.route span covers the routing decision + submission
+        # (not the result wait): inside a traced request the replica's
+        # handle_request task span parents under it via attach_trace, so
+        # the tree reads router decision -> replica -> engine.
+        from ray_tpu.util import tracing
+
+        with tracing.trace_span(
+                "serve.route", app=self.app_name,
+                deployment=self.deployment_name, method=method,
+                hinted=hint is not None) as sp:
+            return self._routed_call(method, args, kwargs, hint, sp)
+
+    def _routed_call(self, method: str, args, kwargs,
+                     hint: Optional[str], sp) -> DeploymentResponse:
         deadline = time.monotonic() + 30.0
         reported = False
         while True:
@@ -176,6 +190,19 @@ class DeploymentHandle:
         state = {"rid": rid}
         router = self._router
         router.on_send(rid)
+        if sp is not None:
+            try:
+                loads = [router.load(r.actor_id)
+                         for r in router.replicas()]
+                sp.attrs.update(
+                    policy=router.policy,
+                    outcome=getattr(router, "_last_outcome", None),
+                    replica=rid.hex()[:12] if isinstance(rid, bytes)
+                    else str(rid),
+                    replicas=len(loads),
+                    imbalance=(max(loads) - min(loads)) if loads else 0)
+            except Exception:
+                pass
 
         def done():
             router.on_done(state["rid"])

@@ -178,11 +178,20 @@ class ReplicaActor:
                 raise AttributeError(
                     f"{type(self._user).__name__} is not callable; "
                     f"call a method instead")
-            out = target(*args, **kwargs)
-            if inspect.iscoroutine(out):
-                import asyncio
+            # Nested under the actor task span worker_main opened (which
+            # already carries the replica queue wait): this one isolates
+            # user-code time and stamps the deployment identity on the
+            # request tree.
+            from ray_tpu.util import tracing
 
-                out = asyncio.run(out)
+            with tracing.trace_span("replica.handle", method=method,
+                                    app=self._mtags["app"],
+                                    deployment=self._mtags["deployment"]):
+                out = target(*args, **kwargs)
+                if inspect.iscoroutine(out):
+                    import asyncio
+
+                    out = asyncio.run(out)
             from ray_tpu.serve import streaming
 
             if streaming.is_stream_result(out):

@@ -87,6 +87,9 @@ class RequestRouter:
         self._mtags = {"app": app_name, "deployment": deployment_name}
         self._decisions: Dict[str, int] = defaultdict(int)
         self._gauges_at = 0.0
+        # last decision outcome (e.g. "hit"/"fallback_imbalanced"): the
+        # handle's serve.route span reads it right after choose() returns
+        self._last_outcome: Optional[str] = None
 
     # -------------------- replica set / stats plane --------------------
 
@@ -197,6 +200,7 @@ class RequestRouter:
             tags={"policy": self.policy, "outcome": outcome})
         with self._lock:
             self._decisions[outcome] += 1
+            self._last_outcome = outcome
         if reps and len(reps) > 1:
             now = time.monotonic()
             if now - self._gauges_at >= 0.5:

@@ -184,6 +184,19 @@ class Gauge(Metric):
 
 DEFAULT_BOUNDARIES = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60)
 
+# Serving-latency histogram families expected to carry exemplar trace ids
+# (the bucket-indexed "which request landed here" links).  metrics_lint
+# parses this literal and enforces that each family is registered as a
+# Histogram — an exemplar on a counter/gauge would silently vanish.
+EXEMPLAR_FAMILIES = (
+    "llm_ttft_s",
+    "llm_tpot_s",
+    "llm_e2e_s",
+    "llm_queue_wait_s",
+    "llm_prefill_s",
+    "serve_request_latency_s",
+)
+
 
 class Histogram(Metric):
     _kind = "histogram"
@@ -195,8 +208,19 @@ class Histogram(Metric):
         self._boundaries = tuple(boundaries or DEFAULT_BOUNDARIES)
         # per tag tuple: [bucket counts..., +inf count, sum]
         self._hist: Dict[Tuple[str, ...], list] = {}
+        # per tag tuple: {bucket index: last trace id to land there}
+        self._exemplars: Dict[Tuple[str, ...], Dict[int, str]] = {}
 
-    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None,
+                exemplar: Optional[str] = None):
+        if exemplar is None:
+            # ambient pickup: an observe inside a traced request links the
+            # bucket to that request without every call site threading ids
+            from ray_tpu.util import tracing
+
+            ctx = tracing.current_context()
+            if ctx is not None:
+                exemplar = ctx[0]
         key = self._tag_tuple(tags)
         with self._lock:
             h = self._hist.get(key)
@@ -204,16 +228,28 @@ class Histogram(Metric):
                 h = self._hist[key] = [0] * (len(self._boundaries) + 1) + [0.0]
             for i, b in enumerate(self._boundaries):
                 if value <= b:
-                    h[i] += 1
+                    bucket = i
                     break
             else:
-                h[len(self._boundaries)] += 1
+                bucket = len(self._boundaries)
+            h[bucket] += 1
             h[-1] += value
+            if exemplar:
+                self._exemplars.setdefault(key, {})[bucket] = str(exemplar)
+
+    def clear(self) -> None:
+        super().clear()
+        with self._lock:
+            self._exemplars.clear()
 
     def _snapshot(self) -> dict:
         with self._lock:
             hist = {k: list(v) for k, v in self._hist.items()}
-        return {"name": self._name, "kind": self._kind,
+            exemplars = {k: dict(v) for k, v in self._exemplars.items() if v}
+        snap = {"name": self._name, "kind": self._kind,
                 "description": self._description,
                 "tag_keys": self._tag_keys,
                 "boundaries": self._boundaries, "hist": hist}
+        if exemplars:
+            snap["exemplars"] = exemplars
+        return snap

@@ -669,6 +669,27 @@ def query_timeseries(family: str = "",
                      {"family": family, "window_s": window_s})
 
 
+def exemplars_for(family: str,
+                  window_s: float = 300.0) -> Dict[str, Dict[int, str]]:
+    """Exemplar trace ids banked on a histogram family's buckets: per
+    series (keyed "tag=val,..." or "-"), bucket index -> the trace id of
+    the last observation that landed there.  This answers "which request
+    was the p99" — feed a returned id to :func:`get_trace` for the full
+    router→replica→engine anatomy of that request."""
+    doc = query_timeseries(family, window_s)
+    out: Dict[str, Dict[int, str]] = {}
+    for s in doc.get("series") or ():
+        ex = s.get("exemplars")
+        if not ex:
+            continue
+        key = ",".join(f"{k}={v}"
+                       for k, v in sorted(s.get("tags", {}).items())) or "-"
+        cur = out.setdefault(key, {})
+        for b, tid in ex.items():
+            cur[int(b)] = str(tid)
+    return out
+
+
 def slo_status() -> Dict[str, Any]:
     """The SLO engine's rule table: per-rule current value, fast/slow
     burn rates, firing state — plus the aggregate ``healthy`` bit the

@@ -20,6 +20,7 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import random
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -91,9 +92,11 @@ def attach_trace(spec) -> None:
 
 class Span:
     """Handle yielded by :func:`trace_span`: exposes the ids so callers can
-    look the trace up later (``state.get_trace(span.trace_id)``)."""
+    look the trace up later (``state.get_trace(span.trace_id)``).  Mutating
+    ``attrs`` inside the block adds attributes resolved mid-span (e.g. the
+    router's chosen replica) to the recorded span."""
 
-    __slots__ = ("trace_id", "span_id", "parent_id", "name")
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "attrs")
 
     def __init__(self, trace_id: str, span_id: str,
                  parent_id: Optional[str], name: str):
@@ -101,6 +104,7 @@ class Span:
         self.span_id = span_id
         self.parent_id = parent_id
         self.name = name
+        self.attrs: Dict[str, Any] = {}
 
     def __repr__(self):
         return f"Span({self.name!r}, trace_id={self.trace_id})"
@@ -149,8 +153,96 @@ def trace_span(name: str, **attributes):
             "parent_id": parent_id, "name": name, "kind": "user",
             "pid": os.getpid(), "start_ts": t0, "end_ts": time.time(),
             "queue_wait_s": 0.0, "arg_fetch_s": 0.0,
-            "run_s": time.time() - t0, "ok": True, "args": attributes,
+            "run_s": time.time() - t0, "ok": True,
+            "args": dict(attributes, **span.attrs),
         })
+
+
+def sample_request() -> bool:
+    """Head-sampling decision for a new serving root trace
+    (``RTPU_TRACE_SAMPLE``, default 1.0).  Children of an existing trace
+    always inherit — sampling happens only where roots are minted, so a
+    sampled request is traced end to end and a dropped one costs nothing."""
+    from ray_tpu._private import flags
+
+    p = float(flags.get("RTPU_TRACE_SAMPLE"))
+    if p >= 1.0:
+        return True
+    if p <= 0.0:
+        return False
+    return random.random() < p
+
+
+@contextlib.contextmanager
+def serving_span(name: str, **attributes):
+    """Root entry point for a serving request (OpenAI server, P/D router).
+
+    Unlike :func:`trace_span`, this mints a root even when tracing was
+    never enabled in this process — serving anatomy should be on by
+    default — but each new root passes the ``RTPU_TRACE_SAMPLE`` head
+    sampler first.  Inside an existing trace it nests exactly like
+    ``trace_span``; sampled-out requests yield None and record nothing.
+    """
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None and not sample_request():
+        yield None
+        return
+    with trace_span(name, **attributes) as span:
+        if span is not None:
+            yield span
+            return
+        # no ambient context and tracing disabled: mint the root ourselves
+        trace_id, parent_id = new_trace_id(), None
+        span = Span(trace_id, new_span_id(), parent_id, name)
+        _tls.ctx = (trace_id, span.span_id)
+        t0 = time.time()
+        try:
+            yield span
+        finally:
+            _tls.ctx = ctx
+            _record({
+                "trace_id": trace_id, "span_id": span.span_id,
+                "parent_id": parent_id, "name": name, "kind": "user",
+                "pid": os.getpid(), "start_ts": t0, "end_ts": time.time(),
+                "queue_wait_s": 0.0, "arg_fetch_s": 0.0,
+                "run_s": time.time() - t0, "ok": True,
+                "args": dict(attributes, **span.attrs),
+            })
+
+
+@contextlib.contextmanager
+def use_context(ctx: Optional[Tuple[str, Optional[str]]]):
+    """Re-establish a captured ``(trace_id, span_id)`` context on this
+    thread — for work handed across threads or processes (SSE generators,
+    the P/D prefill→decode handoff) that should parent under the capture
+    point rather than wherever it happens to run."""
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    try:
+        yield
+    finally:
+        _tls.ctx = prev
+
+
+def record_span(trace_id: str, name: str, start_ts: float, end_ts: float, *,
+                parent_id: Optional[str] = None,
+                span_id: Optional[str] = None, kind: str = "engine",
+                ok: bool = True,
+                attrs: Optional[Dict[str, Any]] = None) -> str:
+    """Record a span with an explicit context instead of thread-local
+    state.  The engine's scheduler thread interleaves many requests, so it
+    carries each request's ``(trace_id, span_id)`` and stamps phase spans
+    (queue, kv-pull, prefill, decode) here as they complete."""
+    sid = span_id or new_span_id()
+    _record({
+        "trace_id": trace_id, "span_id": sid, "parent_id": parent_id,
+        "name": name, "kind": kind, "pid": os.getpid(),
+        "start_ts": start_ts, "end_ts": end_ts,
+        "queue_wait_s": 0.0, "arg_fetch_s": 0.0,
+        "run_s": max(0.0, end_ts - start_ts), "ok": ok,
+        "args": dict(attrs or {}),
+    })
+    return sid
 
 
 # ---------------------------------------------------------------------------
